@@ -1,0 +1,219 @@
+"""Workloads: per-client command streams with expected results.
+
+Re-design of framework/tst/.../Workload.java:44-574.  A workload yields
+(command, expected-result) pairs per client; the string-template form supports
+the reference's ``%``-substitutions (Workload.java:96-226):
+
+  %r    random alphanumeric string of 8 chars      %rN   ... of N chars
+  %n    random int in [1, 100]                     %nN   ... in [1, N]
+  %i    1-based command index;  %i-1 / %i+1        %a    client address string
+
+The same random draws are shared between a command string and its result
+string when the identical token appears in both (keyed by token text, consumed
+in order) — exactly the reference's randomness-map protocol.
+"""
+
+from __future__ import annotations
+
+import random
+import re
+import string
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from dslabs_tpu.core.address import Address
+from dslabs_tpu.core.types import Command, Result
+
+__all__ = ["Workload", "InfiniteWorkload", "workload_builder"]
+
+_TOKEN = re.compile(r"%(?:r(\d*)|n(\d*)|i(?:-1|\+1)?|a)")
+
+
+def _substitute(s: str, a: Address, i: int,
+                randomness: Optional[Dict[str, List[str]]]):
+    """One pass of %-token replacement.  When ``randomness`` is None, fresh
+    draws are made and recorded; when given, recorded draws are consumed."""
+    recording: Dict[str, List[str]] = {}
+    use_recorded = randomness is not None
+
+    def repl(m: re.Match) -> str:
+        tok = m.group(0)
+        kind = tok[1]
+        if kind == "r" or kind == "n":
+            val: Optional[str] = None
+            if use_recorded and randomness.get(tok):
+                val = randomness[tok].pop(0)
+            if val is None:
+                if kind == "r":
+                    n = int(m.group(1)) if m.group(1) else 8
+                    val = "".join(random.choices(
+                        string.ascii_letters + string.digits, k=n))
+                else:
+                    ub = int(m.group(2)) if m.group(2) else 100
+                    val = str(random.randint(1, ub))
+            if not use_recorded:
+                recording.setdefault(tok, []).append(val)
+            return val
+        if kind == "i":
+            if tok == "%i-1":
+                return str(i - 1)
+            if tok == "%i+1":
+                return str(i + 1)
+            return str(i)
+        if kind == "a":
+            return str(a)
+        return tok
+
+    out = _TOKEN.sub(repl, s)
+    return out, recording
+
+
+def do_replacements(command: Optional[str], result: Optional[str],
+                    a: Address, i: int) -> Tuple[Optional[str], Optional[str]]:
+    if command is None:
+        return None, None
+    new_cmd, rec = _substitute(command, a, i, None)
+    if result is None:
+        return new_cmd, None
+    new_res, _ = _substitute(result, a, i, rec)
+    return new_cmd, new_res
+
+
+class Workload:
+    """A stream of commands (and optionally expected results) for one client.
+
+    Construct via :func:`workload_builder` or the convenience classmethods.
+    """
+
+    def __init__(self, *,
+                 commands: Optional[List[Command]] = None,
+                 results: Optional[List[Result]] = None,
+                 command_strings: Optional[List[str]] = None,
+                 result_strings: Optional[List[str]] = None,
+                 parser: Optional[Callable[[str, Optional[str]],
+                                           Tuple[Command, Optional[Result]]]] = None,
+                 num_times: int = 1,
+                 finite: bool = True,
+                 replacements: bool = True,
+                 millis_between_requests: int = 0):
+        if commands is not None:
+            if command_strings is not None or result_strings is not None:
+                raise ValueError("Cannot mix commands and command strings")
+            if results is not None and len(commands) != len(results):
+                raise ValueError("Commands/results size mismatch")
+            self._commands: Optional[List[Command]] = list(commands)
+            self._results: List[Result] = list(results) if results else []
+            self._command_strings = None
+            self._result_strings: List[str] = []
+            self._parser = None
+        elif command_strings is not None:
+            if results is not None:
+                raise ValueError("Cannot mix commands and command strings")
+            if parser is None:
+                raise ValueError("String workload requires a parser")
+            if result_strings is not None and len(command_strings) != len(result_strings):
+                raise ValueError("Commands/results size mismatch")
+            self._commands = None
+            self._results = []
+            self._command_strings = list(command_strings)
+            self._result_strings = list(result_strings) if result_strings else []
+            self._parser = parser
+        else:
+            raise ValueError("Must have commands or command strings")
+        if not finite and self._list_size() == 0:
+            raise ValueError("Cannot create empty infinite workload")
+        self._finite = finite
+        self._replacements = replacements
+        self._num_times = max(1, num_times) if finite else 1
+        self.millis_between_requests = millis_between_requests
+        self._i = 0
+
+    # ------------------------------------------------------------------ core
+
+    def _list_size(self) -> int:
+        return (len(self._commands) if self._commands is not None
+                else len(self._command_strings))
+
+    def _next_pair(self, a: Address) -> Tuple[Command, Optional[Result]]:
+        if not self.has_next():
+            raise RuntimeError("Workload finished.")
+        index = self._i % self._list_size()
+        if self._commands is not None:
+            command = self._commands[index]
+            result = self._results[index] if self.has_results() else None
+        else:
+            cs = self._command_strings[index]
+            rs = self._result_strings[index] if self.has_results() else None
+            if self._replacements:
+                cs, rs = do_replacements(cs, rs, a, self._i + 1)
+            command, result = self._parser(cs, rs)
+        self._i += 1
+        return command, result
+
+    def next_command_and_result(self, client_address: Address) -> Tuple[Command, Result]:
+        if not self.has_results():
+            raise RuntimeError("Workload doesn't contain results")
+        return self._next_pair(client_address)
+
+    def next_command(self, client_address: Address) -> Command:
+        return self._next_pair(client_address)[0]
+
+    def has_next(self) -> bool:
+        return not self._finite or self._i < self._list_size() * self._num_times
+
+    def has_results(self) -> bool:
+        if self._commands is not None:
+            return len(self._commands) == len(self._results) and self._list_size() > 0
+        return (len(self._command_strings) == len(self._result_strings)
+                and self._list_size() > 0)
+
+    def add(self, command, result=None) -> "Workload":
+        if not self._finite or self._num_times > 1:
+            raise RuntimeError("Cannot add to an infinite or repeating workload")
+        if isinstance(command, str):
+            if self._command_strings is None:
+                raise RuntimeError("Workload doesn't have command strings")
+            if result is None and self._command_strings and self.has_results():
+                raise RuntimeError("Workload has results")
+            self._command_strings.append(command)
+            if result is not None:
+                self._result_strings.append(result)
+        else:
+            if self._commands is None:
+                raise RuntimeError("Workload has command strings")
+            if result is None and self._commands and self.has_results():
+                raise RuntimeError("Workload has results")
+            self._commands.append(command)
+            if result is not None:
+                self._results.append(result)
+        return self
+
+    def reset(self) -> None:
+        self._i = 0
+
+    def size(self) -> int:
+        return self._list_size() * self._num_times if self._finite else -1
+
+    def infinite(self) -> bool:
+        return not self._finite
+
+    # Equality: workloads are part of ClientWorker state, but progress is
+    # captured by the worker's sentCommands/results; like the reference
+    # (ClientWorker equality is (client, results) only) workloads never
+    # participate in structural equality.
+
+    def __repr__(self) -> str:
+        return (f"Workload(size={self.size()}, i={self._i}, "
+                f"results={self.has_results()})")
+
+
+class InfiniteWorkload(Workload):
+    """Convenience: endlessly repeating workload (InfiniteWorkload.java:28-58)."""
+
+    def __init__(self, **kwargs):
+        kwargs["finite"] = False
+        super().__init__(**kwargs)
+
+
+def workload_builder(**kwargs) -> Workload:
+    """Keyword-style builder mirroring Workload.builder() (Workload.java:466-557)."""
+    return Workload(**kwargs)

@@ -1,0 +1,46 @@
+"""Global flag system.
+
+Re-design of framework/tst/.../utils/GlobalSettings.java:37-143.  Flags come
+from environment variables (``DSLABS_<NAME>``) or are set programmatically;
+the test harness maps CLI options onto them the way run-tests.py maps flags to
+JVM properties.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = ["GlobalSettings"]
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.lower() in ("1", "true", "yes", "on")
+
+
+class _GlobalSettings:
+    def __init__(self):
+        self.verbose: bool = _env_bool("DSLABS_VERBOSE", True)
+        self.single_threaded: bool = _env_bool("DSLABS_SINGLE_THREADED")
+        self.start_viz: bool = _env_bool("DSLABS_START_VIZ")
+        self.save_traces: bool = _env_bool("DSLABS_SAVE_TRACES")
+        self.do_checks: bool = _env_bool("DSLABS_DO_CHECKS")
+        self.do_all_checks: bool = _env_bool("DSLABS_DO_ALL_CHECKS")
+        self.test_timeouts_disabled: bool = _env_bool("DSLABS_NO_TIMEOUTS")
+        self.results_output_file: Optional[str] = os.environ.get(
+            "DSLABS_RESULTS_OUTPUT_FILE")
+        self.log_level: str = os.environ.get("DSLABS_LOG_LEVEL", "WARNING")
+        # Temporarily-enabled error checks (@ChecksEnabled rule analog)
+        self.error_checks_temporarily_enabled: bool = False
+
+    def do_error_checks(self) -> bool:
+        return self.do_checks or self.error_checks_temporarily_enabled
+
+    def do_all_error_checks(self) -> bool:
+        return self.do_all_checks
+
+
+GlobalSettings = _GlobalSettings()

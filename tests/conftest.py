@@ -1,0 +1,14 @@
+"""Test configuration: force an 8-device virtual CPU mesh before JAX loads.
+
+The real TPU (single chip) is reserved for bench runs; tests exercise the
+multi-chip sharding paths on virtual CPU devices per the project environment
+contract.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()

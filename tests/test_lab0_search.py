@@ -1,0 +1,83 @@
+"""Lab 0 search tests — behavioural port of the reference's PingTest search
+half (labs/lab0-pingpong/tst/dslabs/pingpong/PingTest.java:125-140): BFS finds
+the all-clients-done goal, and the CLIENTS_DONE-pruned subspace is finite and
+safe (RESULTS_OK holds everywhere).
+"""
+
+from dslabs_tpu.core.address import LocalAddress
+from dslabs_tpu.labs.pingpong.pingpong import (Ping, PingClient, PingServer,
+                                               Pong)
+from dslabs_tpu.search.results import EndCondition
+from dslabs_tpu.search.search import bfs, dfs
+from dslabs_tpu.search.search_state import SearchState
+from dslabs_tpu.search.settings import SearchSettings
+from dslabs_tpu.testing.generator import NodeGenerator
+from dslabs_tpu.testing.predicates import CLIENTS_DONE, RESULTS_OK
+from dslabs_tpu.testing.workload import Workload
+
+SERVER = LocalAddress("pingserver")
+
+
+def ping_parser(cmd, res):
+    return Ping(cmd), (Pong(res) if res is not None else None)
+
+
+def make_state(num_clients=1, num_pings=2):
+    gen = NodeGenerator(
+        server_supplier=lambda a: PingServer(a),
+        client_supplier=lambda a: PingClient(a, SERVER),
+        workload_supplier=lambda a: Workload(
+            command_strings=[f"ping-%i" for _ in range(num_pings)],
+            result_strings=[f"ping-%i" for _ in range(num_pings)],
+            parser=ping_parser),
+    )
+    state = SearchState(gen)
+    state.add_server(SERVER)
+    for i in range(1, num_clients + 1):
+        state.add_client_worker(LocalAddress(f"client{i}"))
+    return state
+
+
+def test_bfs_finds_clients_done_goal():
+    state = make_state()
+    settings = SearchSettings().add_invariant(RESULTS_OK).add_goal(CLIENTS_DONE)
+    settings.max_time(30)
+    results = bfs(state, settings)
+    assert results.end_condition == EndCondition.GOAL_FOUND
+    goal = results.goal_matching_state
+    assert goal is not None
+    for w in goal.client_workers().values():
+        assert w.done()
+        assert w.results == [Pong("ping-1"), Pong("ping-2")]
+
+
+def test_bfs_exhausts_pruned_space_safely():
+    state = make_state()
+    settings = (SearchSettings().add_invariant(RESULTS_OK)
+                .add_prune(CLIENTS_DONE))
+    settings.max_time(30)
+    results = bfs(state, settings)
+    assert results.end_condition == EndCondition.SPACE_EXHAUSTED
+
+
+def test_random_dfs_depth_limited():
+    state = make_state()
+    settings = (SearchSettings().add_invariant(RESULTS_OK)
+                .set_max_depth(100))
+    settings.max_time(5)
+    results = dfs(state, settings)
+    assert results.end_condition == EndCondition.TIME_EXHAUSTED
+    assert results.invariant_violating_state is None
+
+
+def test_search_state_dedup():
+    """Stepping the same message twice from one state yields equivalent
+    states (network-as-set, delivery does not consume)."""
+    state = make_state()
+    events = state.events()
+    assert events, "initial state should have deliverable events"
+    e = events[0]
+    s1 = state.step_event(e, None, skip_checks=True)
+    s2 = state.step_event(e, None, skip_checks=True)
+    assert s1.search_equivalence_key() == s2.search_equivalence_key()
+    assert s1 == s2
