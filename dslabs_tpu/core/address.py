@@ -38,6 +38,11 @@ class Address(ImmutableMarker):
     def __deepcopy__(self, memo):
         return self  # immutable
 
+    def __sfreeze__(self):
+        # Canonical frozen form for structural hashing: the printed name is
+        # the identity (equality/ordering are string-based above).
+        return str(self)
+
     def __repr__(self) -> str:
         return str(self)
 

@@ -12,6 +12,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 from dslabs_tpu.core.address import Address
+from dslabs_tpu.core.client_utils import SyncClientMixin
 from dslabs_tpu.core.node import Node
 from dslabs_tpu.core.types import (Application, Client, Command, Message,
                                    Result, Timer)
@@ -76,7 +77,7 @@ class PingServer(Node):
         self.send(PongReply(pong), sender)
 
 
-class PingClient(Node, Client):
+class PingClient(SyncClientMixin, Node, Client):
     """Sends pings, retries on a 10ms timer (PingClient.java:18-88)."""
 
     def __init__(self, address: Address, server_address: Address):
@@ -100,12 +101,8 @@ class PingClient(Node, Client):
     def has_result(self) -> bool:
         return self.pong is not None
 
-    def get_result(self, timeout: Optional[float] = None) -> Result:
-        # In search/single-threaded contexts this is only called when
-        # has_result(); the runner path blocks via the ClientWorker pump.
-        assert self.pong is not None
-        result = self.pong
-        return result
+    def _take_result(self) -> Result:
+        return self.pong
 
     # --------------------------------------------------------------- handlers
 
@@ -113,6 +110,7 @@ class PingClient(Node, Client):
         if self.ping is not None and m.pong.value == self.ping.value:
             self.pong = m.pong
             self.ping = None
+            self._notify_result()
 
     def on_PingTimer(self, t: PingTimer) -> None:
         if self.ping is not None and t.ping == self.ping:

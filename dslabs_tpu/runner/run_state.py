@@ -1,0 +1,266 @@
+"""RunState: the live, wall-clock system.
+
+Re-design of framework/tst/.../runner/RunState.java:53-414.
+
+* ``_setup_node`` wires a node's hooks to clone-on-send into the Network and
+  to record a thrown-exception flag (RunState.java:95-122).
+* Multi-threaded mode: one thread per node looping ``inbox.take()`` ->
+  deliver, filtered by ``settings.should_deliver`` / timer gating
+  (RunState.java:133-163).
+* Single-threaded mode: round-robin delivering at most one message and one
+  due timer per node per step (RunState.java:165-181).
+* ``run``/``start``/``stop``/``wait_for`` lifecycle; nodes can be added and
+  removed live (RunState.java:125-131, 193-383).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, Iterable, Optional
+
+from dslabs_tpu.core.address import Address
+from dslabs_tpu.core.node import Node, NodeConfig
+from dslabs_tpu.runner.network import Network
+from dslabs_tpu.runner.run_settings import RunSettings
+from dslabs_tpu.testing.events import MessageEnvelope, TimerEnvelope
+from dslabs_tpu.testing.generator import NodeGenerator
+from dslabs_tpu.testing.state import AbstractState
+from dslabs_tpu.utils.structural import clone
+
+LOG = logging.getLogger("dslabs.runner")
+
+__all__ = ["RunState"]
+
+_SLOW_HANDLER_WARN_S = 1.0
+
+
+class RunState(AbstractState):
+
+    def __init__(self, generator: NodeGenerator):
+        super().__init__(generator)
+        self._network = Network()
+        self._settings: Optional[RunSettings] = None
+        self._threads: Dict[Address, threading.Thread] = {}
+        self._running = False
+        self._shutdown = threading.Event()
+        self._exception_thrown = False
+        self._lock = threading.RLock()
+        self.stop_time: Optional[float] = None
+
+    # Live run state is never hashed/deduped; identity equality is fine and
+    # avoids touching concurrently-mutating node state.
+    def __eq__(self, other):
+        return self is other
+
+    def __hash__(self):
+        return id(self)
+
+    # ------------------------------------------------------------- accessors
+
+    @property
+    def network(self) -> Network:
+        return self._network
+
+    @property
+    def exception_thrown(self) -> bool:
+        return self._exception_thrown
+
+    def timers(self, address: Address):
+        raise NotImplementedError("RunState does not expose timer queues")
+
+    # -------------------------------------------------------- engine contract
+
+    def _setup_node(self, address: Address) -> None:
+        node = self.node(address)
+        self._network.add_inbox(address)
+        self._config_node(address)
+        node.init()
+        with self._lock:
+            if self._running:
+                self._start_node_thread(address)
+
+    def _ensure_node_config(self, address: Address) -> None:
+        self._config_node(address)
+
+    def _cleanup_node(self, address: Address) -> None:
+        """Remove a node live: interrupt its thread and delete its inbox
+        (RunState.java:125-131)."""
+        with self._lock:
+            self._threads.pop(address, None)
+        inbox = self._network.inbox(address)
+        if inbox is not None:
+            inbox.interrupt()
+        self._network.remove_inbox(address)
+
+    def _config_node(self, address: Address) -> None:
+        state = self
+
+        def message_adder(frm: Address, to: Address, message) -> None:
+            env = MessageEnvelope(frm, to, clone(message))  # clone-on-send
+            state._network.send(env)
+
+        def batch_message_adder(frm, tos, message) -> None:
+            # Clone per destination: each inbox must own its copy (the
+            # reference wires only the per-destination clone-on-send adder in
+            # the runner, RunState.java:99-115).
+            for to in tos:
+                state._network.send(MessageEnvelope(frm, to, clone(message)))
+
+        def timer_adder(frm: Address, timer, min_ms: int, max_ms: int) -> None:
+            env = TimerEnvelope(frm, clone(timer), min_ms, max_ms)
+            state._network.set_timer(env)
+
+        def throwable_catcher(t: BaseException) -> None:
+            LOG.exception("Node %s threw", address, exc_info=t)
+            state._exception_thrown = True
+
+        self.node(address).config(NodeConfig(
+            message_adder=message_adder,
+            batch_message_adder=batch_message_adder,
+            timer_adder=timer_adder,
+            throwable_catcher=throwable_catcher,
+            log_exceptions=True))
+
+    # -------------------------------------------------------------- delivery
+
+    def _deliver(self, address: Address, event) -> None:
+        node = self.node(address)
+        if node is None:
+            return
+        start = time.monotonic()
+        if isinstance(event, MessageEnvelope):
+            if self._settings is None or self._settings.should_deliver(event):
+                node.deliver_message(event.message, event.frm, event.to)
+        else:
+            if self._settings is None or self._settings.should_deliver_timer(event.to):
+                node.deliver_timer(event.timer, event.to)
+        elapsed = time.monotonic() - start
+        if elapsed > _SLOW_HANDLER_WARN_S:
+            LOG.warning("Handler on %s took %.2fs; handlers must not block",
+                        address, elapsed)
+
+    def _run_node_loop(self, address: Address) -> None:
+        while not self._shutdown.is_set():
+            inbox = self._network.inbox(address)
+            if inbox is None:
+                return  # node removed
+            event = inbox.take()
+            if event is None or self._shutdown.is_set():
+                return
+            self._deliver(address, event)
+
+    def _start_node_thread(self, address: Address) -> None:
+        t = threading.Thread(target=self._run_node_loop, args=(address,),
+                             name=f"dslabs-node-{address}", daemon=True)
+        self._threads[address] = t
+        t.start()
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self, settings: Optional[RunSettings] = None) -> None:
+        """Start the system without blocking (multi-threaded mode)."""
+        with self._lock:
+            if self._running:
+                raise RuntimeError("RunState already running")
+            self._settings = settings or RunSettings()
+            self._shutdown.clear()
+            self._running = True
+            self.stop_time = None
+            for address in list(self.addresses()):
+                inbox = self._network.inbox(address)
+                if inbox is not None:
+                    inbox.clear_interrupt()
+                self._start_node_thread(address)
+
+    def run(self, settings: Optional[RunSettings] = None) -> None:
+        """Run until clients finish / the time budget elapses, then stop
+        (RunState.java:223-276)."""
+        settings = settings or RunSettings()
+        if settings.single_threaded:
+            self._run_single_threaded(settings)
+            return
+        self.start(settings)
+        try:
+            self.wait_for()
+        finally:
+            self.stop()
+
+    def _run_single_threaded(self, settings: RunSettings) -> None:
+        """Round-robin: at most one message and one due timer per node per
+        sweep (RunState.java:165-181)."""
+        self._settings = settings
+        self._running = True
+        self.stop_time = None
+        start = time.monotonic()
+        try:
+            while True:
+                delivered_any = False
+                for address in list(self.addresses()):
+                    inbox = self._network.inbox(address)
+                    if inbox is None:
+                        continue
+                    m = inbox.poll_message()
+                    if m is not None:
+                        self._deliver(address, m)
+                        delivered_any = True
+                    t = inbox.poll_due_timer()
+                    if t is not None:
+                        self._deliver(address, t)
+                        delivered_any = True
+                if self._done_condition(settings, start):
+                    return
+                if not delivered_any:
+                    time.sleep(0.001)
+        finally:
+            self._running = False
+            self.stop_time = time.monotonic()
+
+    def _done_condition(self, settings: RunSettings, start: float) -> bool:
+        if settings.wait_for_clients and self.client_workers_map:
+            if all(w.done() for w in self.client_workers_map.values()):
+                return True
+        if settings.max_time_secs is not None:
+            return time.monotonic() - start >= settings.max_time_secs
+        if not (settings.wait_for_clients and self.client_workers_map):
+            return True  # nothing to wait for
+        return False
+
+    def wait_for(self) -> None:
+        """Wait for client workers (if configured) and/or the time budget
+        (RunState.java:193-217)."""
+        settings = self._settings or RunSettings()
+        if settings.wait_for_clients and self.client_workers_map:
+            deadline = (None if settings.max_time_secs is None
+                        else time.monotonic() + settings.max_time_secs)
+            for worker in list(self.client_workers_map.values()):
+                timeout = (None if deadline is None
+                           else max(0.0, deadline - time.monotonic()))
+                worker.wait_until_done(timeout)
+        elif settings.max_time_secs is not None:
+            time.sleep(settings.max_time_secs)
+
+    def stop(self) -> None:
+        """Interrupt node threads and join them (RunState.java:340-383)."""
+        with self._lock:
+            if not self._running:
+                return
+            self._shutdown.set()
+            threads = list(self._threads.values())
+            self._threads.clear()
+            self._running = False
+        for address in list(self.addresses()):
+            inbox = self._network.inbox(address)
+            if inbox is not None:
+                inbox.interrupt()
+        join_start = time.monotonic()
+        for t in threads:
+            t.join(timeout=2.0)
+        if time.monotonic() - join_start > 1.0:
+            LOG.warning("Node threads took >1s to stop; "
+                        "handlers should not block")
+        self.stop_time = time.monotonic()
+
+    def running(self) -> bool:
+        return self._running

@@ -46,15 +46,34 @@ def _public_items(obj: Any):
     return [(k, v) for k, v in d.items() if not k.startswith("_")]
 
 
+def _slot_items(obj: Any):
+    """Public values stored in __slots__ across the MRO (objects like Address
+    keep their state in slots, not __dict__)."""
+    items = []
+    for cls in type(obj).__mro__:
+        for name in getattr(cls, "__slots__", ()):
+            if name.startswith("__"):
+                continue
+            try:
+                items.append((name, getattr(obj, name)))
+            except AttributeError:
+                pass
+    return items
+
+
 def sfreeze(obj: Any) -> Any:
     """Return a canonical hashable representation of ``obj``.
 
     dicts and sets freeze order-insensitively (like Java HashMap/HashSet
     hashCodes); lists/tuples keep order.  Objects with ``StructEq`` freeze as
-    (class, frozen public fields).
+    (class, frozen public fields).  A class may define ``__sfreeze__`` to
+    supply its own canonical form.
     """
     if obj is None or isinstance(obj, (bool, int, float, str, bytes)):
         return obj
+    custom = getattr(obj, "__sfreeze__", None)
+    if custom is not None:
+        return (type(obj).__qualname__, custom())
     if isinstance(obj, (list, tuple)):
         return ("#l", tuple(sfreeze(x) for x in obj))
     if isinstance(obj, dict):
@@ -66,10 +85,13 @@ def sfreeze(obj: Any) -> Any:
         # ClientWorker's (client, results)) shapes nested hashing too.
         return (type(obj).__qualname__, ("#d", frozenset(
             (k, sfreeze(v)) for k, v in obj._eq_fields().items())))
-    if hasattr(obj, "__dict__"):
-        # Plain objects (e.g. dataclasses without StructEq): structural too.
+    if hasattr(obj, "__dict__") or hasattr(type(obj), "__slots__"):
+        # Plain objects (e.g. dataclasses, slotted classes): structural over
+        # public __dict__ entries plus public slot values.
+        fields = _public_items(obj) if hasattr(obj, "__dict__") else []
+        fields += _slot_items(obj)
         return (type(obj).__qualname__, ("#d", frozenset(
-            (k, sfreeze(v)) for k, v in _public_items(obj))))
+            (k, sfreeze(v)) for k, v in fields)))
     # Fall back to the object's own hashability (enums, etc).
     return obj
 

@@ -1,0 +1,99 @@
+"""Lab 0 run tests — behavioural port of the reference's PingTest run half
+(labs/lab0-pingpong/tst/dslabs/pingpong/PingTest.java:32-124): workload runs
+to completion on the real-time emulated network, in both threading modes,
+including under an unreliable network (retry timer must recover losses).
+"""
+
+import pytest
+
+from dslabs_tpu.core.address import LocalAddress
+from dslabs_tpu.labs.pingpong.pingpong import (Ping, PingClient, PingServer,
+                                               Pong)
+from dslabs_tpu.runner.run_settings import RunSettings
+from dslabs_tpu.runner.run_state import RunState
+from dslabs_tpu.testing.generator import NodeGenerator
+from dslabs_tpu.testing.predicates import RESULTS_OK
+from dslabs_tpu.testing.workload import Workload
+
+SERVER = LocalAddress("pingserver")
+
+
+def ping_parser(cmd, res):
+    return Ping(cmd), (Pong(res) if res is not None else None)
+
+
+def make_state(num_clients=1, num_pings=5):
+    gen = NodeGenerator(
+        server_supplier=lambda a: PingServer(a),
+        client_supplier=lambda a: PingClient(a, SERVER),
+        workload_supplier=lambda a: Workload(
+            command_strings=["ping-%i-%a" for _ in range(num_pings)],
+            result_strings=["ping-%i-%a" for _ in range(num_pings)],
+            parser=ping_parser),
+    )
+    state = RunState(gen)
+    state.add_server(SERVER)
+    for i in range(1, num_clients + 1):
+        state.add_client_worker(LocalAddress(f"client{i}"))
+    return state
+
+
+def assert_results_ok(state):
+    r = RESULTS_OK.check(state)
+    assert r.value, r.error_message()
+
+
+def test_basic_run_multithreaded():
+    state = make_state(num_clients=2)
+    settings = RunSettings().max_time(10)
+    state.run(settings)
+    assert_results_ok(state)
+    for w in state.client_workers().values():
+        assert w.done()
+        assert len(w.results) == 5
+
+
+def test_basic_run_single_threaded():
+    state = make_state(num_clients=2)
+    settings = RunSettings().max_time(10)
+    settings.set_single_threaded(True)
+    state.run(settings)
+    assert_results_ok(state)
+    for w in state.client_workers().values():
+        assert w.done()
+
+
+def test_unreliable_network_retries_recover():
+    state = make_state(num_clients=1, num_pings=3)
+    settings = RunSettings().max_time(20)
+    settings.network_deliver_rate(0.5)
+    state.run(settings)
+    assert_results_ok(state)
+    for w in state.client_workers().values():
+        assert w.done()
+
+
+def test_direct_client_blocking_get_result():
+    """Drive a bare client (no worker) through the blocking Client API."""
+    gen = NodeGenerator(
+        server_supplier=lambda a: PingServer(a),
+        client_supplier=lambda a: PingClient(a, SERVER))
+    state = RunState(gen)
+    state.add_server(SERVER)
+    client = state.add_client(LocalAddress("client1"))
+    state.start(RunSettings())
+    try:
+        client.send_command(Ping("hello"))
+        result = client.get_result(timeout=5)
+        assert result == Pong("hello")
+    finally:
+        state.stop()
+
+
+def test_max_wait_tracked():
+    state = make_state(num_clients=1, num_pings=2)
+    state.run(RunSettings().max_time(10))
+    for w in state.client_workers().values():
+        mw = w.max_wait(state.stop_time)
+        assert mw is not None
+        assert mw[0] < 1.0  # reliable local network: sub-second waits

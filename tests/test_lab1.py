@@ -1,0 +1,166 @@
+"""Lab 1 tests — behavioural port of KVStoreTest, ClientServerPart1Test
+(at-most-once server with reliable network) and ClientServerPart2Test
+(exactly-once under unreliable delivery + search tests over duplication).
+"""
+
+import pytest
+
+from dslabs_tpu.core.address import LocalAddress
+from dslabs_tpu.labs.clientserver.amo import AMOApplication, AMOCommand
+from dslabs_tpu.labs.clientserver.clientserver import SimpleClient, SimpleServer
+from dslabs_tpu.labs.clientserver.kv_workload import (
+    APPENDS_LINEARIZABLE, append_different_key_workload,
+    append_same_key_workload, kv_workload, put_get_workload, simple_workload)
+from dslabs_tpu.labs.clientserver.kvstore import (Append, AppendResult, Get,
+                                                  GetResult, KVStore,
+                                                  KeyNotFound, Put, PutOk)
+from dslabs_tpu.runner.run_settings import RunSettings
+from dslabs_tpu.runner.run_state import RunState
+from dslabs_tpu.search.results import EndCondition
+from dslabs_tpu.search.search import bfs
+from dslabs_tpu.search.search_state import SearchState
+from dslabs_tpu.search.settings import SearchSettings
+from dslabs_tpu.testing.generator import NodeGenerator
+from dslabs_tpu.testing.predicates import CLIENTS_DONE, RESULTS_OK
+
+SERVER = LocalAddress("server")
+
+
+# ------------------------------------------------------------- KVStore unit
+
+def test_kvstore_semantics():
+    kv = KVStore()
+    assert kv.execute(Get("k")) == KeyNotFound()
+    assert kv.execute(Put("k", "v")) == PutOk()
+    assert kv.execute(Get("k")) == GetResult("v")
+    assert kv.execute(Append("k", "w")) == AppendResult("vw")
+    assert kv.execute(Append("k2", "x")) == AppendResult("x")
+    assert kv.execute(Get("k2")) == GetResult("x")
+
+
+def test_kvstore_equality():
+    a, b = KVStore(), KVStore()
+    a.execute(Put("k", "v"))
+    assert a != b
+    b.execute(Put("k", "v"))
+    assert a == b and hash(a) == hash(b)
+
+
+# ----------------------------------------------------------------- AMO unit
+
+def test_amo_deduplicates():
+    c1 = LocalAddress("c1")
+    app = AMOApplication(KVStore())
+    r1 = app.execute(AMOCommand(Append("k", "a"), c1, 1))
+    assert r1.result == AppendResult("a")
+    # Duplicate: same result, NOT re-executed.
+    r2 = app.execute(AMOCommand(Append("k", "a"), c1, 1))
+    assert r2 == r1
+    assert app.application.execute(Get("k")) == GetResult("a")
+
+
+def test_amo_per_client_sequencing():
+    c1, c2 = LocalAddress("c1"), LocalAddress("c2")
+    app = AMOApplication(KVStore())
+    app.execute(AMOCommand(Append("k", "a"), c1, 1))
+    app.execute(AMOCommand(Append("k", "b"), c2, 1))  # distinct client, runs
+    assert app.application.execute(Get("k")) == GetResult("ab")
+    # Old sequence number from c1 is dropped (returns None).
+    assert app.execute(AMOCommand(Append("k", "zzz"), c1, 0)) is None
+    assert app.already_executed(AMOCommand(Append("k", "a"), c1, 1))
+
+
+# ------------------------------------------------------------- run fixtures
+
+def make_run_state(num_clients=1, workload_factory=put_get_workload):
+    gen = NodeGenerator(
+        server_supplier=lambda a: SimpleServer(a, KVStore()),
+        client_supplier=lambda a: SimpleClient(a, SERVER),
+        workload_supplier=lambda a: workload_factory())
+    state = RunState(gen)
+    state.add_server(SERVER)
+    for i in range(1, num_clients + 1):
+        state.add_client_worker(LocalAddress(f"client{i}"))
+    return state
+
+
+def assert_ok(state):
+    r = RESULTS_OK.check(state)
+    assert r.value, r.error_message()
+
+
+def test_single_client_simple_workload():
+    state = make_run_state(workload_factory=simple_workload)
+    state.run(RunSettings().max_time(10))
+    assert_ok(state)
+
+
+def test_multi_client_different_keys():
+    state = make_run_state(
+        num_clients=3,
+        workload_factory=lambda: append_different_key_workload(4))
+    state.run(RunSettings().max_time(10))
+    assert_ok(state)
+
+
+def test_unreliable_network_exactly_once():
+    state = make_run_state(
+        num_clients=2,
+        workload_factory=lambda: append_different_key_workload(3))
+    settings = RunSettings().max_time(30)
+    settings.network_deliver_rate(0.5)
+    state.run(settings)
+    assert_ok(state)
+
+
+def test_same_key_appends_linearizable():
+    state = make_run_state(
+        num_clients=3,
+        workload_factory=lambda: append_same_key_workload(3))
+    state.run(RunSettings().max_time(20))
+    r = APPENDS_LINEARIZABLE.check(state)
+    assert r.value, r.error_message()
+
+
+# ---------------------------------------------------------------- search
+
+def make_search_state(num_clients=1, workload=None):
+    gen = NodeGenerator(
+        server_supplier=lambda a: SimpleServer(a, KVStore()),
+        client_supplier=lambda a: SimpleClient(a, SERVER),
+        workload_supplier=lambda a: workload or put_get_workload())
+    state = SearchState(gen)
+    state.add_server(SERVER)
+    for i in range(1, num_clients + 1):
+        state.add_client_worker(LocalAddress(f"client{i}"))
+    return state
+
+
+def test_search_exactly_once_under_duplication():
+    """BFS over the full duplication/reordering space: results always match
+    (the AMO layer absorbs duplicate deliveries).  Port of
+    ClientServerPart2Test search tests (:175-281)."""
+    workload = kv_workload(["APPEND:k:a", "APPEND:k:b"], ["a", "ab"])
+    state = make_search_state(workload=workload)
+    settings = (SearchSettings().add_invariant(RESULTS_OK)
+                .add_goal(CLIENTS_DONE))
+    settings.max_time(30)
+    results = bfs(state, settings)
+    assert results.end_condition == EndCondition.GOAL_FOUND
+
+    # Exhaust the done-pruned subspace: no interleaving violates RESULTS_OK.
+    settings2 = (SearchSettings().add_invariant(RESULTS_OK)
+                 .add_prune(CLIENTS_DONE))
+    settings2.max_time(60)
+    results2 = bfs(make_search_state(workload=workload), settings2)
+    assert results2.end_condition == EndCondition.SPACE_EXHAUSTED
+
+
+def test_search_two_clients_linearizable_appends():
+    workload = append_same_key_workload(1)
+    state = make_search_state(num_clients=2, workload=workload)
+    settings = (SearchSettings().add_invariant(APPENDS_LINEARIZABLE)
+                .add_goal(CLIENTS_DONE))
+    settings.max_time(60)
+    results = bfs(state, settings)
+    assert results.end_condition == EndCondition.GOAL_FOUND

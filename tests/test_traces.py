@@ -1,0 +1,83 @@
+"""Saved-trace persistence + replay (reference: SerializableTrace.java,
+CheckSavedTracesTest.java) and human-readable causal reordering."""
+
+import os
+
+from dslabs_tpu.core.address import LocalAddress
+from dslabs_tpu.labs.pingpong.pingpong import Ping, PingClient, PingServer, Pong
+from dslabs_tpu.search.replay import replay_trace
+from dslabs_tpu.search.results import EndCondition
+from dslabs_tpu.search.search import bfs
+from dslabs_tpu.search.search_state import SearchState
+from dslabs_tpu.search.settings import SearchSettings
+from dslabs_tpu.search.trace import (SerializableTrace, human_readable_trace,
+                                     save_trace)
+from dslabs_tpu.testing.generator import NodeGenerator
+from dslabs_tpu.testing.predicates import NONE_DECIDED, RESULTS_OK
+from dslabs_tpu.testing.workload import Workload
+
+SERVER = LocalAddress("pingserver")
+
+
+def ping_parser(cmd, res):
+    return Ping(cmd), (Pong(res) if res is not None else None)
+
+
+def make_generator():
+    return NodeGenerator(
+        server_supplier=lambda a: PingServer(a),
+        client_supplier=lambda a: PingClient(a, SERVER),
+        workload_supplier=lambda a: Workload(
+            command_strings=["p1", "p2"], result_strings=["p1", "p2"],
+            parser=ping_parser))
+
+
+def violating_state():
+    state = SearchState(make_generator())
+    state.add_server(SERVER)
+    state.add_client_worker(LocalAddress("client1"))
+    settings = SearchSettings().add_invariant(NONE_DECIDED)
+    settings.max_time(15)
+    results = bfs(state, settings)
+    assert results.end_condition == EndCondition.INVARIANT_VIOLATED
+    return results.invariant_violating_state
+
+
+def test_save_and_replay_trace(tmp_path):
+    end = violating_state()
+    path = save_trace(end, [NONE_DECIDED], "0", None, "PingTest",
+                      "test_viol", directory=str(tmp_path))
+    assert os.path.exists(path)
+
+    loaded = SerializableTrace.load(path)
+    assert loaded is not None
+    assert len(loaded.history) == len(end.trace()) - 1
+
+    # Replaying the trace with the violated invariant re-finds the violation.
+    settings = SearchSettings().add_invariant(NONE_DECIDED)
+    results = replay_trace(loaded.initial_state(), loaded.history, settings)
+    assert results.end_condition == EndCondition.INVARIANT_VIOLATED
+
+    # Replaying with a holding invariant completes exhausted.
+    settings2 = SearchSettings().add_invariant(RESULTS_OK)
+    results2 = replay_trace(loaded.initial_state(), loaded.history, settings2)
+    assert results2.end_condition == EndCondition.SPACE_EXHAUSTED
+
+
+def test_stale_trace_skipped(tmp_path):
+    bad = tmp_path / "lab0_garbage.trace"
+    bad.write_bytes(b"not a pickle")
+    assert SerializableTrace.load(str(bad)) is None
+    assert SerializableTrace.traces(str(tmp_path)) == []
+
+
+def test_human_readable_trace_reaches_same_verdict():
+    end = violating_state()
+    hr = human_readable_trace(end)
+    assert hr[0].previous is None
+    # End state of the re-ordered trace still violates the predicate.
+    r = NONE_DECIDED.check(hr[-1])
+    assert not r.value
+    # Events are causally ordered: every message delivery happens after its
+    # send (checked implicitly by successful replay inside the reordering).
+    assert len(hr) <= len(end.trace())
