@@ -1,0 +1,247 @@
+"""Lab 2 part 2 tests — behavioural port of PrimaryBackupTest.java:75-905
+(run tests: basic ops, backup takeover, failover reads, at-most-once under
+loss, all-servers-dead liveness; search tests: single-client BFS with
+RESULTS_OK, linearizable appends)."""
+
+import time
+
+import pytest
+
+from dslabs_tpu.core.address import LocalAddress
+from dslabs_tpu.labs.clientserver.kv_workload import (
+    APPENDS_LINEARIZABLE, append_different_key_workload,
+    append_same_key_workload, kv_workload, put_get_workload, simple_workload)
+from dslabs_tpu.labs.clientserver.kvstore import KVStore
+from dslabs_tpu.labs.primarybackup.pb import PBClient, PBServer
+from dslabs_tpu.labs.primarybackup.viewserver import (PING_CHECK_MILLIS,
+                                                      ViewServer)
+from dslabs_tpu.labs.clientserver.kv_workload import get, put, get_result, put_ok
+from dslabs_tpu.runner.run_settings import RunSettings
+from dslabs_tpu.runner.run_state import RunState
+from dslabs_tpu.search.results import EndCondition
+from dslabs_tpu.search.search import bfs
+from dslabs_tpu.search.search_state import SearchState
+from dslabs_tpu.search.settings import SearchSettings
+from dslabs_tpu.testing.generator import NodeGenerator
+from dslabs_tpu.testing.predicates import ALL_RESULTS_SAME, CLIENTS_DONE, RESULTS_OK
+
+VSA = LocalAddress("viewserver")
+
+
+def server(i):
+    return LocalAddress(f"server{i}")
+
+
+def client(i):
+    return LocalAddress(f"client{i}")
+
+
+def generator(workload_factory=put_get_workload):
+    def server_supplier(a):
+        if a == VSA:
+            return ViewServer(a)
+        return PBServer(a, VSA, KVStore())
+
+    return NodeGenerator(
+        server_supplier=server_supplier,
+        client_supplier=lambda a: PBClient(a, VSA),
+        workload_supplier=lambda a: workload_factory())
+
+
+def make_run_state(workload_factory=put_get_workload):
+    state = RunState(generator(workload_factory))
+    state.add_server(VSA)
+    return state
+
+
+def assert_ok(state):
+    r = RESULTS_OK.check(state)
+    assert r.value, r.error_message()
+
+
+def settle(state, settings, secs):
+    """Run the live system for a bit so views form / heal."""
+    state.start(settings)
+    time.sleep(secs)
+    state.stop()
+
+
+# ------------------------------------------------------------------ run tests
+
+def test02_basic():
+    state = make_run_state(simple_workload)
+    state.add_server(server(1))
+    state.add_client_worker(client(1))
+    state.run(RunSettings().max_time(10))
+    assert_ok(state)
+
+
+def test04_backup_chosen_and_replicates():
+    state = make_run_state(simple_workload)
+    settings = RunSettings().max_time(15)
+    state.add_server(server(1))
+    state.add_server(server(2))
+    settle(state, settings, PING_CHECK_MILLIS * 6 / 1000)
+    state.add_client_worker(client(1))
+    state.run(settings)
+    assert_ok(state)
+
+
+def test06_backup_takes_over():
+    state = make_run_state()
+    settings = RunSettings().max_time(15)
+    state.add_server(server(1))
+    c = state.add_client(client(1))
+    state.start(settings)
+
+    c.send_command(put("foo1", "bar1"))
+    assert c.get_result(timeout=5) == put_ok()
+
+    state.add_server(server(2))
+    # Wait for the backup view to form and sync.
+    time.sleep(PING_CHECK_MILLIS * 8 / 1000)
+
+    c.send_command(put("foo2", "bar2"))
+    assert c.get_result(timeout=5) == put_ok()
+
+    state.remove_node(server(1))
+    c.send_command(get("foo1"))
+    assert c.get_result(timeout=5) == get_result("bar1")
+    c.send_command(get("foo2"))
+    assert c.get_result(timeout=5) == get_result("bar2")
+    state.stop()
+
+
+def test07_kill_all_servers():
+    state = make_run_state()
+    settings = RunSettings().max_time(15)
+    state.add_server(server(1))
+    state.add_server(server(2))
+    c = state.add_client(client(1))
+    state.start(settings)
+
+    c.send_command(put("foo", "bar"))
+    assert c.get_result(timeout=5) == put_ok()
+
+    # Kill every server holding state; a fresh server must NOT serve.
+    state.stop()
+    state.remove_node(server(1))
+    state.remove_node(server(2))
+    state.add_server(server(3))
+    state.start(settings)
+
+    c.send_command(get("foo"))
+    time.sleep(PING_CHECK_MILLIS * 4 / 1000)
+    assert not c.has_result()
+    state.stop()
+
+
+def test08_at_most_once_unreliable():
+    state = make_run_state(lambda: append_different_key_workload(10))
+    settings = RunSettings().max_time(30)
+    state.add_server(server(1))
+    state.add_server(server(2))
+    settle(state, settings, PING_CHECK_MILLIS * 6 / 1000)
+    state.add_client_worker(client(1))
+    settings.network_deliver_rate(0.8).node_unreliable(VSA, False)
+    state.run(settings)
+    assert_ok(state)
+
+
+def test11_concurrent_appends_linearizable_failover():
+    state = make_run_state(lambda: append_same_key_workload(5))
+    settings = RunSettings().max_time(30)
+    state.add_server(server(1))
+    state.add_server(server(2))
+    settle(state, settings, PING_CHECK_MILLIS * 6 / 1000)
+    for i in range(1, 4):
+        state.add_client_worker(client(i))
+    state.run(settings)
+    r = APPENDS_LINEARIZABLE.check(state)
+    assert r.value, r.error_message()
+
+    for a in list(state.client_workers()):
+        state.remove_node(a)
+    # Heal, then read from the primary and (after failover) the old backup.
+    settle(state, settings, PING_CHECK_MILLIS * 6 / 1000)
+
+    read = kv_workload(["GET:the-key"])
+    state.add_client_worker(LocalAddress("client-readprimary"), read)
+    state.run(settings)
+
+    state.remove_node(server(1))
+    settle(state, settings, PING_CHECK_MILLIS * 6 / 1000)
+    state.add_client_worker(LocalAddress("client-readbackup"), read)
+    settings.add_invariant(ALL_RESULTS_SAME)
+    state.run(settings)
+    r = ALL_RESULTS_SAME.check(state)
+    assert r.value, r.error_message()
+
+
+# --------------------------------------------------------------- search tests
+
+def make_search_state(workload):
+    state = SearchState(generator(lambda: workload))
+    state.add_server(VSA)
+    return state
+
+
+def test16_single_client_search():
+    workload = kv_workload(["PUT:foo:bar", "GET:foo"], ["PutOk", "bar"])
+    state = make_search_state(workload)
+    state.add_server(server(1))
+    state.add_client_worker(client(1))
+
+    settings = (SearchSettings().add_invariant(RESULTS_OK)
+                .add_goal(CLIENTS_DONE))
+    settings.max_time(60)
+    results = bfs(state, settings)
+    assert results.end_condition == EndCondition.GOAL_FOUND, results
+
+    # The done-pruned subspace never violates RESULTS_OK.
+    settings2 = (SearchSettings().add_invariant(RESULTS_OK)
+                 .add_prune(CLIENTS_DONE))
+    settings2.max_time(60).set_max_depth(22)
+    results2 = bfs(make_search_state(workload), settings2)
+    assert results2.end_condition in (EndCondition.SPACE_EXHAUSTED,
+                                      EndCondition.TIME_EXHAUSTED), results2
+
+
+def test18_two_client_appends_linearizable_search():
+    """Staged search in the reference's initView style
+    (PrimaryBackupTest.java:124-187): first reach the synced two-server view
+    with the clients gated off, then search client completion with the ping
+    machinery frozen (settings gate events, never mutate states — SURVEY
+    §7.7)."""
+    from dslabs_tpu.testing.predicates import StatePredicate
+
+    workload = append_same_key_workload(1)
+    state = make_search_state(workload)
+    state.add_server(server(1))
+    state.add_server(server(2))
+    state.add_client_worker(client(1))
+    state.add_client_worker(client(2))
+
+    def view2_synced(s):
+        s1, s2 = s.node(server(1)), s.node(server(2))
+        return (s1.view is not None and s1.view.view_num == 2
+                and s1.view.primary == server(1) and s1.view.backup == server(2)
+                and s1.synced and s2.view is not None
+                and s2.view.view_num == 2 and s2.synced)
+
+    stage1 = (SearchSettings()
+              .add_goal(StatePredicate("view 2 formed and synced", view2_synced)))
+    stage1.max_time(60)
+    stage1.sender_active(client(1), False).sender_active(client(2), False)
+    stage1.deliver_timers(client(1), False).deliver_timers(client(2), False)
+    results = bfs(state, stage1)
+    assert results.end_condition == EndCondition.GOAL_FOUND, results
+    synced_state = results.goal_matching_state
+
+    stage2 = (SearchSettings().add_invariant(APPENDS_LINEARIZABLE)
+              .add_goal(CLIENTS_DONE))
+    stage2.max_time(120)
+    stage2.deliver_timers(VSA, False)
+    stage2.deliver_timers(server(1), False).deliver_timers(server(2), False)
+    results = bfs(synced_state, stage2)
+    assert results.end_condition == EndCondition.GOAL_FOUND, results

@@ -1,0 +1,184 @@
+"""Lab 2 part 1 tests — behavioural port of ViewServerTest.java:40-303.
+
+Unit-style direct drive: the ViewServer node is configured with list-capturing
+hooks and fed messages/timers by hand (no engine), mirroring the reference's
+test pattern (SURVEY §4.1 "unit-style tests without any engine").
+"""
+
+import pytest
+
+from dslabs_tpu.core.address import LocalAddress
+from dslabs_tpu.core.node import NodeConfig
+from dslabs_tpu.labs.primarybackup.viewserver import (GetView, INITIAL_VIEWNUM,
+                                                      Ping, PingCheckTimer,
+                                                      STARTUP_VIEWNUM,
+                                                      ViewReply, ViewServer)
+
+VSA = LocalAddress("viewserver")
+TA = LocalAddress("testserver")
+
+
+def server(i):
+    return LocalAddress(f"server{i}")
+
+
+class ViewServerHarness:
+
+    def __init__(self):
+        self.vs = ViewServer(VSA)
+        self.messages = []
+        self.timers = []
+        self.vs.config(NodeConfig(
+            message_adder=lambda frm, to, m: self.messages.append((frm, to, m)),
+            timer_adder=lambda frm, t, mn, mx: self.timers.append((frm, t)),
+        ))
+        self.vs.init()
+
+    def timeout(self):
+        assert self.timers
+        frm, timer = self.timers.pop(0)
+        assert isinstance(timer, PingCheckTimer)
+        self.vs.deliver_timer(timer, frm)
+
+    def send_ping(self, view_num, frm):
+        self.vs.deliver_message(Ping(view_num), frm, VSA)
+
+    def get_view(self):
+        self.vs.deliver_message(GetView(), TA, VSA)
+        frm, to, m = self.messages[-1]
+        assert frm == VSA and to == TA and isinstance(m, ViewReply)
+        return m.view
+
+    def check(self, primary, backup, view_num=None):
+        v = self.get_view()
+        assert v.primary == primary, f"primary: {v.primary} != {primary}"
+        assert v.backup == backup, f"backup: {v.backup} != {backup}"
+        if view_num is not None:
+            assert v.view_num == view_num
+
+    def setup_view(self, primary, backup, ack_view=False):
+        self.send_ping(STARTUP_VIEWNUM, primary)
+        self.check(primary, None, INITIAL_VIEWNUM)
+        if backup is not None:
+            self.send_ping(INITIAL_VIEWNUM, primary)
+            self.send_ping(STARTUP_VIEWNUM, backup)
+            self.check(primary, backup, INITIAL_VIEWNUM + 1)
+        if ack_view:
+            if backup is None:
+                self.send_ping(INITIAL_VIEWNUM, primary)
+            else:
+                self.send_ping(INITIAL_VIEWNUM + 1, primary)
+
+    def timeout_fully(self, *servers_sending_pings):
+        current = self.get_view()
+        for _ in range(2):
+            for a in servers_sending_pings:
+                self.send_ping(current.view_num, a)
+            self.timeout()
+
+
+@pytest.fixture
+def h():
+    return ViewServerHarness()
+
+
+def test01_startup_view_correct(h):
+    h.check(None, None, STARTUP_VIEWNUM)
+
+
+def test02_first_primary(h):
+    h.setup_view(server(1), None)
+
+
+def test03_first_backup(h):
+    h.setup_view(server(1), server(2))
+
+
+def test04_backup_pings_first(h):
+    h.setup_view(server(1), None)
+    h.send_ping(STARTUP_VIEWNUM, server(2))
+    h.send_ping(INITIAL_VIEWNUM, server(1))
+    h.check(server(1), server(2), INITIAL_VIEWNUM + 1)
+
+
+def test05_backup_takes_over(h):
+    h.setup_view(server(1), server(2), ack_view=True)
+    h.send_ping(INITIAL_VIEWNUM + 1, server(2))
+    h.check(server(1), server(2), INITIAL_VIEWNUM + 1)
+    h.timeout()
+    h.send_ping(INITIAL_VIEWNUM + 1, server(2))
+    h.check(server(1), server(2), INITIAL_VIEWNUM + 1)
+    h.timeout()
+    h.check(server(2), None, INITIAL_VIEWNUM + 2)
+
+
+def test06_old_server_becomes_backup(h):
+    h.setup_view(server(1), server(2), ack_view=True)
+    h.timeout_fully(server(2))
+    h.check(server(2), None, INITIAL_VIEWNUM + 2)
+    h.send_ping(INITIAL_VIEWNUM + 2, server(2))
+    h.send_ping(INITIAL_VIEWNUM + 1, server(1))
+    h.check(server(2), server(1), INITIAL_VIEWNUM + 3)
+
+
+def test07_idle_third_server_becomes_backup(h):
+    h.setup_view(server(1), server(2), ack_view=True)
+    h.timeout_fully(server(2), server(3))
+    h.check(server(2), server(3), INITIAL_VIEWNUM + 2)
+
+
+def test08_wait_for_primary_ack(h):
+    h.send_ping(STARTUP_VIEWNUM, server(1))
+    h.send_ping(STARTUP_VIEWNUM, server(2))
+    h.check(server(1), None, INITIAL_VIEWNUM)
+    h.send_ping(INITIAL_VIEWNUM, server(1))
+    h.check(server(1), server(2), INITIAL_VIEWNUM + 1)
+    h.send_ping(INITIAL_VIEWNUM, server(2))
+    # Fail the primary; the unacked view must not advance.
+    h.timeout_fully(server(2))
+    h.check(server(1), server(2), INITIAL_VIEWNUM + 1)
+
+
+def test09_dead_backup_removed(h):
+    h.setup_view(server(1), server(2), ack_view=True)
+    h.timeout_fully(server(1))
+    h.check(server(1), None, INITIAL_VIEWNUM + 2)
+
+
+def test10_uninitialized_not_promoted(h):
+    h.setup_view(server(1), server(2), ack_view=True)
+    h.timeout_fully(server(2), server(3))
+    h.check(server(2), server(3), INITIAL_VIEWNUM + 2)
+    h.timeout_fully(server(3))
+    h.check(server(2), server(3), INITIAL_VIEWNUM + 2)
+
+
+def test11_dead_server_not_made_backup(h):
+    h.setup_view(server(1), None)
+    h.send_ping(STARTUP_VIEWNUM, server(2))
+    h.timeout_fully()
+    h.send_ping(INITIAL_VIEWNUM, server(1))
+    h.check(server(1), None, INITIAL_VIEWNUM)
+
+
+def test12_new_view_not_started(h):
+    h.setup_view(server(1), None)
+    h.timeout_fully(server(1))
+    h.check(server(1), None, INITIAL_VIEWNUM)
+    h.timeout_fully()
+    h.check(server(1), None, INITIAL_VIEWNUM)
+    h.send_ping(INITIAL_VIEWNUM, server(1))
+    h.timeout_fully(server(1))
+    h.check(server(1), None, INITIAL_VIEWNUM)
+    h.timeout_fully()
+    h.check(server(1), None, INITIAL_VIEWNUM)
+    h.send_ping(STARTUP_VIEWNUM, server(2))
+    h.check(server(1), server(2), INITIAL_VIEWNUM + 1)
+    h.send_ping(INITIAL_VIEWNUM + 1, server(1))
+    h.check(server(1), server(2), INITIAL_VIEWNUM + 1)
+    h.timeout_fully(server(1), server(2))
+    h.check(server(1), server(2), INITIAL_VIEWNUM + 1)
+    h.timeout_fully()
+    v = h.get_view()
+    if v.primary == server(1) and v.backup == server(2):
+        assert v.view_num == INITIAL_VIEWNUM + 1
