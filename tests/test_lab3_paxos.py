@@ -1,0 +1,284 @@
+"""Lab 3 tests — behavioural port of PaxosTest.java:67-1160.
+
+Run tests: basic ops + log interface, progress in majority, no progress in
+minority, heal, concurrent appends, message budget, garbage collection.
+Search tests: staged BFS with LOGS_CONSISTENT invariants (test20/test21
+style) and randomized DFS probes (test25 style).
+"""
+
+import time
+
+import pytest
+
+from dslabs_tpu.core.address import LocalAddress
+from dslabs_tpu.labs.clientserver.kv_workload import (
+    APPENDS_LINEARIZABLE, append_same_key_workload,
+    append_different_key_workload, get, get_result, kv_workload, put,
+    put_get_workload, put_ok, simple_workload)
+from dslabs_tpu.labs.clientserver.kvstore import KVStore
+from dslabs_tpu.labs.paxos.paxos import (PaxosClient, PaxosLogSlotStatus,
+                                         PaxosServer)
+from dslabs_tpu.labs.paxos.predicates import (LOGS_CONSISTENT,
+                                              LOGS_CONSISTENT_ALL_SLOTS,
+                                              slot_valid)
+from dslabs_tpu.runner.run_settings import RunSettings
+from dslabs_tpu.runner.run_state import RunState
+from dslabs_tpu.search.results import EndCondition
+from dslabs_tpu.search.search import bfs, dfs
+from dslabs_tpu.search.search_state import SearchState
+from dslabs_tpu.search.settings import SearchSettings
+from dslabs_tpu.testing.generator import NodeGenerator
+from dslabs_tpu.testing.predicates import (CLIENTS_DONE, NONE_DECIDED,
+                                           RESULTS_OK)
+
+
+def server(i):
+    return LocalAddress(f"server{i}")
+
+
+def client(i):
+    return LocalAddress(f"client{i}")
+
+
+def servers(n):
+    return tuple(server(i) for i in range(1, n + 1))
+
+
+def generator(n, workload_factory=put_get_workload):
+    addrs = servers(n)
+    return NodeGenerator(
+        server_supplier=lambda a: PaxosServer(a, addrs, KVStore()),
+        client_supplier=lambda a: PaxosClient(a, addrs),
+        workload_supplier=lambda a: workload_factory())
+
+
+def make_run_state(n, workload_factory=put_get_workload):
+    state = RunState(generator(n, workload_factory))
+    for a in servers(n):
+        state.add_server(a)
+    return state
+
+
+def make_search_state(n, workload_factory=put_get_workload):
+    state = SearchState(generator(n, workload_factory))
+    for a in servers(n):
+        state.add_server(a)
+    return state
+
+
+def assert_ok(state):
+    r = RESULTS_OK.check(state)
+    assert r.value, r.error_message()
+
+
+def assert_logs_consistent(state, all_slots=True):
+    p = LOGS_CONSISTENT_ALL_SLOTS if all_slots else LOGS_CONSISTENT
+    r = p.check(state)
+    assert r.value, r.error_message()
+
+
+# ------------------------------------------------------------------ run tests
+
+def test02_basic():
+    state = make_run_state(3, simple_workload)
+    state.add_client_worker(client(1))
+
+    for p in state.servers.values():
+        assert p.first_non_cleared() == 1
+        assert p.last_non_empty() == 0
+
+    state.run(RunSettings().max_time(10))
+    assert_ok(state)
+    assert_logs_consistent(state)
+
+    size = 7  # simple_workload length
+    num_full = sum(1 for p in state.servers.values()
+                   if p.last_non_empty() >= size)
+    assert 2 * num_full > len(state.servers)
+    for i in range(1, size + 1):
+        assert any(p.status(i) in (PaxosLogSlotStatus.CHOSEN,
+                                   PaxosLogSlotStatus.CLEARED)
+                   for p in state.servers.values()), f"slot {i} undecided"
+
+
+def test04_progress_in_majority():
+    state = make_run_state(5)
+    c = state.add_client(client(1))
+    settings = RunSettings().max_time(10)
+    settings.partition(server(1), server(2), server(3), client(1))
+    state.start(settings)
+    c.send_command(put("foo", "bar"))
+    assert c.get_result(timeout=5) == put_ok()
+    state.stop()
+
+
+def test05_no_progress_in_minority():
+    state = make_run_state(5)
+    c = state.add_client(client(1))
+    settings = RunSettings().max_time(10)
+    settings.partition(server(1), server(2), client(1))
+    state.start(settings)
+    c.send_command(put("foo", "bar"))
+    time.sleep(2)
+    assert not c.has_result()
+    assert NONE_DECIDED.check(state).value
+    state.stop()
+
+
+def test06_progress_after_heal():
+    state = make_run_state(5)
+    c1 = state.add_client(client(1))
+    c2 = state.add_client(client(2))
+    settings = RunSettings().max_time(15)
+    settings.partition(server(1), server(2), client(1))
+    state.start(settings)
+    c1.send_command(put("foo", "bar"))
+    time.sleep(1)
+    assert not c1.has_result()
+    settings.reset_network()
+    assert c1.get_result(timeout=10) == put_ok()
+    c2.send_command(get("foo"))
+    assert c2.get_result(timeout=5) == get_result("bar")
+    state.stop()
+
+
+def test09_concurrent_appends():
+    n_clients, n_rounds = 5, 3
+    state = make_run_state(3, lambda: append_same_key_workload(n_rounds))
+    for i in range(1, n_clients + 1):
+        state.add_client_worker(client(i))
+    state.run(RunSettings().max_time(20))
+    assert all(w.done() for w in state.client_workers().values())
+    r = APPENDS_LINEARIZABLE.check(state)
+    assert r.value, r.error_message()
+    assert_logs_consistent(state)
+
+
+def test10_message_count():
+    n_rounds, n_servers = 100, 5
+    state = make_run_state(n_servers, lambda: append_same_key_workload(n_rounds))
+    state.add_client_worker(client(1))
+    state.run(RunSettings().max_time(30))
+    assert_ok(state)
+    total = sum(state.network.num_messages_received(a)
+                for a in state.servers)
+    per_agreement = total / n_rounds
+    allowed = 15 * n_servers
+    assert per_agreement <= allowed, \
+        f"Too many messages: {per_agreement:.1f}/agreement (allowed {allowed})"
+
+
+def test11_clears_memory():
+    """Scaled-down port of test11ClearsMemory: bulk values are garbage
+    collected once the partitioned server heals and catches up."""
+    value_size, items = 50_000, 10
+    state = make_run_state(3)
+    c = state.add_client(client(1))
+    settings = RunSettings().max_time(60)
+    settings.partition(server(2), server(3), client(1))
+    state.start(settings)
+
+    for key in range(items):
+        c.send_command(put(key, "x" * value_size))
+        assert c.get_result(timeout=5) == put_ok()
+
+    def log_entries(p):
+        return p.last_non_empty() - p.first_non_cleared() + 1
+
+    # Partitioned: server(1) can't execute, so nothing may be GC'd.
+    assert any(log_entries(p) >= items for p in state.servers.values())
+
+    # Heal; overwrite with small values; wait for catchup + GC.
+    settings.reset_network()
+    for key in range(items):
+        c.send_command(put(key, "foo"))
+        assert c.get_result(timeout=5) == put_ok()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if all(log_entries(p) <= 3 for p in state.servers.values()):
+            break
+        time.sleep(0.2)
+    state.stop()
+    for a, p in state.servers.items():
+        assert log_entries(p) <= 3, \
+            f"{a} retains {log_entries(p)} log entries after GC"
+        assert p.first_non_cleared() > items
+    assert_logs_consistent(state, all_slots=False)
+
+
+def test12_basic_unreliable():
+    state = make_run_state(3, lambda: append_different_key_workload(5))
+    state.add_client_worker(client(1))
+    settings = RunSettings().max_time(30)
+    settings.network_deliver_rate(0.8)
+    state.run(settings)
+    assert_ok(state)
+    assert_logs_consistent(state)
+
+
+# --------------------------------------------------------------- search tests
+
+def test20_basic_search():
+    state = make_search_state(3)
+    state.add_client_worker(client(1), kv_workload(["PUT:foo:bar", "GET:foo"],
+                                                   ["PutOk", "bar"]))
+
+    settings = SearchSettings()
+    settings.max_time(60)
+    settings.partition(server(1), server(2), client(1))
+    settings.add_invariant(RESULTS_OK).add_invariant(LOGS_CONSISTENT_ALL_SLOTS)
+    settings.add_goal(NONE_DECIDED.negate())
+    results = bfs(state, settings)
+    assert results.end_condition == EndCondition.GOAL_FOUND, results
+    one_executed = results.goal_matching_state
+
+    settings2 = SearchSettings()
+    settings2.max_time(60)
+    settings2.add_invariant(RESULTS_OK).add_invariant(LOGS_CONSISTENT_ALL_SLOTS)
+    settings2.add_goal(CLIENTS_DONE)
+    results2 = bfs(one_executed, settings2)
+    assert results2.end_condition == EndCondition.GOAL_FOUND, results2
+
+    # Linearizability within the partitioned subspace, timers frozen
+    # (reference narrows the same way, PaxosTest.java:924-930).
+    settings3 = SearchSettings()
+    settings3.max_time(30).set_max_depth(one_executed.depth + 6)
+    settings3.partition(server(1), server(2), client(1))
+    settings3.deliver_timers(False)
+    settings3.add_invariant(RESULTS_OK).add_invariant(LOGS_CONSISTENT_ALL_SLOTS)
+    settings3.add_prune(CLIENTS_DONE)
+    results3 = bfs(one_executed, settings3)
+    assert results3.end_condition in (EndCondition.SPACE_EXHAUSTED,
+                                      EndCondition.TIME_EXHAUSTED), results3
+
+
+def test21_no_progress_in_minority_search():
+    state = make_search_state(5, lambda: kv_workload(["PUT:foo:bar"]))
+    state.add_client_worker(client(1))
+
+    settings = SearchSettings()
+    settings.max_time(20)
+    settings.add_invariant(NONE_DECIDED).add_invariant(LOGS_CONSISTENT_ALL_SLOTS)
+    settings.partition(server(1), server(2), client(1))
+    settings.set_max_depth(12)
+    results = bfs(state, settings)
+    assert results.end_condition in (EndCondition.SPACE_EXHAUSTED,
+                                     EndCondition.TIME_EXHAUSTED), results
+
+    settings.deliver_timers(False)
+    results = bfs(state, settings)
+    assert results.end_condition in (EndCondition.SPACE_EXHAUSTED,
+                                     EndCondition.TIME_EXHAUSTED), results
+
+
+def test25_random_search():
+    state = make_search_state(3, lambda: kv_workload(["APPEND:foo:x"]))
+    state.add_client_worker(client(1))
+    state.add_client_worker(client(2))
+
+    settings = SearchSettings()
+    settings.set_max_depth(1000).max_time(8)
+    settings.add_invariant(APPENDS_LINEARIZABLE).add_invariant(LOGS_CONSISTENT)
+    settings.add_prune(CLIENTS_DONE)
+    results = dfs(state, settings)
+    assert results.end_condition == EndCondition.TIME_EXHAUSTED, results
