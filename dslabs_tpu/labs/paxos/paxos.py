@@ -41,12 +41,11 @@ from dslabs_tpu.core.types import (Application, Client, Command, Message,
 from dslabs_tpu.labs.clientserver.amo import AMOApplication, AMOCommand, AMOResult
 
 __all__ = ["PaxosServer", "PaxosClient", "PaxosRequest", "PaxosReply",
-           "PaxosLogSlotStatus", "Ballot", "ELECTION_MILLIS",
+           "PaxosLogSlotStatus", "Ballot",
            "HEARTBEAT_MILLIS", "CLIENT_RETRY_MILLIS"]
 
 ELECTION_MILLIS_MIN = 150
 ELECTION_MILLIS_MAX = 300
-ELECTION_MILLIS = ELECTION_MILLIS_MIN
 HEARTBEAT_MILLIS = 50
 CLIENT_RETRY_MILLIS = 100
 
@@ -82,7 +81,6 @@ class P1b(Message):
     ballot: Ballot
     # slot -> (accepted ballot, command-or-None, chosen flag)
     log: Tuple[Tuple[int, Tuple[Ballot, Optional[AMOCommand], bool]], ...]
-    first_unchosen: int
 
 
 @dataclass(frozen=True)
@@ -129,7 +127,7 @@ class ElectionTimer(Timer):
 
 @dataclass(frozen=True)
 class HeartbeatTimer(Timer):
-    pass
+    ballot: Ballot
 
 
 @dataclass(frozen=True)
@@ -219,8 +217,17 @@ class PaxosServer(Node):
 
     # ------------------------------------------------------------- elections
 
-    def _my_ballot(self) -> Ballot:
-        return (self.ballot[0], self.index)
+    def _send_to_all(self, msg: Message) -> None:
+        """Broadcast to peers and deliver to ourselves synchronously (our
+        own vote/acceptance never rides the network)."""
+        self.broadcast(msg, [s for s in self.servers if s != self.address])
+        self.deliver_message(msg, self.address)
+
+    def _reply(self, msg: Message, to: Address) -> None:
+        if to == self.address:
+            self.deliver_message(msg, self.address)
+        else:
+            self.send(msg, to)
 
     def _is_leader_ballot(self) -> bool:
         return self.leader and self.ballot[1] == self.index
@@ -229,9 +236,7 @@ class PaxosServer(Node):
         self.ballot = (self.ballot[0] + 1, self.index)
         self.leader = False
         self.p1b_votes = {}
-        msg = P1a(self.ballot)
-        self.broadcast(msg, [s for s in self.servers if s != self.address])
-        self.deliver_message(msg, self.address)  # vote for ourselves
+        self._send_to_all(P1a(self.ballot))
 
     def on_ElectionTimer(self, t: ElectionTimer) -> None:
         if not self._is_leader_ballot() and not self.heard_from_leader:
@@ -247,11 +252,7 @@ class PaxosServer(Node):
             # Promise: report our accepted entries above the GC frontier.
             entries = tuple(sorted(
                 (s, (e.ballot, e.command, e.chosen)) for s, e in self.log.items()))
-            reply = P1b(self.ballot, entries, self.executed_through + 1)
-            if sender == self.address:
-                self.deliver_message(reply, self.address)
-            else:
-                self.send(reply, sender)
+            self._reply(P1b(self.ballot, entries), sender)
 
     def handle_P1b(self, m: P1b, sender: Address) -> None:
         if m.ballot != self.ballot or self.ballot[1] != self.index or self.leader:
@@ -293,16 +294,14 @@ class PaxosServer(Node):
                 self.proposed_seq[c.client_address] = max(
                     self.proposed_seq.get(c.client_address, -1), c.sequence_num)
         self._execute_chosen()
-        self.set_timer(HeartbeatTimer(), HEARTBEAT_MILLIS)
+        self.set_timer(HeartbeatTimer(self.ballot), HEARTBEAT_MILLIS)
         self._send_heartbeats()
 
     # ----------------------------------------------------------- replication
 
     def _send_p2a(self, slot: int) -> None:
         e = self.log[slot]
-        msg = P2a(self.ballot, slot, e.command)
-        self.broadcast(msg, [s for s in self.servers if s != self.address])
-        self.deliver_message(msg, self.address)
+        self._send_to_all(P2a(self.ballot, slot, e.command))
 
     def handle_PaxosRequest(self, m: PaxosRequest, sender: Address) -> None:
         c = m.command
@@ -330,11 +329,7 @@ class PaxosServer(Node):
             e = self.log.get(m.slot)
             if m.slot > self.cleared_through and (e is None or not e.chosen):
                 self.log[m.slot] = _LogEntry(m.ballot, m.command, False)
-            reply = P2b(m.ballot, m.slot)
-            if sender == self.address:
-                self.deliver_message(reply, self.address)
-            else:
-                self.send(reply, sender)
+            self._reply(P2b(m.ballot, m.slot), sender)
 
     def handle_P2b(self, m: P2b, sender: Address) -> None:
         if m.ballot != self.ballot or not self._is_leader_ballot():
@@ -376,10 +371,17 @@ class PaxosServer(Node):
         self.broadcast(hb, [s for s in self.servers if s != self.address])
 
     def on_HeartbeatTimer(self, t: HeartbeatTimer) -> None:
-        if not self._is_leader_ballot():
-            return  # deposed: stop heartbeating
+        if t.ballot != self.ballot or not self._is_leader_ballot():
+            return  # stale chain or deposed: stop heartbeating
         self._send_heartbeats()
-        self.set_timer(HeartbeatTimer(), HEARTBEAT_MILLIS)
+        # Retransmit P2as for in-flight slots (a lost P2a/P2b would otherwise
+        # stall the slot forever: client retries are absorbed by proposed_seq
+        # and heartbeats suppress elections).
+        for slot in range(self.executed_through + 1, self.slot_in):
+            e = self.log.get(slot)
+            if e is not None and not e.chosen:
+                self._send_p2a(slot)
+        self.set_timer(HeartbeatTimer(self.ballot), HEARTBEAT_MILLIS)
 
     def handle_Heartbeat(self, m: Heartbeat, sender: Address) -> None:
         if m.ballot < self.ballot:
@@ -421,7 +423,9 @@ class PaxosServer(Node):
     def handle_CatchupRequest(self, m: CatchupRequest, sender: Address) -> None:
         entries = []
         slot = max(m.from_slot, self.cleared_through + 1)
-        while slot <= self.executed_through:
+        # Cap the reply so repeated requests from a lagging follower don't
+        # flood the network with full-backlog copies.
+        while slot <= self.executed_through and len(entries) < 100:
             e = self.log.get(slot)
             if e is None or not e.chosen:
                 break
