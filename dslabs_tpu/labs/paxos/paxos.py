@@ -41,6 +41,7 @@ from dslabs_tpu.core.types import (Application, Client, Command, Message,
 from dslabs_tpu.labs.clientserver.amo import AMOApplication, AMOCommand, AMOResult
 
 __all__ = ["PaxosServer", "PaxosClient", "PaxosRequest", "PaxosReply",
+           "PaxosDecision",
            "PaxosLogSlotStatus", "Ballot",
            "HEARTBEAT_MILLIS", "CLIENT_RETRY_MILLIS"]
 
@@ -63,7 +64,16 @@ Ballot = Tuple[int, int]
 
 @dataclass(frozen=True)
 class PaxosRequest(Message):
-    command: AMOCommand
+    command: Command  # AMOCommand from clients; raw commands in relay mode
+
+
+@dataclass(frozen=True)
+class PaxosDecision(Message):
+    """Relay-mode output: delivered locally to the parent node for each
+    chosen slot, in slot order (the sub-node replication pattern of lab 4,
+    ShardStoreServer.java — Paxos as a group-replicated log)."""
+    slot: int
+    command: Optional[Command]
 
 
 @dataclass(frozen=True)
@@ -80,14 +90,14 @@ class P1a(Message):
 class P1b(Message):
     ballot: Ballot
     # slot -> (accepted ballot, command-or-None, chosen flag)
-    log: Tuple[Tuple[int, Tuple[Ballot, Optional[AMOCommand], bool]], ...]
+    log: Tuple[Tuple[int, Tuple[Ballot, Optional[Command], bool]], ...]
 
 
 @dataclass(frozen=True)
 class P2a(Message):
     ballot: Ballot
     slot: int
-    command: Optional[AMOCommand]  # None = no-op hole filler
+    command: Optional[Command]  # None = no-op hole filler
 
 
 @dataclass(frozen=True)
@@ -117,7 +127,7 @@ class CatchupRequest(Message):
 @dataclass(frozen=True)
 class CatchupReply(Message):
     # slot -> command for chosen slots
-    entries: Tuple[Tuple[int, Optional[AMOCommand]], ...]
+    entries: Tuple[Tuple[int, Optional[Command]], ...]
 
 
 @dataclass(frozen=True)
@@ -140,7 +150,7 @@ class _LogEntry:
 
     __slots__ = ("ballot", "command", "chosen")
 
-    def __init__(self, ballot: Ballot, command: Optional[AMOCommand],
+    def __init__(self, ballot: Ballot, command: Optional[Command],
                  chosen: bool = False):
         self.ballot = ballot
         self.command = command
@@ -161,12 +171,17 @@ class _LogEntry:
 class PaxosServer(Node):
 
     def __init__(self, address: Address, servers: Tuple[Address, ...],
-                 app: Application):
+                 app: Optional[Application]):
+        """With an application, executes chosen commands against it and
+        replies to clients (lab 3).  With ``app=None`` (relay mode), instead
+        delivers each chosen command to the parent node as a local
+        ``PaxosDecision`` — Paxos as a replicated log for sub-node
+        composition (lab 4)."""
         super().__init__(address)
         self.servers = tuple(servers)
         self.index = self.servers.index(address)
         self.majority = len(self.servers) // 2 + 1
-        self.app = AMOApplication(app)
+        self.app = AMOApplication(app) if app is not None else None
 
         self.log: Dict[int, _LogEntry] = {}
         self.ballot: Ballot = (0, 0)          # highest ballot seen/promised
@@ -207,7 +222,9 @@ class PaxosServer(Node):
         e = self.log.get(slot)
         if e is None or e.command is None:
             return None
-        return e.command.command  # unwrap the AMOCommand
+        if isinstance(e.command, AMOCommand):
+            return e.command.command  # unwrap
+        return e.command  # relay mode carries raw commands
 
     def first_non_cleared(self) -> int:
         return self.cleared_through + 1
@@ -231,6 +248,9 @@ class PaxosServer(Node):
 
     def _is_leader_ballot(self) -> bool:
         return self.leader and self.ballot[1] == self.index
+
+    def is_leader(self) -> bool:
+        return self._is_leader_ballot()
 
     def _start_election(self) -> None:
         self.ballot = (self.ballot[0] + 1, self.index)
@@ -289,7 +309,7 @@ class PaxosServer(Node):
                 self._send_p2a(slot)
         self.slot_in = top + 1
         for slot, e in self.log.items():
-            if e.command is not None:
+            if isinstance(e.command, AMOCommand):
                 c = e.command
                 self.proposed_seq[c.client_address] = max(
                     self.proposed_seq.get(c.client_address, -1), c.sequence_num)
@@ -305,16 +325,35 @@ class PaxosServer(Node):
 
     def handle_PaxosRequest(self, m: PaxosRequest, sender: Address) -> None:
         c = m.command
-        if self.app.already_executed(c):
+        if self.app is not None and self.app.already_executed(c):
             result = self.app.execute(c)
             if result is not None:
-                self.send(PaxosReply(result), sender)
+                # Reply to the originating client, not the sender: the
+                # request may have been forwarded by a peer server.
+                self.send(PaxosReply(result), c.client_address)
             return
         if not self._is_leader_ballot():
+            # Forward externally-originated (client / parent-injected)
+            # requests to the believed leader once; never re-forward a
+            # peer's forward (a stale view could bounce a request around
+            # forever in run mode).  A parent-injected request arrives with
+            # sender == our own address.
+            believed = self.servers[self.ballot[1]]
+            if ((sender == self.address or sender not in self.servers)
+                    and believed != self.address):
+                self.send(m, believed)
             return
-        if self.proposed_seq.get(c.client_address, -1) >= c.sequence_num:
-            return  # already in flight; client retries are absorbed
-        self.proposed_seq[c.client_address] = c.sequence_num
+        if self.app is not None and isinstance(c, AMOCommand):
+            if self.proposed_seq.get(c.client_address, -1) >= c.sequence_num:
+                return  # already in flight; client retries are absorbed
+            self.proposed_seq[c.client_address] = c.sequence_num
+        elif any(e.command == c and not e.chosen for e in self.log.values()):
+            # Relay mode: dedup only against in-flight (unchosen) entries.
+            # A decided command the parent executor chose to skip (e.g. a
+            # client op logged before the group adopted its first config)
+            # must stay re-proposable; the parent's AMO layer absorbs
+            # duplicate executions.
+            return
         slot = self.slot_in
         self.slot_in += 1
         self.log[slot] = _LogEntry(self.ballot, c, False)
@@ -356,7 +395,11 @@ class PaxosServer(Node):
             if e is None or not e.chosen:
                 break
             self.executed_through += 1
-            if e.command is not None:
+            if self.app is None:
+                if self._parent is not None:
+                    self._parent.handle_message_local(
+                        PaxosDecision(self.executed_through, e.command))
+            elif e.command is not None:
                 result = self.app.execute(e.command)
                 if result is not None:
                     self.send(PaxosReply(result), e.command.client_address)

@@ -1,0 +1,189 @@
+"""Lab 4 part 1 tests — behavioural port of ShardMasterTest.java:43-372
+(pure-Application unit tests, including the determinism check test08)."""
+
+import pytest
+
+from dslabs_tpu.core.address import LocalAddress
+from dslabs_tpu.labs.shardedstore.shardmaster import (Error, Join, Leave,
+                                                      Move, Ok, Query,
+                                                      ShardConfig, ShardMaster,
+                                                      INITIAL_CONFIG_NUM)
+from dslabs_tpu.utils.structural import clone
+
+NUM_SHARDS = 10
+
+
+def group(i):
+    return frozenset(LocalAddress(f"server{j}") for j in range(3 * i - 2, 3 * i + 1))
+
+
+def full_range(n=NUM_SHARDS):
+    return set(range(1, n + 1))
+
+
+class Harness:
+
+    def __init__(self, num_shards=NUM_SHARDS):
+        self.sm = ShardMaster(num_shards)
+        self.max_seen = -1
+        self.seen = {}
+
+    def execute(self, command):
+        return clone(self.sm.execute(command))
+
+    def get_config(self, config_num=-1, check_is_next=False):
+        result = self.execute(Query(config_num))
+        assert result == self.execute(Query(config_num))
+        assert isinstance(result, ShardConfig)
+        if config_num >= INITIAL_CONFIG_NUM:
+            assert config_num >= result.config_num
+        if result.config_num in self.seen:
+            assert not check_is_next, "Got an old configuration"
+            assert self.seen[result.config_num] == result
+        else:
+            if check_is_next:
+                assert result.config_num == self.max_seen + 1
+            self.seen[result.config_num] = result
+        self.max_seen = max(self.max_seen, result.config_num)
+        return result
+
+    def check_config(self, config, group_ids, num_moved=0, num_shards=NUM_SHARDS):
+        sizes = [len(shards) for _, (_, shards) in config.group_info]
+        assert max(sizes) - min(sizes) <= 1 + 2 * num_moved
+        assert set(config.groups().keys()) == set(group_ids)
+        for gid in group_ids:
+            assert config.groups()[gid][0] == group(gid)
+        seen = set()
+        for gid, (_, shards) in config.group_info:
+            assert not (seen & shards)
+            seen |= shards
+        assert seen == full_range(num_shards)
+
+    def check_movement(self, previous, current, num_shards=NUM_SHARDS):
+        assert current.config_num == previous.config_num + 1
+        p_groups, c_groups = previous.groups(), current.groups()
+        num_moved = sum(
+            len(p_groups[g][1] - (c_groups[g][1] if g in c_groups else frozenset()))
+            for g in p_groups)
+        assert abs(len(p_groups) - len(c_groups)) <= 1
+        if len(p_groups) < len(c_groups):
+            new_g = next(g for g in c_groups if g not in p_groups)
+            assert len(c_groups[new_g][1]) == num_moved
+            assert num_moved == num_shards // len(c_groups)
+        elif len(c_groups) < len(p_groups):
+            removed = next(g for g in p_groups if g not in c_groups)
+            assert len(p_groups[removed][1]) == num_moved
+        else:
+            assert num_moved == 1
+
+
+@pytest.fixture
+def h():
+    return Harness()
+
+
+def test01_commands_return_ok(h):
+    assert h.execute(Join(1, group(1))) == Ok()
+    assert h.execute(Join(2, group(2))) == Ok()
+    config = h.get_config()
+    shard = next(iter(config.groups()[1][1]))
+    assert h.execute(Move(2, shard)) == Ok()
+    assert h.execute(Leave(2)) == Ok()
+
+
+def test02_initial_query_returns_no_config(h):
+    assert h.execute(Query(-1)) == Error()
+
+
+def test03_commands_return_error(h):
+    h.execute(Join(1, group(1)))
+    assert h.execute(Join(1, group(1))) == Error()
+    assert h.execute(Leave(2)) == Error()
+    h.execute(Join(2, group(2)))
+    config = h.get_config()
+    shard = next(iter(config.groups()[1][1]))
+    assert h.execute(Move(1, shard)) == Error()
+    assert h.execute(Move(3, shard)) == Error()
+    assert h.execute(Move(2, 0)) == Error()
+    assert h.execute(Move(2, NUM_SHARDS + 1)) == Error()
+
+
+def test04_initial_config_correct(h):
+    h.execute(Join(1, group(1)))
+    received = h.get_config(check_is_next=True)
+    assert received == ShardConfig(
+        INITIAL_CONFIG_NUM, {1: (group(1), frozenset(full_range()))})
+
+
+def test05_basic_join_leave(h):
+    h.execute(Join(1, group(1)))
+    previous = h.get_config(check_is_next=True)
+    h.check_config(previous, [1])
+
+    for gid in (2, 3):
+        h.execute(Join(gid, group(gid)))
+        nxt = h.get_config(check_is_next=True)
+        h.check_config(nxt, list(range(1, gid + 1)))
+        h.check_movement(previous, nxt)
+        previous = nxt
+
+    for gid in (3, 2):
+        h.execute(Leave(gid))
+        nxt = h.get_config(check_is_next=True)
+        h.check_config(nxt, list(range(1, gid)))
+        h.check_movement(previous, nxt)
+        previous = nxt
+
+
+def test06_historical_queries(h):
+    test05_basic_join_leave(h)
+    for i in range(5):
+        h.get_config(INITIAL_CONFIG_NUM + i)
+
+
+def test07_move_shards(h):
+    h.execute(Join(1, group(1)))
+    h.execute(Join(2, group(2)))
+    config = h.get_config()
+    group_one = set(config.groups()[1][1])
+    assert len(group_one) == 5
+
+    remaining = set(group_one)
+    for shard in group_one:
+        h.execute(Move(2, shard))
+        remaining.discard(shard)
+        config = h.get_config(check_is_next=True)
+        h.check_config(config, [1, 2],
+                       num_moved=len(group_one) - len(remaining))
+        assert set(config.groups()[1][1]) == remaining
+
+    h.execute(Join(3, group(3)))
+    config = h.get_config(check_is_next=True)
+    h.check_config(config, [1, 2, 3])
+
+
+def test08_determinism():
+    reference = None
+    for _ in range(10):
+        h = Harness(num_shards=100)
+        h.execute(Join(1, group(1)))
+        h.check_config(h.get_config(), [1], num_shards=100)
+        h.execute(Join(2, group(2)))
+        h.check_config(h.get_config(), [1, 2], num_shards=100)
+        h.execute(Join(3, group(3)))
+        h.check_config(h.get_config(), [1, 2, 3], num_shards=100)
+        h.execute(Leave(3))
+        config = h.get_config()
+        h.check_config(config, [1, 2], num_shards=100)
+        group_one = sorted(config.groups()[1][1])
+        assert len(group_one) == 50
+        for j in range(10):
+            h.execute(Move(2, group_one[j]))
+            config = h.get_config()
+            h.check_config(config, [1, 2], num_moved=j + 1, num_shards=100)
+        h.execute(Join(3, group(3)))
+        final = h.get_config()
+        if reference is None:
+            reference = final
+        else:
+            assert final == reference  # the application is deterministic

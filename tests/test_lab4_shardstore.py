@@ -1,0 +1,259 @@
+"""Lab 4 tests — behavioural port of the TransactionalKVStore unit semantics
+and ShardStorePart1Test run tests (basic ops, join/leave handoff, shard
+movement, wrong-group routing)."""
+
+import time
+
+import pytest
+
+from dslabs_tpu.core.address import LocalAddress
+from dslabs_tpu.labs.clientserver.kv_workload import get, get_result, put, put_ok
+from dslabs_tpu.labs.clientserver.kvstore import KeyNotFound
+from dslabs_tpu.labs.paxos.paxos import PaxosClient, PaxosServer
+from dslabs_tpu.labs.shardedstore.shardmaster import (Join, Leave, Move, Ok,
+                                                      Query, ShardConfig,
+                                                      ShardMaster)
+from dslabs_tpu.labs.shardedstore.shardstore import (ShardStoreClient,
+                                                     ShardStoreServer,
+                                                     key_to_shard)
+from dslabs_tpu.labs.shardedstore.txkvstore import (MultiGet, MultiGetResult,
+                                                    MultiPut, MultiPutOk,
+                                                    Swap, SwapOk,
+                                                    TransactionalKVStore,
+                                                    KEY_NOT_FOUND)
+from dslabs_tpu.runner.run_settings import RunSettings
+from dslabs_tpu.runner.run_state import RunState
+from dslabs_tpu.testing.generator import NodeGenerator
+
+CCA = LocalAddress("configController")
+NUM_SHARDS = 10
+
+
+def shard_master(i):
+    return LocalAddress(f"shardmaster{i}")
+
+
+def server(g, i):
+    return LocalAddress(f"server{g}-{i}")
+
+
+def group(g, n=3):
+    return frozenset(server(g, i) for i in range(1, n + 1))
+
+
+# --------------------------------------------------------- unit: txkvstore
+
+def test_txkvstore_semantics():
+    kv = TransactionalKVStore()
+    assert kv.execute(MultiPut({"a": "1", "b": "2"})) == MultiPutOk()
+    r = kv.execute(MultiGet({"a", "b", "c"}))
+    assert r == MultiGetResult({"a": "1", "b": "2", "c": KEY_NOT_FOUND})
+    assert kv.execute(Swap("a", "b")) == SwapOk()
+    assert kv.execute(MultiGet({"a", "b"})) == MultiGetResult(
+        {"a": "2", "b": "1"})
+    # Swap with a missing key moves the value and deletes the other side.
+    assert kv.execute(Swap("a", "missing")) == SwapOk()
+    assert kv.execute(MultiGet({"a", "missing"})) == MultiGetResult(
+        {"a": KEY_NOT_FOUND, "missing": "2"})
+    # Plain KVStore ops still work.
+    assert kv.execute(put("x", "y")) == put_ok()
+    assert kv.execute(get("x")) == get_result("y")
+
+
+def test_key_to_shard():
+    assert key_to_shard("key-3", 10) == 3
+    assert key_to_shard("key-10", 10) == 10  # 10 mod 10 = 0 -> +10
+    assert key_to_shard("key-13", 10) == 3
+    s = key_to_shard("foo", 10)
+    assert 1 <= s <= 10
+    assert key_to_shard("foo", 10) == s  # deterministic
+
+
+# ------------------------------------------------------------- run fixtures
+
+def make_state(num_groups, servers_per_group=3, num_shard_masters=3,
+               num_shards=NUM_SHARDS):
+    masters = tuple(shard_master(i) for i in range(1, num_shard_masters + 1))
+
+    def server_supplier(a):
+        if a in masters:
+            return PaxosServer(a, masters, ShardMaster(num_shards))
+        name = str(a)
+        g = int(name.split("server")[1].split("-")[0])
+        grp = tuple(server(g, i) for i in range(1, servers_per_group + 1))
+        return ShardStoreServer(a, masters, num_shards, grp, g)
+
+    def client_supplier(a):
+        if a == CCA:
+            return PaxosClient(a, masters)
+        return ShardStoreClient(a, masters, num_shards)
+
+    gen = NodeGenerator(server_supplier=server_supplier,
+                        client_supplier=client_supplier,
+                        workload_supplier=lambda a: None)
+    state = RunState(gen)
+    for m in masters:
+        state.add_server(m)
+    for g in range(1, num_groups + 1):
+        for i in range(1, servers_per_group + 1):
+            state.add_server(server(g, i))
+    return state
+
+
+def send_check(client, command, expected, timeout=8):
+    client.send_command(command)
+    result = client.get_result(timeout=timeout)
+    assert result == expected, f"{command} -> {result} (expected {expected})"
+
+
+def test_basic_single_group():
+    state = make_state(1)
+    settings = RunSettings().max_time(30)
+    state.start(settings)
+    cc = state.add_client(CCA)
+    send_check(cc, Join(1, group(1)), Ok())
+    c = state.add_client(LocalAddress("client1"))
+    send_check(c, put("key-1", "v1"), put_ok())
+    send_check(c, get("key-1"), get_result("v1"))
+    send_check(c, get("key-7"), KeyNotFound())
+    send_check(c, put("key-7", "v7"), put_ok())
+    send_check(c, get("key-7"), get_result("v7"))
+    state.stop()
+
+
+def test_join_moves_shards():
+    state = make_state(2)
+    settings = RunSettings().max_time(60)
+    state.start(settings)
+    cc = state.add_client(CCA)
+    send_check(cc, Join(1, group(1)), Ok())
+
+    c = state.add_client(LocalAddress("client1"))
+    for i in range(1, NUM_SHARDS + 1):
+        send_check(c, put(f"key-{i}", f"v{i}"), put_ok())
+
+    # Join the second group: half the shards (with data) must move.
+    send_check(cc, Join(2, group(2)), Ok())
+    for i in range(1, NUM_SHARDS + 1):
+        send_check(c, get(f"key-{i}"), get_result(f"v{i}"))
+
+    # Data written after the reconfiguration lands in the right group too.
+    send_check(c, put("key-1", "v1b"), put_ok())
+    send_check(c, get("key-1"), get_result("v1b"))
+
+    # Leave group 1: all shards drain to group 2, nothing is lost.
+    send_check(cc, Leave(1), Ok())
+    for i in range(2, NUM_SHARDS + 1):
+        send_check(c, get(f"key-{i}"), get_result(f"v{i}"))
+    send_check(c, get("key-1"), get_result("v1b"))
+    state.stop()
+
+
+def test_move_command_relocates_data():
+    state = make_state(2)
+    settings = RunSettings().max_time(60)
+    state.start(settings)
+    cc = state.add_client(CCA)
+    send_check(cc, Join(1, group(1)), Ok())
+    send_check(cc, Join(2, group(2)), Ok())
+
+    c = state.add_client(LocalAddress("client1"))
+    send_check(c, put("key-3", "v3"), put_ok())
+
+    cc.send_command(Query(-1))
+    config = cc.get_result(timeout=5)
+    assert isinstance(config, ShardConfig)
+    dest = 2 if 3 in config.groups()[1][1] else 1
+    send_check(cc, Move(dest, 3), Ok())
+
+    send_check(c, get("key-3"), get_result("v3"))
+    send_check(c, put("key-3", "v3b"), put_ok())
+    send_check(c, get("key-3"), get_result("v3b"))
+    state.stop()
+
+
+def test_single_group_transactions():
+    """Transactions whose key set lives in one group run without 2PC."""
+    state = make_state(1)
+    settings = RunSettings().max_time(30)
+    state.start(settings)
+    cc = state.add_client(CCA)
+    send_check(cc, Join(1, group(1)), Ok())
+    c = state.add_client(LocalAddress("client1"))
+    send_check(c, MultiPut({"a1": "x", "b1": "y"}), MultiPutOk())
+    send_check(c, MultiGet({"a1", "b1"}),
+               MultiGetResult({"a1": "x", "b1": "y"}))
+    send_check(c, Swap("a1", "b1"), SwapOk())
+    send_check(c, MultiGet({"a1", "b1"}),
+               MultiGetResult({"a1": "y", "b1": "x"}))
+    state.stop()
+
+
+def test_cross_group_transactions():
+    """2PC: transactions spanning groups commit atomically."""
+    state = make_state(2)
+    settings = RunSettings().max_time(60)
+    state.start(settings)
+    cc = state.add_client(CCA)
+    send_check(cc, Join(1, group(1)), Ok())
+    send_check(cc, Join(2, group(2)), Ok())
+    c = state.add_client(LocalAddress("client1"))
+    # key-1..key-10 span both groups (shards 1..10 split 5/5).
+    send_check(c, MultiPut({f"key-{i}": f"v{i}" for i in range(1, 6)}),
+               MultiPutOk())
+    send_check(c, MultiGet({f"key-{i}" for i in range(1, 6)}),
+               MultiGetResult({f"key-{i}": f"v{i}" for i in range(1, 6)}))
+    send_check(c, Swap("key-1", "key-2"), SwapOk())
+    send_check(c, MultiGet({"key-1", "key-2"}),
+               MultiGetResult({"key-1": "v2", "key-2": "v1"}))
+    # Swap against a missing key across groups.
+    send_check(c, Swap("key-3", "key-9"), SwapOk())
+    send_check(c, MultiGet({"key-3", "key-9"}),
+               MultiGetResult({"key-3": KEY_NOT_FOUND, "key-9": "v3"}))
+    state.stop()
+
+
+def test_concurrent_cross_group_swaps():
+    """Concurrent conflicting 2PC transactions stay atomic: swaps permute
+    values, so the value multiset is preserved (TransactionalKVStoreWorkload
+    MULTI_GETS_MATCH spirit)."""
+    import threading
+    state = make_state(2)
+    settings = RunSettings().max_time(60)
+    state.start(settings)
+    cc = state.add_client(CCA)
+    send_check(cc, Join(1, group(1)), Ok())
+    send_check(cc, Join(2, group(2)), Ok())
+    setup = state.add_client(LocalAddress("setup-client"))
+    keys = ["key-1", "key-5", "key-6", "key-10"]
+    send_check(setup, MultiPut({k: k for k in keys}), MultiPutOk())
+
+    errors = []
+
+    def swapper(name, k1, k2, n):
+        c = state.add_client(LocalAddress(name))
+        try:
+            for _ in range(n):
+                c.send_command(Swap(k1, k2))
+                assert c.get_result(timeout=20) == SwapOk()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=swapper, args=("swap-a", "key-1", "key-6", 4)),
+        threading.Thread(target=swapper, args=("swap-b", "key-5", "key-10", 4)),
+        threading.Thread(target=swapper, args=("swap-c", "key-1", "key-10", 3)),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+
+    reader = state.add_client(LocalAddress("reader-client"))
+    reader.send_command(MultiGet(set(keys)))
+    result = reader.get_result(timeout=20)
+    assert isinstance(result, MultiGetResult)
+    # Swaps only permute: the multiset of values is invariant.
+    assert sorted(result.as_dict().values()) == sorted(keys)
+    state.stop()
