@@ -140,12 +140,14 @@ TxId = Tuple[Address, int]  # (client address, sequence number)
 @dataclass(frozen=True)
 class TxPrepare(Message, Command):
     tx: AMOCommand
+    round: int  # retry round; stale-round votes/decisions are ignored
     coordinator_group: int
 
 
 @dataclass(frozen=True)
 class TxVote(Message, Command):
     tx_id: TxId
+    round: int
     group_id: int
     ok: bool
     # current values of the tx's keys owned by the voter (missing = absent)
@@ -155,6 +157,7 @@ class TxVote(Message, Command):
 @dataclass(frozen=True)
 class TxDecision(Message, Command):
     tx_id: TxId
+    round: int
     coordinator_group: int
     commit: bool
     # key -> new value (None = delete); each group applies its owned keys
@@ -164,6 +167,7 @@ class TxDecision(Message, Command):
 @dataclass(frozen=True)
 class TxAck(Message, Command):
     tx_id: TxId
+    round: int
     group_id: int
 
 
@@ -223,6 +227,7 @@ class ShardStoreServer(ShardStoreNode):
         # coordinator side: tx_id -> [tx, votes{group: (ok, values)},
         #                             decision(None/bool), writes, acked set]
         self.coord: Dict["TxId", list] = {}
+        self.tx_round: Dict["TxId", int] = {}  # latest 2PC round per tx
         self.tx_done: Dict["TxId", bool] = {}  # finished txs (True = committed)
 
     def init(self) -> None:
@@ -252,7 +257,11 @@ class ShardStoreServer(ShardStoreNode):
         return info[1] if info is not None else frozenset()
 
     def _reconfig_done(self) -> bool:
-        return not self.incoming and not self.outgoing
+        # Handoff fully drained AND no 2PC state outstanding: moving a shard
+        # mid-transaction would strand its prepared locks and lose the
+        # transaction's committed writes on the departed shard.
+        return (not self.incoming and not self.outgoing and not self.locks
+                and not self.prepared and not self.coord)
 
     def _snapshot_for(self, shards: FrozenSet[int]):
         kv = tuple(sorted(
@@ -331,7 +340,7 @@ class ShardStoreServer(ShardStoreNode):
             self._apply_tx_decision(c)
         elif isinstance(c, TxAck):
             entry = self.coord.get(c.tx_id)
-            if entry is not None:
+            if entry is not None and entry[5] == c.round:
                 entry[4] = entry[4] | {c.group_id}
                 if entry[4] >= self._participant_groups(entry[0].command):
                     del self.coord[c.tx_id]
@@ -350,8 +359,7 @@ class ShardStoreServer(ShardStoreNode):
             return
         if not shards <= self.owned:
             return  # shards still in flight; the client retries
-        if isinstance(c.command, Transaction) and any(
-                s in self.locks for s in shards):
+        if any(s in self.locks for s in shards):
             return  # a cross-group tx holds these shards; client retries
         result = self.app.execute(c)
         if result is not None:
@@ -378,13 +386,15 @@ class ShardStoreServer(ShardStoreNode):
             return
         if tx_id in self.coord:
             return  # already in progress; retries are absorbed
-        self.coord[tx_id] = [c, {}, None, (), frozenset()]
+        rnd = self.tx_round.get(tx_id, 0) + 1
+        self.tx_round[tx_id] = rnd
+        self.coord[tx_id] = [c, {}, None, (), frozenset(), rnd]
         if self.paxos.is_leader():
             self._send_prepares(tx_id)
 
     def _send_prepares(self, tx_id) -> None:
         entry = self.coord[tx_id]
-        prepare = TxPrepare(entry[0], self.group_id)
+        prepare = TxPrepare(entry[0], entry[5], self.group_id)
         groups = self.current_config.groups()
         for g in self._participant_groups(entry[0].command):
             if g not in entry[1]:
@@ -397,8 +407,18 @@ class ShardStoreServer(ShardStoreNode):
         done = self.tx_done.get(tx_id)
         if done is not None:
             self._send_vote_to(c.coordinator_group,
-                               TxVote(tx_id, self.group_id, True, ()))
+                               TxVote(tx_id, c.round, self.group_id, True, ()))
             return
+        cur = self.prepared.get(tx_id)
+        if cur is not None and cur[4] != c.round:
+            if cur[4] < c.round:
+                # A newer round supersedes our stale prepare: release it and
+                # re-prepare below (its votes can no longer be accepted).
+                for sh in [sh for sh, t in self.locks.items() if t == tx_id]:
+                    del self.locks[sh]
+                del self.prepared[tx_id]
+            else:
+                return  # stale prepare from an older round: ignore
         if tx_id not in self.prepared:
             my_shards = (self.command_shards(c.tx.command)
                          & self._my_shards(self.current_config))
@@ -413,9 +433,11 @@ class ShardStoreServer(ShardStoreNode):
                 values = tuple(sorted(
                     (k, store[k]) for k in self._tx_keys(c.tx.command)
                     if self.key_to_shard(k) in my_shards and k in store))
-            self.prepared[tx_id] = (c.tx, c.coordinator_group, ok, values)
-        _, coord_group, ok, values = self.prepared[tx_id]
-        self._send_vote_to(coord_group, TxVote(tx_id, self.group_id, ok, values))
+            self.prepared[tx_id] = (c.tx, c.coordinator_group, ok, values,
+                                    c.round)
+        _, coord_group, ok, values, rnd = self.prepared[tx_id]
+        self._send_vote_to(coord_group,
+                           TxVote(tx_id, rnd, self.group_id, ok, values))
 
     @staticmethod
     def _tx_keys(tx: Command):
@@ -430,7 +452,7 @@ class ShardStoreServer(ShardStoreNode):
 
     def _apply_tx_vote(self, c: TxVote) -> None:
         entry = self.coord.get(c.tx_id)
-        if entry is None or entry[2] is not None:
+        if entry is None or entry[2] is not None or c.round != entry[5]:
             return
         entry[1][c.group_id] = (c.ok, c.values)
         participants = self._participant_groups(entry[0].command)
@@ -463,16 +485,21 @@ class ShardStoreServer(ShardStoreNode):
 
     def _send_decision(self, tx_id) -> None:
         entry = self.coord[tx_id]
-        decision = TxDecision(tx_id, self.group_id, entry[2], entry[3])
+        decision = TxDecision(tx_id, entry[5], self.group_id, entry[2],
+                              entry[3])
         groups = self.current_config.groups()
         for g in self._participant_groups(entry[0].command):
             if g not in entry[4]:
                 self.broadcast(decision, groups[g][0])
 
     def _apply_tx_decision(self, c: TxDecision) -> None:
-        p = self.prepared.pop(c.tx_id, None)
+        p = self.prepared.get(c.tx_id)
+        if p is not None and p[4] != c.round:
+            p = None  # decision from another round: leave our prepare alone
+        else:
+            self.prepared.pop(c.tx_id, None)
         if p is not None:
-            _, _, ok, _ = p
+            _, _, ok, _, _ = p
             if c.commit and ok:
                 store = self.app.application.store
                 my = self._my_shards(self.current_config)
@@ -486,15 +513,17 @@ class ShardStoreServer(ShardStoreNode):
             for s in [s for s, t in self.locks.items() if t == c.tx_id]:
                 del self.locks[s]
         # Aborted coordinator entries are cleared so a client retry can
-        # restart the transaction from scratch.
+        # restart the transaction from scratch (stale-round decisions must
+        # not clear a newer round's entry).
         entry = self.coord.get(c.tx_id)
-        if entry is not None and entry[2] is False:
+        if entry is not None and entry[2] is False and entry[5] == c.round:
             del self.coord[c.tx_id]
         # Always ack (even duplicate decisions: an earlier ack may be lost).
         if self.paxos.is_leader() and self.current_config is not None:
             members = self.current_config.groups().get(c.coordinator_group)
             if members is not None:
-                self.broadcast(TxAck(c.tx_id, self.group_id), members[0])
+                self.broadcast(TxAck(c.tx_id, c.round, self.group_id),
+                               members[0])
 
     def _apply_new_config(self, cfg: ShardConfig) -> None:
         if cfg.config_num != self._next_config_num() or not self._reconfig_done():
@@ -564,9 +593,11 @@ class ShardStoreServer(ShardStoreNode):
                     self._send_prepares(tx_id)
                 else:
                     self._send_decision(tx_id)
-            for tx_id, (tx, coord_group, ok, values) in self.prepared.items():
+            for tx_id, (tx, coord_group, ok, values, rnd) in \
+                    self.prepared.items():
                 self._send_vote_to(coord_group,
-                                   TxVote(tx_id, self.group_id, ok, values))
+                                   TxVote(tx_id, rnd, self.group_id, ok,
+                                          values))
         self.set_timer(QueryTimer(), QUERY_MILLIS)
 
 

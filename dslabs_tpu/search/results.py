@@ -26,6 +26,8 @@ class EndCondition(enum.Enum):
 
 class SearchResults:
 
+    discovered_count: int = 0
+
     def __init__(self, invariants: List[StatePredicate],
                  goals: List[StatePredicate]):
         self.invariants = list(invariants)

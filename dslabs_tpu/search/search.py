@@ -163,6 +163,8 @@ class Search:
             self.results.end_condition = EndCondition.SPACE_EXHAUSTED
         else:
             self.results.end_condition = EndCondition.TIME_EXHAUSTED
+        if hasattr(self, "_discovered"):
+            self.results.discovered_count = len(self._discovered)
         return self.results
 
 

@@ -1,0 +1,496 @@
+"""TPU tensor-search engine: vmapped BFS over a frontier of packed states.
+
+This is the component the whole rebuild points at (SURVEY §0, §8,
+BASELINE.json): the reference's explicit-state model checker
+(framework/tst/.../search/Search.java:405-505 — one thread pops one state,
+clones one node, runs one reflective handler) becomes a data-parallel XLA
+program:
+
+  frontier [N, ...]  --(enumerate events x vmapped transition)-->
+  successors [N*E, ...] --(canonicalise + 128-bit fingerprint)-->
+  dedup (sort-unique + sorted-visited membership) --> next frontier
+
+Checker semantics reproduced exactly (SURVEY §7):
+  * the network is a SET of fixed-width message records, kept in canonical
+    sorted order (Java hashes unordered sets; canonical order makes equal
+    states hash equal — SURVEY §8.1 "canonicalization matters");
+    delivery never removes a message (SearchState.java:300);
+  * per-node timer queues keep insertion order; a timer is deliverable iff
+    no earlier-queued timer t' has t.min >= t'.max (TimerQueue.java:66-105),
+    computed as a vectorised prefix-min; firing removes the timer;
+  * dedup happens on successor generation, pre-check (Search.java:485);
+    equivalence keys on (node lanes, network set, timer queues) via a
+    128-bit fingerprint (hash compaction; collision odds ~n^2 / 2^128).
+
+The engine is protocol-agnostic: a :class:`TensorProtocol` supplies packed
+node-state lanes and a pure ``step(state, event)`` transition; the engine
+owns event enumeration, network-set insertion, canonicalisation,
+fingerprinting, dedup, predicate checks, and frontier compaction.  Multi-
+chip scaling shards the frontier over a mesh and exchanges successor
+fingerprints by hash ownership (see ``dslabs_tpu/tpu/sharded.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+
+# 64-bit fingerprints need x64 lanes (TPU emulates int64; the fingerprint
+# arithmetic is a tiny fraction of the level step).
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+__all__ = ["TensorProtocol", "TensorState", "TensorSearch", "SearchOutcome",
+           "SENTINEL"]
+
+# Empty slots in the network / timer arrays hold SENTINEL in every lane, so
+# they sort after every real record and hash consistently.
+SENTINEL = np.int32(2 ** 31 - 1)
+
+
+# --------------------------------------------------------------------- state
+
+class TensorState(Dict[str, jnp.ndarray]):
+    """A batch of packed search states (struct-of-arrays pytree):
+
+    nodes  [N, NW]            int32 — all nodes' packed protocol fields
+    net    [N, NET_CAP, MW]   int32 — canonical-sorted message set
+    timers [N, NN, T_CAP, TW] int32 — per-node timer queues, insertion order
+                                      (lane 0 = tag, lane 1 = min, lane 2 =
+                                      max, rest payload)
+    """
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorProtocol:
+    """Contract a tensorised protocol twin fulfils.
+
+    The transition functions operate on ONE state (the engine vmaps them):
+
+    ``step_message(nodes, msg) -> (nodes', sends, new_timers)``
+    ``step_timer(nodes, node_idx, timer) -> (nodes', sends, new_timers)``
+
+    where ``sends`` is ``[MAX_SENDS, MW]`` with invalid rows = SENTINEL and
+    ``new_timers`` is ``[MAX_SETS, 1 + TW]`` (leading lane = target node
+    index, SENTINEL rows invalid).
+    """
+
+    name: str
+    n_nodes: int
+    node_width: int
+    msg_width: int
+    timer_width: int
+    net_cap: int
+    timer_cap: int
+    max_sends: int
+    max_sets: int
+    init_nodes: Callable[[], np.ndarray]
+    init_messages: Callable[[], np.ndarray]   # [k, MW] initial network
+    init_timers: Callable[[], np.ndarray]     # [k, 1 + TW] initial timer sets
+    step_message: Callable
+    step_timer: Callable
+    # message -> destination node index (for delivery gating); jax fn
+    msg_dest: Callable
+    # state-level predicates: dict name -> vmapped-able fn(state_slice)->bool
+    invariants: Dict[str, Callable] = dataclasses.field(default_factory=dict)
+    goals: Dict[str, Callable] = dataclasses.field(default_factory=dict)
+    prunes: Dict[str, Callable] = dataclasses.field(default_factory=dict)
+    # optional masks: deliver_message(msg)->bool, deliver_timer(node)->bool
+    deliver_message: Optional[Callable] = None
+    deliver_timer: Optional[Callable] = None
+
+
+@dataclasses.dataclass
+class SearchOutcome:
+    end_condition: str               # GOAL_FOUND / INVARIANT_VIOLATED /
+                                     # SPACE_EXHAUSTED / CAPACITY_EXHAUSTED /
+                                     # DEPTH_EXHAUSTED
+    states_explored: int
+    unique_states: int
+    depth: int
+    elapsed_secs: float
+    violating_state: Optional[dict] = None
+    goal_state: Optional[dict] = None
+    predicate_name: Optional[str] = None
+
+
+# ----------------------------------------------------------------- hashing
+
+def _mix32(x: jnp.ndarray, seed: jnp.ndarray) -> jnp.ndarray:
+    """xorshift-multiply mixer over int32 lanes (vectorised)."""
+    x = x.astype(jnp.uint32) ^ (seed.astype(jnp.uint32) * jnp.uint32(0x9E3779B9))
+    x = (x ^ (x >> 16)) * jnp.uint32(0x85EBCA6B)
+    x = (x ^ (x >> 13)) * jnp.uint32(0xC2B2AE35)
+    return x ^ (x >> 16)
+
+
+def _fingerprint(flat: jnp.ndarray, seed: int) -> jnp.ndarray:
+    """64-bit fingerprint of [N, L] int32 rows -> [N] int64.
+
+    Sequential-free: each lane is mixed with its position and a seed, then
+    lanes are combined with addition and a final avalanche (order within the
+    row still matters via the positional term)."""
+    n, l = flat.shape
+    pos = jnp.arange(l, dtype=jnp.uint32)[None, :] + jnp.uint32(seed * 0x1000193)
+    h = _mix32(flat, pos)
+    lo = jnp.sum(h, axis=1, dtype=jnp.uint32)
+    hi = jnp.sum(_mix32(h, pos + jnp.uint32(0x27D4EB2F)), axis=1,
+                 dtype=jnp.uint32)
+    return (hi.astype(jnp.int64) << 32) | lo.astype(jnp.int64)
+
+
+def state_fingerprints(state: dict) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Two independent 64-bit fingerprints per state (128-bit key)."""
+    n = state["nodes"].shape[0]
+    flat = jnp.concatenate([
+        state["nodes"].reshape(n, -1),
+        state["net"].reshape(n, -1),
+        state["timers"].reshape(n, -1),
+    ], axis=1)
+    return _fingerprint(flat, 1), _fingerprint(flat, 2)
+
+
+# ------------------------------------------------------------ net/timer ops
+
+def canonicalize_net(net: jnp.ndarray) -> jnp.ndarray:
+    """Sort the message set into canonical order and collapse duplicates.
+
+    [CAP, MW] -> [CAP, MW]; empty rows are all-SENTINEL and sort last.
+    Records are ordered by their packed fingerprint (any total order works
+    for canonicalisation as long as it is content-determined)."""
+    cap, mw = net.shape
+
+    def keys(rows):
+        empty = rows[:, 0] == SENTINEL
+        return empty, _fingerprint(rows, 3), _fingerprint(rows, 4)
+
+    empty, key1, key2 = keys(net)
+    # lexsort: LAST key is primary — empty rows always sort to the back.
+    order = jnp.lexsort((key2, key1, empty))
+    net = net[order]
+    key1, key2, empty = key1[order], key2[order], empty[order]
+    dup = jnp.zeros(cap, dtype=bool).at[1:].set(
+        (key1[1:] == key1[:-1]) & (key2[1:] == key2[:-1]) & ~empty[1:])
+    net = jnp.where(dup[:, None], SENTINEL, net)
+    # One more sort pushes the duplicate-cleared rows to the back.
+    empty, key1, key2 = keys(net)
+    order = jnp.lexsort((key2, key1, empty))
+    return net[order]
+
+
+def insert_messages(net: jnp.ndarray, sends: jnp.ndarray) -> jnp.ndarray:
+    """Set-insert up to MAX_SENDS records into the canonical network.
+
+    Concatenate, canonicalise (dedup), and truncate back to capacity.  A
+    genuine overflow would silently drop the largest-keyed record; protocols
+    size NET_CAP so this cannot happen within the searched depth."""
+    cap = net.shape[0]
+    combined = jnp.concatenate([net, sends], axis=0)
+    return canonicalize_net(combined)[:cap]
+
+
+def timer_deliverable_mask(queue: jnp.ndarray) -> jnp.ndarray:
+    """[T_CAP, TW] -> [T_CAP] bool: the TimerQueue partial order
+    (TimerQueue.java:66-105).  Lane 1 = min, lane 2 = max; empty rows are
+    SENTINEL.  deliverable[i] = occupied[i] and min[i] < min(max[j] for
+    occupied j < i) (strictly: NOT exists earlier t' with t.min >= t'.max)."""
+    occupied = queue[:, 0] != SENTINEL
+    maxes = jnp.where(occupied, queue[:, 2], SENTINEL)
+    prefix_min = jnp.concatenate([
+        jnp.array([SENTINEL], dtype=maxes.dtype),
+        jax.lax.cummin(maxes)[:-1]])
+    return occupied & (queue[:, 1] < prefix_min)
+
+
+def remove_timer(queue: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """Remove the timer at position idx, shifting later entries left
+    (insertion order is semantic — it drives the partial order)."""
+    cap = queue.shape[0]
+    pos = jnp.arange(cap)
+    src = jnp.where(pos >= idx, pos + 1, pos).clip(0, cap - 1)
+    shifted = queue[src]
+    shifted = shifted.at[cap - 1].set(SENTINEL)
+    return jnp.where((pos >= idx)[:, None], shifted, queue)
+
+
+def append_timers(timers: jnp.ndarray, new_timers: jnp.ndarray) -> jnp.ndarray:
+    """Append [MAX_SETS, 1+TW] records (lane 0 = node idx) to the per-node
+    queues [NN, T_CAP, TW], preserving insertion order."""
+    nn, cap, tw = timers.shape
+
+    def one_append(tmrs, rec):
+        node = rec[0]
+        # A full queue DROPS the append rather than clobbering the last
+        # slot — insertion order is semantic.  Protocols must size
+        # timer_cap for the searched depth (as with NET_CAP overflow).
+        def body(t):
+            q = t[node]
+            count = jnp.sum(q[:, 0] != SENTINEL)
+            has_room = count < cap
+            q = q.at[count.clip(0, cap - 1)].set(
+                jnp.where(has_room, rec[1:], q[count.clip(0, cap - 1)]))
+            return t.at[node].set(q)
+        return jax.lax.cond(rec[0] != SENTINEL, body, lambda t: t, tmrs), None
+
+    timers, _ = jax.lax.scan(one_append, timers, new_timers)
+    return timers
+
+
+# ------------------------------------------------------------------- engine
+
+class TensorSearch:
+    """Single-device BFS driver.  One jitted program expands a frontier
+    chunk into successors; the host loop handles level accounting, visited
+    merging, and termination."""
+
+    def __init__(self, protocol: TensorProtocol,
+                 frontier_cap: int = 1 << 16,
+                 chunk: int = 1 << 12,
+                 max_depth: Optional[int] = None):
+        self.p = protocol
+        self.frontier_cap = frontier_cap
+        self.chunk = chunk
+        self.max_depth = max_depth
+        self._expand = jax.jit(self._expand_chunk)
+
+    # ------------------------------------------------------------- plumbing
+
+    def initial_state(self) -> dict:
+        p = self.p
+        nodes = jnp.asarray(p.init_nodes(), jnp.int32)[None]
+        net = jnp.full((1, p.net_cap, p.msg_width), SENTINEL, jnp.int32)
+        init_msgs = np.asarray(p.init_messages(), np.int32).reshape(-1, p.msg_width)
+        if init_msgs.shape[0]:
+            pad = np.full((p.net_cap - init_msgs.shape[0], p.msg_width),
+                          SENTINEL, np.int32)
+            net = jnp.asarray(np.concatenate([init_msgs, pad]))[None]
+            net = jax.vmap(canonicalize_net)(net)
+        timers = jnp.full((1, p.n_nodes, p.timer_cap, p.timer_width),
+                          SENTINEL, jnp.int32)
+        init_tmrs = np.asarray(p.init_timers(), np.int32)
+        if init_tmrs.size:
+            timers = jax.vmap(append_timers)(
+                timers, jnp.asarray(init_tmrs, jnp.int32)[None])
+        return {"nodes": nodes, "net": net, "timers": timers}
+
+    def _num_events(self) -> int:
+        return self.p.net_cap + self.p.n_nodes * self.p.timer_cap
+
+    def _step_one(self, state_slice: dict, event_idx: jnp.ndarray):
+        """Expand ONE state by ONE event index -> (successor, valid)."""
+        p = self.p
+        nodes, net, timers = (state_slice["nodes"], state_slice["net"],
+                              state_slice["timers"])
+        is_msg = event_idx < p.net_cap
+
+        def deliver_message():
+            msg = net[event_idx.clip(0, p.net_cap - 1)]
+            occupied = msg[0] != SENTINEL
+            ok = occupied
+            if p.deliver_message is not None:
+                ok = ok & p.deliver_message(msg)
+            nodes2, sends, new_timers = p.step_message(nodes, msg)
+            return nodes2, sends, new_timers, None, ok
+
+        def deliver_timer():
+            t_idx = event_idx - p.net_cap
+            node = t_idx // p.timer_cap
+            slot = t_idx % p.timer_cap
+            queue = timers[node]
+            ok = timer_deliverable_mask(queue)[slot]
+            if p.deliver_timer is not None:
+                ok = ok & p.deliver_timer(node)
+            timer = queue[slot]
+            nodes2, sends, new_timers = p.step_timer(nodes, node, timer)
+            return nodes2, sends, new_timers, (node, slot), ok
+
+        m_nodes, m_sends, m_set, _, m_ok = deliver_message()
+        t_nodes, t_sends, t_set, (t_node, t_slot), t_ok = deliver_timer()
+
+        nodes2 = jnp.where(is_msg, m_nodes, t_nodes)
+        sends = jnp.where(is_msg, m_sends, t_sends)
+        new_t = jnp.where(is_msg, m_set, t_set)
+        valid = jnp.where(is_msg, m_ok, t_ok)
+
+        net2 = insert_messages(net, sends)
+        timers2 = timers
+        # Firing consumes the timer (SearchState.java:357).
+        fired_q = remove_timer(timers[t_node], t_slot)
+        timers2 = jnp.where(is_msg, timers2,
+                            timers2.at[t_node].set(fired_q))
+        timers2 = append_timers(timers2, new_t)
+        return {"nodes": nodes2, "net": net2, "timers": timers2}, valid
+
+    def _expand_chunk(self, chunk_state: dict, chunk_valid: jnp.ndarray):
+        """[C]-state chunk -> all successors + fingerprints + flags."""
+        p = self.p
+        ne = self._num_events()
+        ev = jnp.arange(ne)
+
+        def per_state(slice_, v):
+            succ, valid = jax.vmap(
+                lambda e: self._step_one(slice_, e))(ev)
+            return succ, valid & v
+
+        succs, valids = jax.vmap(per_state)(chunk_state, chunk_valid)
+        flat = jax.tree.map(
+            lambda x: x.reshape((-1,) + x.shape[2:]), succs)
+        valids = valids.reshape(-1)
+        h1, h2 = state_fingerprints(flat)
+        h1 = jnp.where(valids, h1, jnp.int64(2 ** 62))
+        flags = {}
+        for kind, preds in (("inv", p.invariants), ("goal", p.goals),
+                            ("prune", p.prunes)):
+            for name, fn in preds.items():
+                flags[f"{kind}:{name}"] = jax.vmap(fn)(flat) & valids
+        return flat, valids, h1, h2, flags
+
+    # ----------------------------------------------------------------- run
+
+    def run(self, check_initial: bool = True) -> SearchOutcome:
+        import time
+        t0 = time.time()
+        p = self.p
+        state = self.initial_state()
+        h1, h2 = state_fingerprints(state)
+        visited = (np.asarray(h1), np.asarray(h2))
+        explored = 0
+        depth = 0
+
+        if check_initial:
+            for kind, preds in (("inv", p.invariants), ("goal", p.goals)):
+                for name, fn in preds.items():
+                    hit = bool(jax.vmap(fn)(state)[0])
+                    if kind == "inv" and not hit:
+                        return SearchOutcome("INVARIANT_VIOLATED", 1, 1, 0,
+                                             time.time() - t0,
+                                             predicate_name=name)
+                    if kind == "goal" and hit:
+                        return SearchOutcome("GOAL_FOUND", 1, 1, 0,
+                                             time.time() - t0,
+                                             goal_state=state,
+                                             predicate_name=name)
+
+        frontier = state
+        frontier_n = 1
+        while frontier_n > 0:
+            if self.max_depth is not None and depth >= self.max_depth:
+                return SearchOutcome("DEPTH_EXHAUSTED", explored,
+                                     len(visited[0]), depth,
+                                     time.time() - t0)
+            depth += 1
+            new_states: List[dict] = []
+            new_keys: List[Tuple[np.ndarray, np.ndarray]] = []
+            outcome = None
+            for start in range(0, frontier_n, self.chunk):
+                end = min(start + self.chunk, frontier_n)
+                c = end - start
+                pad = self.chunk - c
+                chunk_state = jax.tree.map(
+                    lambda x: jnp.concatenate(
+                        [x[start:end],
+                         jnp.repeat(x[:1], pad, axis=0)], axis=0)
+                    if pad else x[start:end], frontier)
+                chunk_valid = jnp.concatenate(
+                    [jnp.ones(c, bool), jnp.zeros(pad, bool)])
+                flat, valids, h1, h2, flags = self._expand(
+                    chunk_state, chunk_valid)
+                explored += int(jnp.sum(valids))
+
+                # Terminal checks in checkState order: invariants strictly
+                # before goals (Search.java:162-231) — jit canonicalises
+                # dict outputs to sorted key order, so order explicitly.
+                np_valids = np.asarray(valids)
+                for kind in ("inv", "goal"):
+                    for name, f in flags.items():
+                        if not name.startswith(kind + ":"):
+                            continue
+                        fa = np.asarray(f)
+                        pname = name.split(":", 1)[1]
+                        if kind == "inv" and not fa[np_valids].all():
+                            idx = int(np.nonzero(np_valids & ~fa)[0][0])
+                            bad = jax.tree.map(lambda x: x[idx:idx + 1], flat)
+                            return SearchOutcome(
+                                "INVARIANT_VIOLATED", explored,
+                                len(visited[0]), depth, time.time() - t0,
+                                violating_state=bad, predicate_name=pname)
+                        if kind == "goal" and fa[np_valids].any():
+                            idx = int(np.nonzero(np_valids & fa)[0][0])
+                            good = jax.tree.map(lambda x: x[idx:idx + 1], flat)
+                            return SearchOutcome(
+                                "GOAL_FOUND", explored, len(visited[0]),
+                                depth, time.time() - t0, goal_state=good,
+                                predicate_name=pname)
+
+                pruned = np.zeros(len(np_valids), dtype=bool)
+                for name, f in flags.items():
+                    if name.startswith("prune:"):
+                        pruned |= np.asarray(f)
+
+                # Dedup: in-chunk sort-unique, then against visited.  Pruned
+                # states count as discovered (dedup happens on generation,
+                # Search.java:485) but are not expanded.
+                h1n, h2n = np.asarray(h1), np.asarray(h2)
+                keep = np.array(np_valids)  # writable copy
+                order = np.lexsort((h2n, h1n))
+                h1s, h2s = h1n[order], h2n[order]
+                first = np.ones(len(order), dtype=bool)
+                first[1:] = (h1s[1:] != h1s[:-1]) | (h2s[1:] != h2s[:-1])
+                unique_mask = np.zeros(len(order), dtype=bool)
+                unique_mask[order] = first
+                keep &= unique_mask
+                # Membership against visited + already-collected this level.
+                vh1, vh2 = visited
+                pos = np.searchsorted(vh1, h1n)
+                seen = np.zeros(len(h1n), dtype=bool)
+                for off in range(2):
+                    q = (pos + off).clip(0, max(len(vh1) - 1, 0))
+                    if len(vh1):
+                        seen |= (vh1[q] == h1n) & (vh2[q] == h2n)
+                for kh1, kh2 in new_keys:
+                    kpos = np.searchsorted(kh1, h1n)
+                    for off in range(2):
+                        q = (kpos + off).clip(0, max(len(kh1) - 1, 0))
+                        if len(kh1):
+                            seen |= (kh1[q] == h1n) & (kh2[q] == h2n)
+                keep &= ~seen
+                if keep.any():
+                    kidxs = np.nonzero(keep)[0]
+                    ko = np.lexsort((h2n[kidxs], h1n[kidxs]))
+                    new_keys.append((h1n[kidxs][ko], h2n[kidxs][ko]))
+                expand = keep & ~pruned
+                if expand.any():
+                    idxs = np.nonzero(expand)[0]
+                    new_states.append(jax.tree.map(
+                        lambda x: np.asarray(x)[idxs], flat))
+
+            if new_keys:
+                all_h1 = np.concatenate([k[0] for k in new_keys])
+                all_h2 = np.concatenate([k[1] for k in new_keys])
+                mh1 = np.concatenate([visited[0], all_h1])
+                mh2 = np.concatenate([visited[1], all_h2])
+                mo = np.lexsort((mh2, mh1))
+                visited = (mh1[mo], mh2[mo])
+
+            if not new_states:
+                return SearchOutcome("SPACE_EXHAUSTED", explored,
+                                     len(visited[0]), depth,
+                                     time.time() - t0)
+
+            nf = jax.tree.map(
+                lambda *xs: np.concatenate(xs, axis=0),
+                *new_states) if len(new_states) > 1 else new_states[0]
+            frontier_n = len(nf["nodes"])
+            if frontier_n > self.frontier_cap:
+                return SearchOutcome("CAPACITY_EXHAUSTED", explored,
+                                     len(visited[0]), depth,
+                                     time.time() - t0)
+            frontier = jax.tree.map(jnp.asarray, nf)
+
+        return SearchOutcome("SPACE_EXHAUSTED", explored, len(visited[0]),
+                             depth, 0.0)

@@ -1,0 +1,80 @@
+"""Verdict-parity tests: the TPU tensor-search backend must reproduce the
+object-graph model checker's verdicts AND unique-state counts on identical
+configurations (SURVEY §8.4 hard part #1 — equivalence-relation parity).
+
+Runs on the 8-device virtual CPU mesh configured in conftest.py.
+"""
+
+import dataclasses
+
+import pytest
+
+from dslabs_tpu.core.address import LocalAddress
+from dslabs_tpu.labs.pingpong.pingpong import (Ping, PingClient, PingServer,
+                                               Pong)
+from dslabs_tpu.search.search import bfs
+from dslabs_tpu.search.search_state import SearchState
+from dslabs_tpu.search.settings import SearchSettings
+from dslabs_tpu.testing.generator import NodeGenerator
+from dslabs_tpu.testing.predicates import CLIENTS_DONE, RESULTS_OK
+from dslabs_tpu.testing.workload import Workload
+from dslabs_tpu.search.results import EndCondition
+
+jax = pytest.importorskip("jax")
+
+from dslabs_tpu.tpu.engine import TensorSearch  # noqa: E402
+from dslabs_tpu.tpu.protocols.pingpong import make_pingpong_protocol  # noqa: E402
+
+SERVER = LocalAddress("pingserver")
+
+
+def object_search(w, prune_done=False):
+    def parser(c, r):
+        return Ping(c), (Pong(r) if r is not None else None)
+
+    gen = NodeGenerator(
+        server_supplier=lambda a: PingServer(a),
+        client_supplier=lambda a: PingClient(a, SERVER),
+        workload_supplier=lambda a: Workload(
+            command_strings=[f"hi-{i}" for i in range(1, w + 1)],
+            result_strings=[f"hi-{i}" for i in range(1, w + 1)],
+            parser=parser))
+    state = SearchState(gen)
+    state.add_server(SERVER)
+    state.add_client_worker(LocalAddress("client1"))
+    settings = SearchSettings().add_invariant(RESULTS_OK)
+    if prune_done:
+        settings.add_prune(CLIENTS_DONE)
+    else:
+        settings.add_goal(CLIENTS_DONE)
+    settings.max_time(60)
+    return bfs(state, settings)
+
+
+def tensor_search(w, prune_done=False):
+    p = make_pingpong_protocol(w)
+    if prune_done:
+        p = dataclasses.replace(p, goals={},
+                                prunes={"CLIENTS_DONE": p.goals["CLIENTS_DONE"]})
+    return TensorSearch(p, chunk=512).run()
+
+
+@pytest.mark.parametrize("w", [1, 2])
+def test_goal_verdict_parity(w):
+    obj = object_search(w)
+    ten = tensor_search(w)
+    assert obj.end_condition == EndCondition.GOAL_FOUND
+    assert ten.end_condition == "GOAL_FOUND"
+
+
+@pytest.mark.parametrize("w", [1, 2])
+def test_exhaustive_unique_state_parity(w):
+    """With CLIENTS_DONE pruned, both backends exhaust the same space and
+    must discover exactly the same number of unique states."""
+    obj = object_search(w, prune_done=True)
+    ten = tensor_search(w, prune_done=True)
+    assert obj.end_condition == EndCondition.SPACE_EXHAUSTED
+    assert ten.end_condition == "SPACE_EXHAUSTED"
+    assert ten.unique_states == obj.discovered_count, (
+        f"object discovered {obj.discovered_count}, "
+        f"tensor discovered {ten.unique_states}")
