@@ -1,0 +1,692 @@
+"""Tensorised twin of lab 3 multi-Paxos — the north-star bench workload
+(BASELINE.json: lab3-paxos BFS states/min).
+
+Mirrors the object implementation in dslabs_tpu/labs/paxos/paxos.py
+handler-for-handler, including everything that participates in object state
+equality: the log, ballot/leader/heard flags, raw P1b vote contents,
+P2b vote bitmasks, proposed_seq, peer_executed + GC frontiers, and the AMO
+application state.  Handler cascades (leader self-accept/self-vote on
+P2a/P2b, execution chains with client replies) are inlined exactly as the
+object's local ``deliver_message`` calls are.
+
+Workload model: ``n_clients`` clients each Put their own key W times
+(value = f(seq)), so the KVStore + AMO state collapses to one
+last-executed-seq lane per client.  Command ids: ``c * W + s`` (1-based);
+0 = no-op.
+
+Packed lanes per server (offsets from the server's base):
+  0 ballot (round * n + leader_idx)   4 executed_through
+  1 leader flag                       5 cleared_through
+  2 heard_from_leader                 6 gc_through
+  3 slot_in                           7 peer_executed bitmask
+  8..8+n-1      peer_executed values
+  AMO           n_clients lanes: last executed seq per client
+  PROP          n_clients lanes: proposed_seq (0 = none)
+  P2B           S lanes: vote bitmask per slot
+  LOG           S x [exists, ballot, cmd, chosen]
+  VOTES         n x [have, S x [exists, ballot, cmd, chosen]]  raw P1b votes
+
+Clients contribute one lane each: k = seq in flight (W+1 = done).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dslabs_tpu.tpu.engine import SENTINEL, TensorProtocol
+
+__all__ = ["make_paxos_protocol"]
+
+# Message tags
+REQ, P1A, P1B, P2A, P2B, HB, HBR, CREQ, CREP, REPLY = range(10)
+# Timer tags
+T_ELECTION, T_HEARTBEAT, T_CLIENT = 1, 2, 3
+
+ELECTION_MIN, ELECTION_MAX = 150, 300
+HEARTBEAT_MS = 50
+CLIENT_MS = 100
+
+
+def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
+                        max_slots: int = 2, net_cap: int = 64,
+                        timer_cap: int = 8) -> TensorProtocol:
+    S = max_slots
+    NC = n_clients
+    maj = n // 2 + 1
+
+    # ---- server lane offsets
+    PEER = 8
+    AMO = PEER + n
+    PROP = AMO + NC
+    P2BV = PROP + NC
+    LOG = P2BV + S
+    VOTES = LOG + 4 * S
+    SW = VOTES + n * (1 + 4 * S)
+    NW = n * SW + NC                       # + one k lane per client
+    N_NODES = n + NC
+
+    # ---- message layout: [tag, frm, to, p0..]  payload:
+    #   REQ:   [client, seq]
+    #   P1A:   [ballot]
+    #   P1B:   [ballot, S x (exists, lballot, cmd, chosen)]
+    #   P2A:   [ballot, slot, cmd]
+    #   P2B:   [ballot, slot]
+    #   HB:    [ballot, commit, gc]     HBR: [ballot, executed]
+    #   CREQ:  [from_slot]              CREP: [base, count, S x cmd]
+    #   REPLY: [client, seq]
+    PAYLOAD = max(1 + 4 * S, 3, 2 + S)
+    MW = 3 + PAYLOAD
+    TW = 4  # [tag, min, max, p0]
+    MAX_SENDS = 64 + n   # SRV_SENDS + CLI_SENDS (finalize() asserts fit)
+    MAX_SETS = 4 + 1
+
+    def cmd_id(client, seq):
+        return client * w + seq  # 1-based; 0 = none/noop
+
+    def cmd_client(cmd):
+        return (cmd - 1) // w
+
+    def cmd_seq(cmd):
+        return (cmd - 1) % w + 1
+
+    # ------------------------------------------------------------- builders
+
+    def mk_msg(tag, frm, to, payload):
+        lanes = [jnp.asarray(tag, jnp.int32), jnp.asarray(frm, jnp.int32),
+                 jnp.asarray(to, jnp.int32)]
+        for v in payload:
+            lanes.append(jnp.asarray(v, jnp.int32))
+        while len(lanes) < MW:
+            lanes.append(jnp.zeros((), jnp.int32))
+        return jnp.stack(lanes)
+
+    class Sends:
+        """Collects conditional sends; blank rows are all-SENTINEL so blocks
+        from mutually exclusive branches merge by elementwise minimum."""
+
+        def __init__(self):
+            self.rows = []
+
+        def add(self, cond, tag, frm, to, payload):
+            rec = mk_msg(tag, frm, to, payload)
+            blank = jnp.full((MW,), SENTINEL, jnp.int32)
+            self.rows.append(jnp.where(cond, rec, blank))
+
+        def finalize(self, count):
+            rows = list(self.rows)
+            assert len(rows) <= count, (len(rows), count)
+            blank = jnp.full((MW,), SENTINEL, jnp.int32)
+            while len(rows) < count:
+                rows.append(blank)
+            return jnp.stack(rows)
+
+    class Sets:
+        def __init__(self):
+            self.rows = []
+
+        def add(self, cond, node, tag, mn, mx, p0):
+            rec = jnp.stack([
+                jnp.asarray(node, jnp.int32), jnp.asarray(tag, jnp.int32),
+                jnp.asarray(mn, jnp.int32), jnp.asarray(mx, jnp.int32),
+                jnp.asarray(p0, jnp.int32)])
+            blank = jnp.full((1 + TW,), SENTINEL, jnp.int32)
+            self.rows.append(jnp.where(cond, rec, blank))
+
+        def finalize(self, count):
+            rows = list(self.rows)
+            assert len(rows) <= count, (len(rows), count)
+            blank = jnp.full((1 + TW,), SENTINEL, jnp.int32)
+            while len(rows) < count:
+                rows.append(blank)
+            return jnp.stack(rows)
+
+    # ----------------------------------------------------- server accessors
+
+    def sbase(i):
+        return i * SW
+
+    def get(nodes, i, off):
+        return nodes[sbase(i) + off]
+
+    def setv(nodes, i, off, val):
+        return nodes.at[sbase(i) + off].set(jnp.asarray(val, jnp.int32))
+
+    def log_get(nodes, i, slot):
+        """slot is 1-based traced int; returns (exists, ballot, cmd, chosen)
+        with slot clamped into range (callers mask)."""
+        s0 = sbase(i) + LOG + 4 * (slot - 1).clip(0, S - 1)
+        return (jax.lax.dynamic_slice(nodes, (s0,), (4,)))
+
+    def log_set(nodes, i, slot, entry, cond):
+        s0 = sbase(i) + LOG + 4 * (slot - 1).clip(0, S - 1)
+        in_range = (slot >= 1) & (slot <= S) & cond
+        cur = jax.lax.dynamic_slice(nodes, (s0,), (4,))
+        new = jnp.where(in_range, jnp.asarray(entry, jnp.int32), cur)
+        return jax.lax.dynamic_update_slice(nodes, new, (s0,))
+
+    def exec_chain(nodes, i, sends: Sends, cond):
+        """Execute contiguous chosen slots (paxos.py _execute_chosen),
+        sending client replies; leader updates its own peer_executed."""
+        for _ in range(S):
+            ex = get(nodes, i, 4)
+            e = log_get(nodes, i, ex + 1)
+            can = cond & (ex + 1 <= S) & (e[0] == 1) & (e[3] == 1)
+            nodes = setv(nodes, i, 4, jnp.where(can, ex + 1, ex))
+            cmd = e[2]
+            has_cmd = can & (cmd != 0)
+            cl = cmd_client(cmd).clip(0, NC - 1)
+            sq = cmd_seq(cmd)
+            last = jax.lax.dynamic_index_in_dim(
+                nodes, sbase(i) + AMO + cl, keepdims=False)
+            reply = has_cmd & (sq >= last)
+            newlast = jnp.where(has_cmd & (sq > last), sq, last)
+            nodes = jax.lax.dynamic_update_index_in_dim(
+                nodes, newlast.astype(jnp.int32), sbase(i) + AMO + cl, 0)
+            sends.add(reply, REPLY, i, n + cl, [cl, sq])
+        # Leader bookkeeping + GC (object: peer_executed[self]=exec; gc)
+        is_leader = (cond & (get(nodes, i, 1) == 1)
+                     & (get(nodes, i, 0) % n == i))
+        return _leader_exec_update(nodes, i, is_leader)
+
+    def _leader_exec_update(nodes, i, is_leader):
+        ex = get(nodes, i, 4)
+        mask = get(nodes, i, 7)
+        nodes = setv(nodes, i, 7,
+                     jnp.where(is_leader, mask | (1 << i), mask))
+        cur = get(nodes, i, PEER + i)
+        nodes = setv(nodes, i, PEER + i, jnp.where(is_leader, ex, cur))
+        return maybe_gc(nodes, i, is_leader)
+
+    def maybe_gc(nodes, i, cond):
+        mask = get(nodes, i, 7)
+        have_all = mask == (1 << n) - 1
+        floor = get(nodes, i, PEER + 0)
+        for j in range(1, n):
+            floor = jnp.minimum(floor, get(nodes, i, PEER + j))
+        do = cond & have_all & (floor > get(nodes, i, 6))
+        nodes = setv(nodes, i, 6,
+                     jnp.where(do, floor, get(nodes, i, 6)))
+        return gc_to(nodes, i, floor, do)
+
+    def gc_to(nodes, i, through, cond):
+        through = jnp.minimum(through, get(nodes, i, 4))
+        cleared = get(nodes, i, 5)
+        do = cond & (through > cleared)
+        for slot in range(1, S + 1):
+            clear = do & (jnp.asarray(slot) > cleared) & (jnp.asarray(slot) <= through)
+            nodes = log_set(nodes, i, jnp.asarray(slot), [0, 0, 0, 0], clear)
+        nodes = setv(nodes, i, 5, jnp.where(do, through, cleared))
+        return nodes
+
+    def accept_p2a(nodes, i, ballot, slot, cmd, cond):
+        """The acceptor body of handle_P2a (ballot already >= checked)."""
+        e = log_get(nodes, i, slot)
+        write = cond & (slot > get(nodes, i, 5)) & ~((e[0] == 1) & (e[3] == 1))
+        return log_set(nodes, i, slot, [1, ballot, cmd, 0], write)
+
+    def record_own_p2b(nodes, i, ballot, slot, cond):
+        """Leader self-vote (send_p2a -> self P2a -> self P2b), which can
+        never reach majority alone for n >= 2 (no cascade)."""
+        e = log_get(nodes, i, slot)
+        ok = (cond & (get(nodes, i, 0) == ballot)
+              & (e[0] == 1) & (e[3] == 0) & (e[1] == ballot))
+        off = sbase(i) + P2BV + (slot - 1).clip(0, S - 1)
+        cur = jax.lax.dynamic_index_in_dim(nodes, off, keepdims=False)
+        return jax.lax.dynamic_update_index_in_dim(
+            nodes, jnp.where(ok, cur | (1 << i), cur).astype(jnp.int32),
+            off, 0)
+
+    def send_p2a(nodes, i, slot, sends: Sends, cond):
+        """Broadcast P2a for log[slot] + inline self-accept/self-vote."""
+        e = log_get(nodes, i, slot)
+        ballot = get(nodes, i, 0)
+        for j in range(n):
+            if j == i:
+                continue
+            sends.add(cond, P2A, i, j, [ballot, slot, e[2]])
+        nodes = accept_p2a(nodes, i, ballot, slot, e[2], cond)
+        nodes = setv(nodes, i, 2, jnp.where(cond, 1, get(nodes, i, 2)))
+        nodes = record_own_p2b(nodes, i, ballot, slot, cond)
+        return nodes
+
+    def heartbeat_sends(nodes, i, sends: Sends, cond):
+        ballot = get(nodes, i, 0)
+        commit = get(nodes, i, 4)
+        gc = get(nodes, i, 6)
+        for j in range(n):
+            if j == i:
+                continue
+            sends.add(cond, HB, i, j, [ballot, commit, gc])
+
+    # ----------------------------------------------------- message handlers
+
+    # Row budgets per handler block (static add-counts; asserted in
+    # finalize).  Branch blocks are mutually exclusive, so they share rows.
+    SRV_SENDS, SRV_SETS = 64, 4
+    CLI_SENDS, CLI_SETS = n, 1
+
+    def step_message(nodes, msg):
+        tag, frm, to = msg[0], msg[1], msg[2]
+        p = msg[3:]
+        out = nodes
+        srv_rows, srv_sets = None, None
+        for i in range(n):
+            here = to == i
+            sends, sets = Sends(), Sets()
+            out = _server_handle(out, i, here, tag, frm, p, sends, sets)
+            r, t = sends.finalize(SRV_SENDS), sets.finalize(SRV_SETS)
+            srv_rows = r if srv_rows is None else jnp.minimum(srv_rows, r)
+            srv_sets = t if srv_sets is None else jnp.minimum(srv_sets, t)
+        cli_rows, cli_sets = None, None
+        for c in range(NC):
+            here = to == n + c
+            sends, sets = Sends(), Sets()
+            out = _client_handle(out, c, here, tag, p, sends, sets)
+            r, t = sends.finalize(CLI_SENDS), sets.finalize(CLI_SETS)
+            cli_rows = r if cli_rows is None else jnp.minimum(cli_rows, r)
+            cli_sets = t if cli_sets is None else jnp.minimum(cli_sets, t)
+        rows = jnp.concatenate([srv_rows, cli_rows])
+        tsets = jnp.concatenate([srv_sets, cli_sets])
+        return out, rows, tsets
+
+    def _server_handle(nodes, i, here, tag, frm, p, sends, sets):
+        ballot = get(nodes, i, 0)
+
+        # ---- PaxosRequest (handle_PaxosRequest, paxos.py)
+        is_req = here & (tag == REQ)
+        client, seq = p[0], p[1]
+        amo_last = jax.lax.dynamic_index_in_dim(
+            nodes, sbase(i) + AMO + client.clip(0, NC - 1), keepdims=False)
+        already = seq <= amo_last
+        sends.add(is_req & already & (seq == amo_last), REPLY, i,
+                  n + client, [client, seq])
+        is_leader = (get(nodes, i, 1) == 1) & (ballot % n == i)
+        believed = ballot % n
+        fwd = (is_req & ~already & ~is_leader
+               & ((frm == i) | (frm >= n)) & (believed != i))
+        sends.add(fwd, REQ, i, believed, [client, seq])
+        prop = jax.lax.dynamic_index_in_dim(
+            nodes, sbase(i) + PROP + client.clip(0, NC - 1), keepdims=False)
+        do_prop = is_req & ~already & is_leader & (seq > prop)
+        slot = get(nodes, i, 3)
+        in_range = slot <= S
+        do_prop = do_prop & in_range
+        nodes = jax.lax.dynamic_update_index_in_dim(
+            nodes, jnp.where(do_prop, seq, prop).astype(jnp.int32),
+            sbase(i) + PROP + client.clip(0, NC - 1), 0)
+        nodes = setv(nodes, i, 3, jnp.where(do_prop, slot + 1, slot))
+        nodes = log_set(nodes, i, slot,
+                        [1, ballot, cmd_id(client, seq), 0], do_prop)
+        nodes = send_p2a(nodes, i, slot, sends, do_prop)
+
+        # ---- P1a (handle_P1a)
+        is_p1a = here & (tag == P1A)
+        mb = p[0]
+        adopt = is_p1a & (mb > ballot)
+        nodes = setv(nodes, i, 0, jnp.where(adopt, mb, get(nodes, i, 0)))
+        nodes = setv(nodes, i, 1, jnp.where(adopt, 0, get(nodes, i, 1)))
+        promise = is_p1a & (mb == get(nodes, i, 0))
+        log_flat = jax.lax.dynamic_slice(nodes, (sbase(i) + LOG,), (4 * S,))
+        sends.add(promise, P1B, i, frm,
+                  [get(nodes, i, 0)] + [log_flat[j] for j in range(4 * S)])
+
+        # ---- P1b (handle_P1b)
+        is_p1b = here & (tag == P1B)
+        vb = p[0]
+        accept_vote = (is_p1b & (vb == get(nodes, i, 0))
+                       & (get(nodes, i, 0) % n == i)
+                       & (get(nodes, i, 1) == 0))
+        voff = sbase(i) + VOTES + frm.clip(0, n - 1) * (1 + 4 * S)
+        vrec = jnp.concatenate([jnp.ones((1,), jnp.int32),
+                                p[1:1 + 4 * S].astype(jnp.int32)])
+        cur_v = jax.lax.dynamic_slice(nodes, (voff,), (1 + 4 * S,))
+        nodes = jax.lax.dynamic_update_slice(
+            nodes, jnp.where(accept_vote, vrec, cur_v), (voff,))
+        nvotes = jnp.zeros((), jnp.int32)
+        for j in range(n):
+            nvotes = nvotes + get(nodes, i, VOTES + j * (1 + 4 * S))
+        win = accept_vote & (nvotes >= maj)
+        nodes = _p1b_win(nodes, i, win, sends, sets)
+
+        # ---- P2a (handle_P2a)
+        is_p2a = here & (tag == P2A)
+        ab, aslot, acmd = p[0], p[1], p[2]
+        ok2a = is_p2a & (ab >= get(nodes, i, 0))
+        nodes = setv(nodes, i, 1,
+                     jnp.where(ok2a & (ab > get(nodes, i, 0)), 0,
+                               get(nodes, i, 1)))
+        nodes = setv(nodes, i, 0, jnp.where(ok2a, ab, get(nodes, i, 0)))
+        nodes = setv(nodes, i, 2, jnp.where(ok2a, 1, get(nodes, i, 2)))
+        nodes = accept_p2a(nodes, i, ab, aslot, acmd, ok2a)
+        sends.add(ok2a, P2B, i, frm, [ab, aslot])
+
+        # ---- P2b (handle_P2b)
+        is_p2b = here & (tag == P2B)
+        bb, bslot = p[0], p[1]
+        lead_ok = (is_p2b & (bb == get(nodes, i, 0))
+                   & (get(nodes, i, 1) == 1) & (get(nodes, i, 0) % n == i))
+        e = log_get(nodes, i, bslot)
+        count_ok = lead_ok & (e[0] == 1) & (e[3] == 0) & (e[1] == bb)
+        p2off = sbase(i) + P2BV + (bslot - 1).clip(0, S - 1)
+        vmask = jax.lax.dynamic_index_in_dim(nodes, p2off, keepdims=False)
+        vmask2 = jnp.where(count_ok, vmask | (1 << frm.clip(0, n - 1)), vmask)
+        chosen_now = count_ok & (_popcount(vmask2) >= maj)
+        nodes = jax.lax.dynamic_update_index_in_dim(
+            nodes, jnp.where(chosen_now, 0, vmask2).astype(jnp.int32),
+            p2off, 0)
+        nodes = log_set(nodes, i, bslot, [1, e[1], e[2], 1], chosen_now)
+        nodes = _maybe_exec(nodes, i, chosen_now, sends)
+
+        # ---- Heartbeat (handle_Heartbeat)
+        is_hb = here & (tag == HB)
+        hb_b, hb_commit, hb_gc = p[0], p[1], p[2]
+        hb_ok = is_hb & (hb_b >= get(nodes, i, 0))
+        nodes = setv(nodes, i, 1,
+                     jnp.where(hb_ok & (hb_b > get(nodes, i, 0)), 0,
+                               get(nodes, i, 1)))
+        nodes = setv(nodes, i, 0, jnp.where(hb_ok, hb_b, get(nodes, i, 0)))
+        nodes = setv(nodes, i, 2, jnp.where(hb_ok, 1, get(nodes, i, 2)))
+        nodes = gc_to(nodes, i, hb_gc, hb_ok)
+        lagging = hb_ok & (get(nodes, i, 4) < hb_commit)
+        sends.add(lagging, CREQ, i, frm, [get(nodes, i, 4) + 1])
+        sends.add(hb_ok, HBR, i, frm, [get(nodes, i, 0), get(nodes, i, 4)])
+
+        # ---- HeartbeatReply (handle_HeartbeatReply)
+        is_hbr = here & (tag == HBR)
+        rb, rexec = p[0], p[1]
+        hbr_ok = (is_hbr & (rb == get(nodes, i, 0))
+                  & (get(nodes, i, 1) == 1) & (get(nodes, i, 0) % n == i))
+        poff = sbase(i) + PEER + frm.clip(0, n - 1)
+        pcur = jax.lax.dynamic_index_in_dim(nodes, poff, keepdims=False)
+        nodes = jax.lax.dynamic_update_index_in_dim(
+            nodes, jnp.where(hbr_ok, jnp.maximum(pcur, rexec),
+                             pcur).astype(jnp.int32), poff, 0)
+        mask = get(nodes, i, 7)
+        nodes = setv(nodes, i, 7,
+                     jnp.where(hbr_ok, mask | (1 << frm.clip(0, n - 1)),
+                               mask))
+        nodes = maybe_gc(nodes, i, hbr_ok)
+
+        # ---- CatchupRequest (handle_CatchupRequest)
+        is_cq = here & (tag == CREQ)
+        from_slot = jnp.maximum(p[0], get(nodes, i, 5) + 1)
+        cmds = []
+        count = jnp.zeros((), jnp.int32)
+        contiguous = jnp.asarray(True)
+        for k in range(S):
+            slot = from_slot + k
+            e = log_get(nodes, i, slot)
+            ok = (contiguous & (slot <= get(nodes, i, 4))
+                  & (e[0] == 1) & (e[3] == 1))
+            contiguous = ok
+            cmds.append(jnp.where(ok, e[2], 0))
+            count = count + ok.astype(jnp.int32)
+        sends.add(is_cq & (count > 0), CREP, i, frm,
+                  [from_slot, count] + cmds)
+
+        # ---- CatchupReply (handle_CatchupReply)
+        is_cp = here & (tag == CREP)
+        base, ccount = p[0], p[1]
+        for k in range(S):
+            slot = base + k
+            cmd = p[2 + k]
+            e = log_get(nodes, i, slot)
+            install = (is_cp & (jnp.asarray(k) < ccount)
+                       & (slot > get(nodes, i, 5))
+                       & ~((e[0] == 1) & (e[3] == 1)))
+            nodes = log_set(nodes, i, slot,
+                            [1, get(nodes, i, 0), cmd, 1], install)
+        nodes = _maybe_exec(nodes, i, is_cp, sends)
+        return nodes
+
+    def _maybe_exec(nodes, i, cond, sends):
+        return exec_chain(nodes, i, sends, cond)
+
+    def _p1b_win(nodes, i, win, sends: Sends, sets: Sets):
+        """Phase-1 victory (handle_P1b body after majority)."""
+        ballot = get(nodes, i, 0)
+        nodes = setv(nodes, i, 1, jnp.where(win, 1, get(nodes, i, 1)))
+        # p2b_votes = {}; peer_executed = {self: exec}
+        for s in range(S):
+            nodes = setv(nodes, i, P2BV + s,
+                         jnp.where(win, 0, get(nodes, i, P2BV + s)))
+        nodes = setv(nodes, i, 7,
+                     jnp.where(win, 1 << i, get(nodes, i, 7)))
+        for j in range(n):
+            nodes = setv(nodes, i, PEER + j,
+                         jnp.where(win & (jnp.asarray(j) == i),
+                                   get(nodes, i, 4),
+                                   jnp.where(win, 0, get(nodes, i, PEER + j))))
+        # Adoption: per slot, chosen wins; else max-ballot accepted.
+        for s in range(1, S + 1):
+            a_ex = jnp.zeros((), jnp.int32)
+            a_b = jnp.full((), -1, jnp.int32)
+            a_c = jnp.zeros((), jnp.int32)
+            a_ch = jnp.zeros((), jnp.int32)
+            for j in range(n):
+                vo = sbase(i) + VOTES + j * (1 + 4 * S)
+                have = nodes[vo]
+                ex = nodes[vo + 1 + 4 * (s - 1) + 0]
+                vb = nodes[vo + 1 + 4 * (s - 1) + 1]
+                vc = nodes[vo + 1 + 4 * (s - 1) + 2]
+                vch = nodes[vo + 1 + 4 * (s - 1) + 3]
+                valid = (have == 1) & (ex == 1)
+                take = valid & ((vch == 1) & (a_ch == 0)
+                                | (a_ch == 0) & ((a_ex == 0) | (vb > a_b)))
+                a_b = jnp.where(take, vb, a_b)
+                a_c = jnp.where(take, vc, a_c)
+                a_ch = jnp.where(take, jnp.maximum(a_ch, vch), a_ch)
+                a_ex = jnp.where(take, 1, a_ex)
+            mine = log_get(nodes, i, jnp.asarray(s))
+            adopt = win & (a_ex == 1) & (jnp.asarray(s) > get(nodes, i, 5)) \
+                & ~((mine[0] == 1) & (mine[3] == 1))
+            nodes = log_set(nodes, i, jnp.asarray(s),
+                            [1, ballot, a_c, a_ch], adopt)
+        # top = last non-empty; fill holes with no-ops; repropose unchosen.
+        top = get(nodes, i, 5)
+        for s in range(1, S + 1):
+            e = log_get(nodes, i, jnp.asarray(s))
+            top = jnp.where(e[0] == 1, jnp.asarray(s, jnp.int32), top)
+        for s in range(1, S + 1):
+            e = log_get(nodes, i, jnp.asarray(s))
+            in_span = win & (jnp.asarray(s) > get(nodes, i, 4)) & (jnp.asarray(s) <= top)
+            fill = in_span & (e[0] == 0)
+            nodes = log_set(nodes, i, jnp.asarray(s), [1, ballot, 0, 0], fill)
+            e2 = log_get(nodes, i, jnp.asarray(s))
+            reprop = in_span & (e2[3] == 0)
+            nodes = send_p2a(nodes, i, jnp.asarray(s, jnp.int32), sends, reprop)
+        nodes = setv(nodes, i, 3, jnp.where(win, top + 1, get(nodes, i, 3)))
+        # proposed_seq from logged commands (max seq per client).
+        for c in range(NC):
+            best = jnp.zeros((), jnp.int32)
+            for s in range(1, S + 1):
+                e = log_get(nodes, i, jnp.asarray(s))
+                mine_c = (e[0] == 1) & (e[2] != 0) & (cmd_client(e[2]) == c)
+                best = jnp.where(mine_c, jnp.maximum(best, cmd_seq(e[2])), best)
+            nodes = setv(nodes, i, PROP + c,
+                         jnp.where(win, best, get(nodes, i, PROP + c)))
+        nodes = _maybe_exec(nodes, i, win, sends)
+        sets.add(win, i, T_HEARTBEAT, HEARTBEAT_MS, HEARTBEAT_MS, ballot)
+        heartbeat_sends(nodes, i, sends, win)
+        return nodes
+
+    def _client_handle(nodes, c, here, tag, p, sends: Sends, sets: Sets):
+        koff = n * SW + c
+        k = nodes[koff]
+        is_reply = here & (tag == REPLY) & (p[0] == c)
+        match = is_reply & (p[1] == k) & (k <= w)
+        k2 = jnp.where(match, k + 1, k)
+        nodes = nodes.at[koff].set(k2)
+        has_next = match & (k2 <= w)
+        for j in range(n):
+            sends.add(has_next, REQ, n + c, j, [jnp.asarray(c), k2])
+        sets.add(has_next, n + c, T_CLIENT, CLIENT_MS, CLIENT_MS, k2)
+        return nodes
+
+    # ------------------------------------------------------- timer handlers
+
+    def step_timer(nodes, node_idx, timer):
+        tag, p0 = timer[0], timer[3]
+        out = nodes
+        srv_rows, srv_sets = None, None
+        for i in range(n):
+            here = node_idx == i
+            sends, sets = Sends(), Sets()
+            out = _server_timer(out, i, here, tag, p0, sends, sets)
+            r, t = sends.finalize(SRV_SENDS), sets.finalize(SRV_SETS)
+            srv_rows = r if srv_rows is None else jnp.minimum(srv_rows, r)
+            srv_sets = t if srv_sets is None else jnp.minimum(srv_sets, t)
+        cli_rows, cli_sets = None, None
+        for c in range(NC):
+            here = node_idx == n + c
+            sends, sets = Sends(), Sets()
+            koff = n * SW + c
+            k = out[koff]
+            live = here & (tag == T_CLIENT) & (p0 == k) & (k <= w)
+            for j in range(n):
+                sends.add(live, REQ, n + c, j, [jnp.asarray(c), k])
+            sets.add(live, n + c, T_CLIENT, CLIENT_MS, CLIENT_MS, k)
+            r, t = sends.finalize(CLI_SENDS), sets.finalize(CLI_SETS)
+            cli_rows = r if cli_rows is None else jnp.minimum(cli_rows, r)
+            cli_sets = t if cli_sets is None else jnp.minimum(cli_sets, t)
+        rows = jnp.concatenate([srv_rows, cli_rows])
+        tsets = jnp.concatenate([srv_sets, cli_sets])
+        return out, rows, tsets
+
+    def _server_timer(nodes, i, here, tag, p0, sends: Sends, sets: Sets):
+        ballot = get(nodes, i, 0)
+        is_leader = (get(nodes, i, 1) == 1) & (ballot % n == i)
+
+        # ---- ElectionTimer (on_ElectionTimer + _start_election inline)
+        is_el = here & (tag == T_ELECTION)
+        elect = is_el & ~is_leader & (get(nodes, i, 2) == 0)
+        new_ballot = (ballot // n + 1) * n + i
+        nodes = setv(nodes, i, 0, jnp.where(elect, new_ballot, get(nodes, i, 0)))
+        nodes = setv(nodes, i, 1, jnp.where(elect, 0, get(nodes, i, 1)))
+        for j in range(n):
+            vo = sbase(i) + VOTES + j * (1 + 4 * S)
+            cur = jax.lax.dynamic_slice(nodes, (vo,), (1 + 4 * S,))
+            nodes = jax.lax.dynamic_update_slice(
+                nodes, jnp.where(elect, jnp.zeros_like(cur), cur), (vo,))
+        for j in range(n):
+            if j == i:
+                continue
+            sends.add(elect, P1A, i, j, [new_ballot])
+        # Self-promise: own vote with own log (P1a -> P1b self-delivery).
+        log_flat = jax.lax.dynamic_slice(nodes, (sbase(i) + LOG,), (4 * S,))
+        vo = sbase(i) + VOTES + i * (1 + 4 * S)
+        own = jnp.concatenate([jnp.ones((1,), jnp.int32), log_flat])
+        cur = jax.lax.dynamic_slice(nodes, (vo,), (1 + 4 * S,))
+        nodes = jax.lax.dynamic_update_slice(
+            nodes, jnp.where(elect, own, cur), (vo,))
+        # (majority with one vote only when n == 1 — not modelled here)
+        nodes = setv(nodes, i, 2, jnp.where(is_el, 0, get(nodes, i, 2)))
+        sets.add(is_el, i, T_ELECTION, ELECTION_MIN, ELECTION_MAX, 0)
+
+        # ---- HeartbeatTimer (on_HeartbeatTimer)
+        is_hbt = here & (tag == T_HEARTBEAT)
+        live = is_hbt & (p0 == get(nodes, i, 0)) & is_leader
+        heartbeat_sends(nodes, i, sends, live)
+        for s in range(1, S + 1):
+            e = log_get(nodes, i, jnp.asarray(s))
+            inflight = (live & (jnp.asarray(s) > get(nodes, i, 4))
+                        & (jnp.asarray(s) < get(nodes, i, 3))
+                        & (e[0] == 1) & (e[3] == 0))
+            nodes = send_p2a(nodes, i, jnp.asarray(s, jnp.int32), sends,
+                             inflight)
+        sets.add(live, i, T_HEARTBEAT, HEARTBEAT_MS, HEARTBEAT_MS, p0)
+        return nodes
+
+    # ------------------------------------------------------------ initials
+
+    def init_nodes():
+        nodes = np.zeros((NW,), np.int32)
+        for i in range(n):
+            nodes[sbase(i) + 3] = 1  # slot_in = 1
+        for c in range(NC):
+            nodes[n * SW + c] = 1    # first command in flight
+        return nodes
+
+    def init_messages():
+        msgs = []
+        for c in range(NC):
+            for j in range(n):
+                rec = np.zeros((MW,), np.int32)
+                rec[0:3] = [REQ, n + c, j]
+                rec[3:5] = [c, 1]
+                msgs.append(rec)
+        return np.stack(msgs)
+
+    def init_timers():
+        recs = []
+        for i in range(n):
+            recs.append([i, T_ELECTION, ELECTION_MIN, ELECTION_MAX, 0])
+        for c in range(NC):
+            recs.append([n + c, T_CLIENT, CLIENT_MS, CLIENT_MS, 1])
+        return np.array(recs, np.int32)
+
+    def msg_dest(msg):
+        return msg[2]
+
+    # ----------------------------------------------------------- predicates
+
+    def clients_done(state):
+        done = jnp.asarray(True)
+        for c in range(NC):
+            done = done & (state["nodes"][n * SW + c] == w + 1)
+        return done
+
+    def none_decided(state):
+        nd = jnp.asarray(True)
+        for c in range(NC):
+            nd = nd & (state["nodes"][n * SW + c] == 1)
+        return nd
+
+    def logs_consistent(state):
+        """slotValid core: no two different commands chosen in a slot."""
+        ok = jnp.asarray(True)
+        nodes = state["nodes"]
+        for s in range(1, S + 1):
+            chosen_cmd = jnp.full((), -1, jnp.int32)
+            seen = jnp.zeros((), jnp.int32)
+            bad = jnp.asarray(False)
+            for i in range(n):
+                e0 = nodes[sbase(i) + LOG + 4 * (s - 1)]
+                ech = nodes[sbase(i) + LOG + 4 * (s - 1) + 3]
+                ec = nodes[sbase(i) + LOG + 4 * (s - 1) + 2]
+                is_ch = (e0 == 1) & (ech == 1)
+                bad = bad | (is_ch & (seen == 1) & (ec != chosen_cmd))
+                chosen_cmd = jnp.where(is_ch, ec, chosen_cmd)
+                seen = jnp.where(is_ch, 1, seen)
+            ok = ok & ~bad
+        return ok
+
+    return TensorProtocol(
+        name=f"paxos-n{n}-c{NC}-w{w}-s{S}",
+        n_nodes=N_NODES,
+        node_width=NW,
+        msg_width=MW,
+        timer_width=TW,
+        net_cap=net_cap,
+        timer_cap=timer_cap,
+        max_sends=MAX_SENDS,
+        max_sets=MAX_SETS,
+        init_nodes=init_nodes,
+        init_messages=init_messages,
+        init_timers=init_timers,
+        step_message=step_message,
+        step_timer=step_timer,
+        msg_dest=msg_dest,
+        invariants={"LOGS_CONSISTENT": logs_consistent},
+        goals={"CLIENTS_DONE": clients_done},
+    )
+
+
+def _popcount(x):
+    x = x.astype(jnp.uint32)
+    x = x - ((x >> 1) & 0x55555555)
+    x = (x & 0x33333333) + ((x >> 2) & 0x33333333)
+    x = (x + (x >> 4)) & 0x0F0F0F0F
+    return ((x * 0x01010101) >> 24).astype(jnp.int32)

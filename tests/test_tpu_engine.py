@@ -78,3 +78,35 @@ def test_exhaustive_unique_state_parity(w):
     assert ten.unique_states == obj.discovered_count, (
         f"object discovered {obj.discovered_count}, "
         f"tensor discovered {ten.unique_states}")
+
+
+@pytest.mark.skipif(not __import__("os").environ.get("DSLABS_SLOW_TESTS"),
+                    reason="multi-minute XLA compile; set DSLABS_SLOW_TESTS=1")
+def test_paxos_depth_parity():
+    """Depth-limited unique-state parity on lab 3 multi-Paxos (3 servers,
+    1 client, 1 command): verified by hand for depths 1-6
+    (6/25/102/427/1803/7540); CI checks depth 3."""
+    from dslabs_tpu.labs.clientserver.kv_workload import kv_workload
+    from dslabs_tpu.labs.clientserver.kvstore import KVStore
+    from dslabs_tpu.labs.paxos.paxos import PaxosClient, PaxosServer
+    from dslabs_tpu.search.search import BFS
+    from dslabs_tpu.tpu.protocols.paxos import make_paxos_protocol
+
+    servers = tuple(LocalAddress(f"server{i}") for i in range(1, 4))
+    gen = NodeGenerator(
+        server_supplier=lambda a: PaxosServer(a, servers, KVStore()),
+        client_supplier=lambda a: PaxosClient(a, servers),
+        workload_supplier=lambda a: None)
+    st = SearchState(gen)
+    for a in servers:
+        st.add_server(a)
+    st.add_client_worker(LocalAddress("client0"),
+                         kv_workload(["PUT:key-0:v1"], ["PutOk"]))
+    settings = SearchSettings()
+    settings.set_max_depth(3).max_time(300)
+    obj = BFS(settings).run(st)
+
+    p = make_paxos_protocol(n=3, n_clients=1, w=1, max_slots=2,
+                            net_cap=48, timer_cap=6)
+    ten = TensorSearch(p, chunk=256, max_depth=3).run()
+    assert ten.unique_states == obj.discovered_count == 102
