@@ -1,0 +1,46 @@
+"""Benchmark: lab3 multi-Paxos BFS unique-states/minute on the TPU tensor
+backend (BASELINE.md north star: >= 1e8 unique lab3-paxos states/min on a
+v5e-8; this runs on whatever single chip the driver provides).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+BASELINE_STATES_PER_MIN = 1e8
+
+
+def main() -> None:
+    import jax
+
+    from dslabs_tpu.tpu.engine import TensorSearch
+    from dslabs_tpu.tpu.protocols.paxos import make_paxos_protocol
+
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    # Two clients widen the space enough to sustain large frontiers.
+    protocol = make_paxos_protocol(n=3, n_clients=2, w=1, max_slots=3,
+                                   net_cap=64, timer_cap=6)
+    chunk = 2048 if on_tpu else 256
+    search = TensorSearch(protocol, frontier_cap=1 << 22, chunk=chunk,
+                          max_depth=1)
+    search.run()  # warm-up: compiles the level program
+
+    search.max_depth = 64
+    search.max_secs = 120.0 if on_tpu else 60.0
+    t0 = time.time()
+    outcome = search.run()
+    elapsed = max(time.time() - t0, 1e-9)
+    states_per_min = outcome.unique_states / elapsed * 60.0
+    print(json.dumps({
+        "metric": "lab3-paxos BFS unique states/min (tensor backend, "
+                  f"{'tpu' if on_tpu else jax.devices()[0].platform})",
+        "value": round(states_per_min, 1),
+        "unit": "states/min",
+        "vs_baseline": round(states_per_min / BASELINE_STATES_PER_MIN, 6),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

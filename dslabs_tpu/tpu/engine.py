@@ -251,11 +251,13 @@ class TensorSearch:
     def __init__(self, protocol: TensorProtocol,
                  frontier_cap: int = 1 << 16,
                  chunk: int = 1 << 12,
-                 max_depth: Optional[int] = None):
+                 max_depth: Optional[int] = None,
+                 max_secs: Optional[float] = None):
         self.p = protocol
         self.frontier_cap = frontier_cap
         self.chunk = chunk
         self.max_depth = max_depth
+        self.max_secs = max_secs
         self._expand = jax.jit(self._expand_chunk)
 
     # ------------------------------------------------------------- plumbing
@@ -381,6 +383,10 @@ class TensorSearch:
         while frontier_n > 0:
             if self.max_depth is not None and depth >= self.max_depth:
                 return SearchOutcome("DEPTH_EXHAUSTED", explored,
+                                     len(visited[0]), depth,
+                                     time.time() - t0)
+            if self.max_secs is not None and time.time() - t0 > self.max_secs:
+                return SearchOutcome("TIME_EXHAUSTED", explored,
                                      len(visited[0]), depth,
                                      time.time() - t0)
             depth += 1

@@ -1,0 +1,158 @@
+"""Multi-chip sharded BFS level step (SPMD over a jax.sharding.Mesh).
+
+Scaling design (SURVEY §2.10, §5): the frontier is data-parallel over the
+``search`` mesh axis; every device expands its shard with the same vmapped
+transition the single-chip engine uses, then successors are exchanged by
+**fingerprint ownership** (device = h1 mod D) with ``lax.all_to_all`` over
+ICI so each device deduplicates exactly the keys it owns against its own
+visited shard.  Collectives: one all_to_all for the routed successor
+records + fingerprints, and psums for the level statistics — the classic
+hash-partitioned distributed BFS, mapped onto XLA collectives instead of
+the reference's shared-memory ConcurrentHashMap (Search.java:405-505).
+
+The routed exchange uses fixed-capacity buckets (OVERFLOW_FACTOR x the
+balanced share) — hash partitioning balances well; overflowed records are
+counted (psum) so callers can detect loss rather than silently undercount.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dslabs_tpu.tpu.engine import SENTINEL, TensorProtocol, TensorSearch
+
+__all__ = ["ShardedTensorSearch", "make_mesh"]
+
+OVERFLOW_FACTOR = 2
+
+
+def make_mesh(n_devices: int = None, axis: str = "search") -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None and len(devs) < n_devices:
+        # Fewer accelerators than requested: use the virtual host-CPU
+        # devices (--xla_force_host_platform_device_count) — the dry-run
+        # path for multi-chip shardings on single-chip machines.
+        devs = jax.devices("cpu")
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise RuntimeError(
+                f"need {n_devices} devices, have {len(devs)} "
+                "(set --xla_force_host_platform_device_count)")
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+class ShardedTensorSearch(TensorSearch):
+    """BFS driver whose level expansion runs SPMD over a device mesh.
+
+    The host loop (frontier compaction, visited merging, termination) is
+    inherited; only the hot expand + ownership routing is sharded."""
+
+    def __init__(self, protocol: TensorProtocol, mesh: Mesh,
+                 chunk_per_device: int = 1 << 10, **kwargs):
+        self.mesh = mesh
+        self.axis = mesh.axis_names[0]
+        self.n_devices = mesh.devices.size
+        super().__init__(protocol, chunk=chunk_per_device * self.n_devices,
+                         **kwargs)
+        self._sharded_expand = self._build_sharded_expand(chunk_per_device)
+
+    # ----------------------------------------------------------- level step
+
+    def _build_sharded_expand(self, cpd: int):
+        p = self.p
+        ne = self._num_events()
+        D = self.n_devices
+        ax = self.axis
+        bucket = (cpd * ne // D + 1) * OVERFLOW_FACTOR
+        lanes = (p.node_width + p.net_cap * p.msg_width
+                 + p.n_nodes * p.timer_cap * p.timer_width)
+
+        def flatten_state(s):
+            m = s["nodes"].shape[0]
+            return jnp.concatenate(
+                [s["nodes"].reshape(m, -1), s["net"].reshape(m, -1),
+                 s["timers"].reshape(m, -1)], axis=1)
+
+        def local_step(chunk_state, chunk_valid):
+            """Runs on ONE device over its [cpd] shard of the chunk."""
+            flat, valids, h1, h2, flags = self._expand_chunk(
+                chunk_state, chunk_valid)
+            rows = flatten_state(flat)
+
+            # Ownership routing: bucket successors by h1 mod D.
+            owner = (h1 % D).astype(jnp.int32)
+            owner = jnp.where(valids, owner, D)  # invalid -> dropped
+            # Stable sort by owner so each destination's records are
+            # contiguous; then scatter into [D, bucket] send buffers.
+            order = jnp.argsort(owner, stable=True)
+            owner_s = owner[order]
+            rows_s = rows[order]
+            h1_s, h2_s = h1[order], h2[order]
+            # Position of each record within its destination bucket.
+            idx_in_bucket = jnp.arange(owner_s.shape[0]) - jnp.searchsorted(
+                owner_s, owner_s, side="left")
+            fits = (owner_s < D) & (idx_in_bucket < bucket)
+            dropped = jnp.sum((owner_s < D) & ~fits)
+            # Column `bucket` is a write-off slot for non-fitting rows so
+            # they cannot clobber real records; it is dropped below.
+            send_rows = jnp.full((D, bucket + 1, lanes), SENTINEL, rows.dtype)
+            send_h1 = jnp.full((D, bucket + 1), jnp.int64(2 ** 62), jnp.int64)
+            send_h2 = jnp.zeros((D, bucket + 1), jnp.int64)
+            dst = owner_s.clip(0, D - 1)
+            slot = jnp.where(fits, idx_in_bucket, bucket).clip(0, bucket)
+            send_rows = send_rows.at[dst, slot].set(rows_s)
+            send_h1 = send_h1.at[dst, slot].set(
+                jnp.where(fits, h1_s, jnp.int64(2 ** 62)))
+            send_h2 = send_h2.at[dst, slot].set(jnp.where(fits, h2_s, 0))
+            send_rows = send_rows[:, :bucket]
+            send_h1 = send_h1[:, :bucket]
+            send_h2 = send_h2[:, :bucket]
+
+            # The exchange: every device receives the bucket destined to it
+            # from every other device (ICI all-to-all).
+            recv_rows = jax.lax.all_to_all(send_rows, ax, 0, 0, tiled=False)
+            recv_h1 = jax.lax.all_to_all(send_h1, ax, 0, 0, tiled=False)
+            recv_h2 = jax.lax.all_to_all(send_h2, ax, 0, 0, tiled=False)
+            recv_rows = recv_rows.reshape(D * bucket, lanes)
+            recv_h1 = recv_h1.reshape(D * bucket)
+            recv_h2 = recv_h2.reshape(D * bucket)
+
+            # Local owner-side dedup: sort by key, keep first occurrences.
+            o = jnp.lexsort((recv_h2, recv_h1))
+            rh1, rh2 = recv_h1[o], recv_h2[o]
+            first = jnp.ones(rh1.shape[0], bool).at[1:].set(
+                (rh1[1:] != rh1[:-1]) | (rh2[1:] != rh2[:-1]))
+            valid_recv = rh1 < jnp.int64(2 ** 62)
+            unique = first & valid_recv
+            n_explored = jnp.sum(valids)
+            # Cross-device stats ride the ICI as psums.
+            totals = {
+                "explored": jax.lax.psum(n_explored, ax),
+                "routed_unique": jax.lax.psum(jnp.sum(unique), ax),
+                "dropped": jax.lax.psum(dropped, ax),
+            }
+            flag_any = {k: jax.lax.psum(jnp.sum(v), ax)
+                        for k, v in flags.items()}
+            return (recv_rows[o], rh1, rh2, unique, totals, flag_any)
+
+        in_specs = (
+            {"nodes": P(ax), "net": P(ax), "timers": P(ax)}, P(ax))
+        out_specs = (P(ax), P(ax), P(ax), P(ax), P(), P())
+        fn = shard_map(local_step, mesh=self.mesh,
+                       in_specs=in_specs, out_specs=out_specs,
+                       check_rep=False)
+        return jax.jit(fn)
+
+    def level_step(self, chunk_state, chunk_valid):
+        """One sharded BFS level step over the mesh (the 'training step' of
+        this framework: expand + route + dedup + reduce)."""
+        with self.mesh:
+            return self._sharded_expand(chunk_state, chunk_valid)
