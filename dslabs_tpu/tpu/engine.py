@@ -8,7 +8,7 @@ program:
 
   frontier [N, ...]  --(enumerate events x vmapped transition)-->
   successors [N*E, ...] --(canonicalise + 128-bit fingerprint)-->
-  dedup (sort-unique + sorted-visited membership) --> next frontier
+  dedup (device sort-unique + host sorted-visited membership) --> frontier'
 
 Checker semantics reproduced exactly (SURVEY §7):
   * the network is a SET of fixed-width message records, kept in canonical
@@ -19,8 +19,21 @@ Checker semantics reproduced exactly (SURVEY §7):
     no earlier-queued timer t' has t.min >= t'.max (TimerQueue.java:66-105),
     computed as a vectorised prefix-min; firing removes the timer;
   * dedup happens on successor generation, pre-check (Search.java:485);
-    equivalence keys on (node lanes, network set, timer queues) via a
-    128-bit fingerprint (hash compaction; collision odds ~n^2 / 2^128).
+    equivalence keys on (node lanes, network set, timer queues, exception
+    lane) via a 128-bit fingerprint (hash compaction; collision odds
+    ~n^2 / 2^128);
+  * guard failures in a tensor twin set a terminal per-state exception code
+    that participates in equivalence (SearchState.java:594-596, SURVEY
+    §8.4.7) and ends the search with EXCEPTION_THROWN (checkState order:
+    exception strictly first, Search.java:162-231).
+
+All device arithmetic is int32/uint32 — TPUs have no native int64 and the
+round-1 bench crashed inside the x64-emulated fingerprint path.  The two
+64-bit fingerprints live on device as paired uint32 lanes `[N, 4]`
+(a_hi, a_lo, b_hi, b_lo); only host-side NumPy packs them into uint64 for
+the sorted visited set.  Capacity overflow (network set or timer queue) is
+counted on device and surfaced as a loud ``CapacityOverflow`` error rather
+than silently corrupting state counts (SURVEY §8.4.2).
 
 The engine is protocol-agnostic: a :class:`TensorProtocol` supplies packed
 node-state lanes and a pure ``step(state, event)`` transition; the engine
@@ -33,24 +46,27 @@ fingerprints by hash ownership (see ``dslabs_tpu/tpu/sharded.py``).
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
-
-# 64-bit fingerprints need x64 lanes (TPU emulates int64; the fingerprint
-# arithmetic is a tiny fraction of the level step).
-jax.config.update("jax_enable_x64", True)
-
-import jax.numpy as jnp  # noqa: E402
-import numpy as np  # noqa: E402
+import jax.numpy as jnp
+import numpy as np
 
 __all__ = ["TensorProtocol", "TensorState", "TensorSearch", "SearchOutcome",
-           "SENTINEL"]
+           "CapacityOverflow", "SENTINEL"]
 
 # Empty slots in the network / timer arrays hold SENTINEL in every lane, so
 # they sort after every real record and hash consistently.
 SENTINEL = np.int32(2 ** 31 - 1)
+
+
+class CapacityOverflow(RuntimeError):
+    """A fixed-capacity structure (network set / timer queue) overflowed.
+
+    The reference's structures are unbounded; the tensor twin's are sized
+    per protocol.  Overflow would silently corrupt verdicts and state
+    counts, so the engine counts drops on device and aborts loudly
+    (SURVEY §8.4.2 "fail loudly on bound overflow")."""
 
 
 # --------------------------------------------------------------------- state
@@ -63,6 +79,7 @@ class TensorState(Dict[str, jnp.ndarray]):
     timers [N, NN, T_CAP, TW] int32 — per-node timer queues, insertion order
                                       (lane 0 = tag, lane 1 = min, lane 2 =
                                       max, rest payload)
+    exc    [N]                int32 — terminal exception code (0 = none)
     """
 
 
@@ -72,12 +89,14 @@ class TensorProtocol:
 
     The transition functions operate on ONE state (the engine vmaps them):
 
-    ``step_message(nodes, msg) -> (nodes', sends, new_timers)``
-    ``step_timer(nodes, node_idx, timer) -> (nodes', sends, new_timers)``
+    ``step_message(nodes, msg) -> (nodes', sends, new_timers[, exc])``
+    ``step_timer(nodes, node_idx, timer) -> (nodes', sends, new_timers[, exc])``
 
-    where ``sends`` is ``[MAX_SENDS, MW]`` with invalid rows = SENTINEL and
+    where ``sends`` is ``[MAX_SENDS, MW]`` with invalid rows = SENTINEL,
     ``new_timers`` is ``[MAX_SETS, 1 + TW]`` (leading lane = target node
-    index, SENTINEL rows invalid).
+    index, SENTINEL rows invalid), and the optional trailing ``exc`` is an
+    int32 exception code (0 = none) — the tensor analog of a handler
+    throwing (SearchState.java:218-222).
     """
 
     name: str
@@ -108,8 +127,9 @@ class TensorProtocol:
 @dataclasses.dataclass
 class SearchOutcome:
     end_condition: str               # GOAL_FOUND / INVARIANT_VIOLATED /
-                                     # SPACE_EXHAUSTED / CAPACITY_EXHAUSTED /
-                                     # DEPTH_EXHAUSTED
+                                     # EXCEPTION_THROWN / SPACE_EXHAUSTED /
+                                     # CAPACITY_EXHAUSTED / DEPTH_EXHAUSTED /
+                                     # TIME_EXHAUSTED
     states_explored: int
     unique_states: int
     depth: int
@@ -117,42 +137,92 @@ class SearchOutcome:
     violating_state: Optional[dict] = None
     goal_state: Optional[dict] = None
     predicate_name: Optional[str] = None
+    exception_code: int = 0
+    trace: Optional[list] = None     # [(parent event id, ...)] — see trace.py
 
 
 # ----------------------------------------------------------------- hashing
 
 def _mix32(x: jnp.ndarray, seed: jnp.ndarray) -> jnp.ndarray:
-    """xorshift-multiply mixer over int32 lanes (vectorised)."""
+    """xorshift-multiply mixer over int32 lanes (vectorised, uint32 only)."""
     x = x.astype(jnp.uint32) ^ (seed.astype(jnp.uint32) * jnp.uint32(0x9E3779B9))
     x = (x ^ (x >> 16)) * jnp.uint32(0x85EBCA6B)
     x = (x ^ (x >> 13)) * jnp.uint32(0xC2B2AE35)
     return x ^ (x >> 16)
 
 
-def _fingerprint(flat: jnp.ndarray, seed: int) -> jnp.ndarray:
-    """64-bit fingerprint of [N, L] int32 rows -> [N] int64.
+def _fingerprint32(flat: jnp.ndarray, seed: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """64-bit fingerprint of [N, L] int32 rows as a (hi, lo) uint32 pair.
 
     Sequential-free: each lane is mixed with its position and a seed, then
     lanes are combined with addition and a final avalanche (order within the
-    row still matters via the positional term)."""
-    n, l = flat.shape
+    row still matters via the positional term).  No int64 anywhere — TPU
+    native dtypes only."""
+    _, l = flat.shape
     pos = jnp.arange(l, dtype=jnp.uint32)[None, :] + jnp.uint32(seed * 0x1000193)
     h = _mix32(flat, pos)
     lo = jnp.sum(h, axis=1, dtype=jnp.uint32)
     hi = jnp.sum(_mix32(h, pos + jnp.uint32(0x27D4EB2F)), axis=1,
                  dtype=jnp.uint32)
-    return (hi.astype(jnp.int64) << 32) | lo.astype(jnp.int64)
+    return hi, lo
 
 
-def state_fingerprints(state: dict) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Two independent 64-bit fingerprints per state (128-bit key)."""
+def row_fingerprints(flat: jnp.ndarray) -> jnp.ndarray:
+    """[N, L] int32 rows -> [N, 4] uint32 (a_hi, a_lo, b_hi, b_lo): two
+    independent 64-bit fingerprints = one 128-bit equivalence key."""
+    a_hi, a_lo = _fingerprint32(flat, 1)
+    b_hi, b_lo = _fingerprint32(flat, 2)
+    return jnp.stack([a_hi, a_lo, b_hi, b_lo], axis=1)
+
+
+def flatten_state(state: dict) -> jnp.ndarray:
+    """[N]-batch state pytree -> [N, L] int32 rows (the hash preimage).
+    The exception lane participates — exception states are equivalence-
+    distinct from normal ones (SearchState.java:594-596)."""
     n = state["nodes"].shape[0]
-    flat = jnp.concatenate([
+    return jnp.concatenate([
         state["nodes"].reshape(n, -1),
         state["net"].reshape(n, -1),
         state["timers"].reshape(n, -1),
+        state["exc"].reshape(n, 1),
     ], axis=1)
-    return _fingerprint(flat, 1), _fingerprint(flat, 2)
+
+
+def state_fingerprints(state: dict) -> jnp.ndarray:
+    """[N]-batch -> [N, 4] uint32 128-bit equivalence keys."""
+    return row_fingerprints(flatten_state(state))
+
+
+def host_keys(fp: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """[N, 4] uint32 device fingerprints -> host (h1, h2) uint64 arrays.
+    x64 lives only here, in host NumPy (TPUs emulate int64; round 1's
+    global ``jax_enable_x64`` crashed the TPU worker)."""
+    fp = np.asarray(fp, dtype=np.uint64)
+    h1 = (fp[:, 0] << np.uint64(32)) | fp[:, 1]
+    h2 = (fp[:, 2] << np.uint64(32)) | fp[:, 3]
+    return h1, h2
+
+
+def sorted_member(vh1: np.ndarray, vh2: np.ndarray,
+                  h1: np.ndarray, h2: np.ndarray) -> np.ndarray:
+    """Membership of query keys (h1, h2) in a visited set sorted by
+    (h1, h2).  Scans forward over the full run of equal h1 (not a fixed
+    2-slot probe), so >=3-way 64-bit collisions cannot cause re-exploration
+    (round-1 advisor finding)."""
+    seen = np.zeros(len(h1), dtype=bool)
+    if not len(vh1):
+        return seen
+    pos = np.searchsorted(vh1, h1, side="left")
+    off = 0
+    while True:
+        q = pos + off
+        inb = q < len(vh1)
+        qc = np.where(inb, q, 0)
+        eq1 = inb & (vh1[qc] == h1)
+        if not eq1.any():
+            return seen
+        seen |= eq1 & (vh2[qc] == h2)
+        off += 1
 
 
 # ------------------------------------------------------------ net/timer ops
@@ -161,37 +231,38 @@ def canonicalize_net(net: jnp.ndarray) -> jnp.ndarray:
     """Sort the message set into canonical order and collapse duplicates.
 
     [CAP, MW] -> [CAP, MW]; empty rows are all-SENTINEL and sort last.
-    Records are ordered by their packed fingerprint (any total order works
-    for canonicalisation as long as it is content-determined)."""
-    cap, mw = net.shape
+    Records are ordered by their packed 128-bit fingerprint (any total
+    order works for canonicalisation as long as it is content-determined)."""
 
     def keys(rows):
         empty = rows[:, 0] == SENTINEL
-        return empty, _fingerprint(rows, 3), _fingerprint(rows, 4)
+        return empty, row_fingerprints(rows)
 
-    empty, key1, key2 = keys(net)
+    empty, k = keys(net)
     # lexsort: LAST key is primary — empty rows always sort to the back.
-    order = jnp.lexsort((key2, key1, empty))
+    order = jnp.lexsort((k[:, 3], k[:, 2], k[:, 1], k[:, 0], empty))
     net = net[order]
-    key1, key2, empty = key1[order], key2[order], empty[order]
-    dup = jnp.zeros(cap, dtype=bool).at[1:].set(
-        (key1[1:] == key1[:-1]) & (key2[1:] == key2[:-1]) & ~empty[1:])
+    k, empty = k[order], empty[order]
+    dup = jnp.zeros(net.shape[0], dtype=bool).at[1:].set(
+        jnp.all(k[1:] == k[:-1], axis=1) & ~empty[1:])
     net = jnp.where(dup[:, None], SENTINEL, net)
     # One more sort pushes the duplicate-cleared rows to the back.
-    empty, key1, key2 = keys(net)
-    order = jnp.lexsort((key2, key1, empty))
+    empty, k = keys(net)
+    order = jnp.lexsort((k[:, 3], k[:, 2], k[:, 1], k[:, 0], empty))
     return net[order]
 
 
-def insert_messages(net: jnp.ndarray, sends: jnp.ndarray) -> jnp.ndarray:
+def insert_messages(net: jnp.ndarray,
+                    sends: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Set-insert up to MAX_SENDS records into the canonical network.
 
-    Concatenate, canonicalise (dedup), and truncate back to capacity.  A
-    genuine overflow would silently drop the largest-keyed record; protocols
-    size NET_CAP so this cannot happen within the searched depth."""
+    Returns ``(net', overflow)`` where overflow counts distinct occupied
+    records that did not fit back into capacity — the caller surfaces any
+    nonzero count as a CapacityOverflow (never a silent truncation)."""
     cap = net.shape[0]
-    combined = jnp.concatenate([net, sends], axis=0)
-    return canonicalize_net(combined)[:cap]
+    combined = canonicalize_net(jnp.concatenate([net, sends], axis=0))
+    overflow = jnp.sum(combined[cap:, 0] != SENTINEL).astype(jnp.int32)
+    return combined[:cap], overflow
 
 
 def timer_deliverable_mask(queue: jnp.ndarray) -> jnp.ndarray:
@@ -218,46 +289,71 @@ def remove_timer(queue: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     return jnp.where((pos >= idx)[:, None], shifted, queue)
 
 
-def append_timers(timers: jnp.ndarray, new_timers: jnp.ndarray) -> jnp.ndarray:
+def append_timers(timers: jnp.ndarray,
+                  new_timers: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Append [MAX_SETS, 1+TW] records (lane 0 = node idx) to the per-node
-    queues [NN, T_CAP, TW], preserving insertion order."""
-    nn, cap, tw = timers.shape
+    queues [NN, T_CAP, TW], preserving insertion order.  Returns
+    ``(timers', dropped)`` — a full queue drops the append (insertion order
+    is semantic, clobbering would corrupt the partial order) and the drop
+    count is surfaced loudly by the engine."""
+    _, cap, _ = timers.shape
 
-    def one_append(tmrs, rec):
+    def one_append(carry, rec):
+        tmrs, dropped = carry
         node = rec[0]
-        # A full queue DROPS the append rather than clobbering the last
-        # slot — insertion order is semantic.  Protocols must size
-        # timer_cap for the searched depth (as with NET_CAP overflow).
-        def body(t):
+
+        def body(carry):
+            t, d = carry
             q = t[node]
             count = jnp.sum(q[:, 0] != SENTINEL)
             has_room = count < cap
             q = q.at[count.clip(0, cap - 1)].set(
                 jnp.where(has_room, rec[1:], q[count.clip(0, cap - 1)]))
-            return t.at[node].set(q)
-        return jax.lax.cond(rec[0] != SENTINEL, body, lambda t: t, tmrs), None
+            return (t.at[node].set(q),
+                    d + jnp.where(has_room, 0, 1).astype(jnp.int32))
 
-    timers, _ = jax.lax.scan(one_append, timers, new_timers)
-    return timers
+        return jax.lax.cond(rec[0] != SENTINEL, body,
+                            lambda c: c, (tmrs, dropped)), None
+
+    (timers, dropped), _ = jax.lax.scan(
+        one_append, (timers, jnp.int32(0)), new_timers)
+    return timers, dropped
+
+
+def _normalize_step(out):
+    """Protocol step fns may return 3-tuple (no exception lane) or 4-tuple
+    with a trailing int32 exception code."""
+    if len(out) == 3:
+        nodes2, sends, new_t = out
+        return nodes2, sends, new_t, jnp.int32(0)
+    nodes2, sends, new_t, exc = out
+    return nodes2, sends, new_t, jnp.asarray(exc, jnp.int32)
 
 
 # ------------------------------------------------------------------- engine
 
 class TensorSearch:
     """Single-device BFS driver.  One jitted program expands a frontier
-    chunk into successors; the host loop handles level accounting, visited
-    merging, and termination."""
+    chunk into successors (vmapped transition + canonicalisation +
+    128-bit fingerprints + in-chunk sort-unique + predicate flags); the
+    host loop handles level accounting, visited merging, and termination."""
 
     def __init__(self, protocol: TensorProtocol,
                  frontier_cap: int = 1 << 16,
                  chunk: int = 1 << 12,
                  max_depth: Optional[int] = None,
-                 max_secs: Optional[float] = None):
+                 max_secs: Optional[float] = None,
+                 record_trace: bool = False):
         self.p = protocol
         self.frontier_cap = frontier_cap
         self.chunk = chunk
         self.max_depth = max_depth
         self.max_secs = max_secs
+        self.record_trace = record_trace
+        # Per-level (parent row, event id) spill for trace reconstruction
+        # (SURVEY §8.1; SearchState.java:361-474). Populated by run() when
+        # record_trace is set; consumed by tpu/trace.py.
+        self._levels: List[dict] = []
         self._expand = jax.jit(self._expand_chunk)
 
     # ------------------------------------------------------------- plumbing
@@ -276,15 +372,20 @@ class TensorSearch:
                           SENTINEL, jnp.int32)
         init_tmrs = np.asarray(p.init_timers(), np.int32)
         if init_tmrs.size:
-            timers = jax.vmap(append_timers)(
+            timers, dropped = jax.vmap(append_timers)(
                 timers, jnp.asarray(init_tmrs, jnp.int32)[None])
-        return {"nodes": nodes, "net": net, "timers": timers}
+            if int(dropped.sum()):
+                raise CapacityOverflow(
+                    f"{self.p.name}: initial timers overflow timer_cap="
+                    f"{p.timer_cap}")
+        return {"nodes": nodes, "net": net, "timers": timers,
+                "exc": jnp.zeros((1,), jnp.int32)}
 
     def _num_events(self) -> int:
         return self.p.net_cap + self.p.n_nodes * self.p.timer_cap
 
     def _step_one(self, state_slice: dict, event_idx: jnp.ndarray):
-        """Expand ONE state by ONE event index -> (successor, valid)."""
+        """Expand ONE state by ONE event index -> (successor, valid, over)."""
         p = self.p
         nodes, net, timers = (state_slice["nodes"], state_slice["net"],
                               state_slice["timers"])
@@ -296,8 +397,9 @@ class TensorSearch:
             ok = occupied
             if p.deliver_message is not None:
                 ok = ok & p.deliver_message(msg)
-            nodes2, sends, new_timers = p.step_message(nodes, msg)
-            return nodes2, sends, new_timers, None, ok
+            nodes2, sends, new_timers, exc = _normalize_step(
+                p.step_message(nodes, msg))
+            return nodes2, sends, new_timers, exc, None, ok
 
         def deliver_timer():
             t_idx = event_idx - p.net_cap
@@ -308,77 +410,173 @@ class TensorSearch:
             if p.deliver_timer is not None:
                 ok = ok & p.deliver_timer(node)
             timer = queue[slot]
-            nodes2, sends, new_timers = p.step_timer(nodes, node, timer)
-            return nodes2, sends, new_timers, (node, slot), ok
+            nodes2, sends, new_timers, exc = _normalize_step(
+                p.step_timer(nodes, node, timer))
+            return nodes2, sends, new_timers, exc, (node, slot), ok
 
-        m_nodes, m_sends, m_set, _, m_ok = deliver_message()
-        t_nodes, t_sends, t_set, (t_node, t_slot), t_ok = deliver_timer()
+        m_nodes, m_sends, m_set, m_exc, _, m_ok = deliver_message()
+        t_nodes, t_sends, t_set, t_exc, (t_node, t_slot), t_ok = deliver_timer()
 
         nodes2 = jnp.where(is_msg, m_nodes, t_nodes)
         sends = jnp.where(is_msg, m_sends, t_sends)
         new_t = jnp.where(is_msg, m_set, t_set)
+        exc = jnp.where(is_msg, m_exc, t_exc)
         valid = jnp.where(is_msg, m_ok, t_ok)
+        # An exception-state successor is frozen at the throwing transition:
+        # sends/new timers from the faulting handler are still applied (the
+        # reference captures the throwable after hooks ran,
+        # SearchState.java:218-222), but the state is terminal (run() ends).
 
-        net2 = insert_messages(net, sends)
+        net2, net_over = insert_messages(net, sends)
         timers2 = timers
         # Firing consumes the timer (SearchState.java:357).
         fired_q = remove_timer(timers[t_node], t_slot)
         timers2 = jnp.where(is_msg, timers2,
                             timers2.at[t_node].set(fired_q))
-        timers2 = append_timers(timers2, new_t)
-        return {"nodes": nodes2, "net": net2, "timers": timers2}, valid
+        timers2, t_over = append_timers(timers2, new_t)
+        over = (net_over + t_over) * valid.astype(jnp.int32)
+        succ = {"nodes": nodes2, "net": net2, "timers": timers2,
+                "exc": exc}
+        return succ, valid, over
 
     def _expand_chunk(self, chunk_state: dict, chunk_valid: jnp.ndarray):
-        """[C]-state chunk -> all successors + fingerprints + flags."""
+        """[C]-state chunk -> successors + fingerprints + masks + flags.
+
+        Returns (flat_successors [C*E], valids [C*E], fp [C*E, 4] uint32,
+        unique [C*E] in-chunk-first-occurrence mask, overflow scalar,
+        flags dict) — all device arrays; no host sync inside."""
         p = self.p
         ne = self._num_events()
-        ev = jnp.arange(ne)
+        c = chunk_valid.shape[0]
+        # ONE flat vmap over all (state, event) pairs.  A nested
+        # vmap-over-events-inside-vmap-over-states compiles the protocol
+        # twins' traced-index gathers/scatters into a pathologically slow
+        # two-batch-dim scatter path on TPU (~100x); flattening keeps every
+        # scatter on the fast single-batch-dim lowering.
+        rep_state = jax.tree.map(
+            lambda x: jnp.repeat(x, ne, axis=0), chunk_state)
+        ev = jnp.tile(jnp.arange(ne), c)
+        rep_valid = jnp.repeat(chunk_valid, ne)
+        flat, valids, overs = jax.vmap(self._step_one)(rep_state, ev)
+        valids = valids & rep_valid
+        overflow = jnp.sum(overs * valids.astype(jnp.int32))
+        fp = state_fingerprints(flat)
 
-        def per_state(slice_, v):
-            succ, valid = jax.vmap(
-                lambda e: self._step_one(slice_, e))(ev)
-            return succ, valid & v
+        # In-chunk sort-unique on device: first occurrence of each 128-bit
+        # key among valid rows (invalid rows sort last and are never
+        # unique).  Cuts host dedup work before any readback.
+        inv = ~valids
+        order = jnp.lexsort((fp[:, 3], fp[:, 2], fp[:, 1], fp[:, 0], inv))
+        fps = fp[order]
+        vs = valids[order]
+        first = jnp.ones(fps.shape[0], bool).at[1:].set(
+            jnp.any(fps[1:] != fps[:-1], axis=1))
+        unique = jnp.zeros_like(vs).at[order].set(first & vs)
 
-        succs, valids = jax.vmap(per_state)(chunk_state, chunk_valid)
-        flat = jax.tree.map(
-            lambda x: x.reshape((-1,) + x.shape[2:]), succs)
-        valids = valids.reshape(-1)
-        h1, h2 = state_fingerprints(flat)
-        h1 = jnp.where(valids, h1, jnp.int64(2 ** 62))
         flags = {}
         for kind, preds in (("inv", p.invariants), ("goal", p.goals),
                             ("prune", p.prunes)):
             for name, fn in preds.items():
                 flags[f"{kind}:{name}"] = jax.vmap(fn)(flat) & valids
-        return flat, valids, h1, h2, flags
+        return flat, valids, fp, unique, overflow, flags
 
     # ----------------------------------------------------------------- run
+
+    def _check_initial(self, state, t0) -> Optional[SearchOutcome]:
+        import time
+        p = self.p
+        for kind, preds in (("inv", p.invariants), ("goal", p.goals)):
+            for name, fn in preds.items():
+                hit = bool(jax.vmap(fn)(state)[0])
+                if kind == "inv" and not hit:
+                    return SearchOutcome("INVARIANT_VIOLATED", 1, 1, 0,
+                                         time.time() - t0,
+                                         violating_state=state,
+                                         predicate_name=name)
+                if kind == "goal" and hit:
+                    return SearchOutcome("GOAL_FOUND", 1, 1, 0,
+                                         time.time() - t0,
+                                         goal_state=state,
+                                         predicate_name=name)
+        return None
+
+    def _terminal_outcome(self, flat, np_valids, np_exc, flags,
+                          explored, visited_n, depth, t0,
+                          level_base_row: int = 0):
+        """checkState order: exception -> invariant -> goal
+        (Search.java:162-231).  Returns a SearchOutcome or None."""
+        import time
+
+        def slice_state(idx):
+            return jax.tree.map(lambda x: np.asarray(x)[idx:idx + 1], flat)
+
+        exc_hit = np_valids & (np_exc != 0)
+        if exc_hit.any():
+            idx = int(np.nonzero(exc_hit)[0][0])
+            return SearchOutcome(
+                "EXCEPTION_THROWN", explored, visited_n, depth,
+                time.time() - t0, violating_state=slice_state(idx),
+                exception_code=int(np_exc[idx]),
+                trace=self._reconstruct(level_base_row + idx))
+        for kind in ("inv", "goal"):
+            for name, f in flags.items():
+                if not name.startswith(kind + ":"):
+                    continue
+                fa = np.asarray(f)
+                pname = name.split(":", 1)[1]
+                if kind == "inv" and not fa[np_valids].all():
+                    idx = int(np.nonzero(np_valids & ~fa)[0][0])
+                    return SearchOutcome(
+                        "INVARIANT_VIOLATED", explored, visited_n, depth,
+                        time.time() - t0, violating_state=slice_state(idx),
+                        predicate_name=pname,
+                        trace=self._reconstruct(level_base_row + idx))
+                if kind == "goal" and fa[np_valids].any():
+                    idx = int(np.nonzero(np_valids & fa)[0][0])
+                    return SearchOutcome(
+                        "GOAL_FOUND", explored, visited_n, depth,
+                        time.time() - t0, goal_state=slice_state(idx),
+                        predicate_name=pname,
+                        trace=self._reconstruct(level_base_row + idx))
+        return None
+
+    def _reconstruct(self, row: int) -> Optional[list]:
+        """Walk the per-level (parent, event) spill back from a successor
+        row of the current level to the initial state -> [event ids] root
+        first (SearchState.java:361-371's parent chain, tensorised)."""
+        if not self.record_trace or not self._levels:
+            return None
+        ne = self._num_events()
+        events = []
+        for lvl in reversed(self._levels):
+            parent_chunk_row = row // ne
+            events.append(int(row % ne))
+            # Map the in-level parent row back through the previous level's
+            # kept-state compaction.
+            row = int(lvl["parent_rows"][parent_chunk_row])
+        events.reverse()
+        return events
 
     def run(self, check_initial: bool = True) -> SearchOutcome:
         import time
         t0 = time.time()
-        p = self.p
         state = self.initial_state()
-        h1, h2 = state_fingerprints(state)
-        visited = (np.asarray(h1), np.asarray(h2))
+        fp0 = np.asarray(state_fingerprints(state))
+        visited = host_keys(fp0)
         explored = 0
         depth = 0
+        self._levels = []
 
         if check_initial:
-            for kind, preds in (("inv", p.invariants), ("goal", p.goals)):
-                for name, fn in preds.items():
-                    hit = bool(jax.vmap(fn)(state)[0])
-                    if kind == "inv" and not hit:
-                        return SearchOutcome("INVARIANT_VIOLATED", 1, 1, 0,
-                                             time.time() - t0,
-                                             predicate_name=name)
-                    if kind == "goal" and hit:
-                        return SearchOutcome("GOAL_FOUND", 1, 1, 0,
-                                             time.time() - t0,
-                                             goal_state=state,
-                                             predicate_name=name)
+            out = self._check_initial(state, t0)
+            if out is not None:
+                return out
 
         frontier = state
+        # parent_rows[i] = the global successor row (in the PREVIOUS level's
+        # enumeration) that produced frontier state i; for the root level it
+        # is -1.  Used by _reconstruct.
+        parent_rows = np.array([-1], dtype=np.int64)
         frontier_n = 1
         while frontier_n > 0:
             if self.max_depth is not None and depth >= self.max_depth:
@@ -390,9 +588,14 @@ class TensorSearch:
                                      len(visited[0]), depth,
                                      time.time() - t0)
             depth += 1
-            new_states: List[dict] = []
-            new_keys: List[Tuple[np.ndarray, np.ndarray]] = []
-            outcome = None
+            if self.record_trace:
+                self._levels.append({"parent_rows": parent_rows})
+            # ---- expand all chunks (device), collect level arrays (host)
+            lvl_states: List[dict] = []
+            lvl_keys: List[Tuple[np.ndarray, np.ndarray]] = []
+            lvl_pruned: List[np.ndarray] = []
+            lvl_rows: List[np.ndarray] = []
+            ne = self._num_events()
             for start in range(0, frontier_n, self.chunk):
                 end = min(start + self.chunk, frontier_n)
                 c = end - start
@@ -404,93 +607,80 @@ class TensorSearch:
                     if pad else x[start:end], frontier)
                 chunk_valid = jnp.concatenate(
                     [jnp.ones(c, bool), jnp.zeros(pad, bool)])
-                flat, valids, h1, h2, flags = self._expand(
+                flat, valids, fp, unique, overflow, flags = self._expand(
                     chunk_state, chunk_valid)
-                explored += int(jnp.sum(valids))
-
-                # Terminal checks in checkState order: invariants strictly
-                # before goals (Search.java:162-231) — jit canonicalises
-                # dict outputs to sorted key order, so order explicitly.
+                if int(overflow):
+                    raise CapacityOverflow(
+                        f"{self.p.name}: net_cap={self.p.net_cap} or "
+                        f"timer_cap={self.p.timer_cap} overflowed at depth "
+                        f"{depth} ({int(overflow)} drops); raise the caps")
                 np_valids = np.asarray(valids)
-                for kind in ("inv", "goal"):
-                    for name, f in flags.items():
-                        if not name.startswith(kind + ":"):
-                            continue
-                        fa = np.asarray(f)
-                        pname = name.split(":", 1)[1]
-                        if kind == "inv" and not fa[np_valids].all():
-                            idx = int(np.nonzero(np_valids & ~fa)[0][0])
-                            bad = jax.tree.map(lambda x: x[idx:idx + 1], flat)
-                            return SearchOutcome(
-                                "INVARIANT_VIOLATED", explored,
-                                len(visited[0]), depth, time.time() - t0,
-                                violating_state=bad, predicate_name=pname)
-                        if kind == "goal" and fa[np_valids].any():
-                            idx = int(np.nonzero(np_valids & fa)[0][0])
-                            good = jax.tree.map(lambda x: x[idx:idx + 1], flat)
-                            return SearchOutcome(
-                                "GOAL_FOUND", explored, len(visited[0]),
-                                depth, time.time() - t0, goal_state=good,
-                                predicate_name=pname)
+                explored += int(np_valids.sum())
+                np_exc = np.asarray(flat["exc"])
+                out = self._terminal_outcome(
+                    flat, np_valids, np_exc, flags, explored,
+                    len(visited[0]), depth, t0,
+                    level_base_row=start * ne)
+                if out is not None:
+                    return out
 
                 pruned = np.zeros(len(np_valids), dtype=bool)
                 for name, f in flags.items():
                     if name.startswith("prune:"):
                         pruned |= np.asarray(f)
-
-                # Dedup: in-chunk sort-unique, then against visited.  Pruned
-                # states count as discovered (dedup happens on generation,
-                # Search.java:485) but are not expanded.
-                h1n, h2n = np.asarray(h1), np.asarray(h2)
-                keep = np.array(np_valids)  # writable copy
-                order = np.lexsort((h2n, h1n))
-                h1s, h2s = h1n[order], h2n[order]
-                first = np.ones(len(order), dtype=bool)
-                first[1:] = (h1s[1:] != h1s[:-1]) | (h2s[1:] != h2s[:-1])
-                unique_mask = np.zeros(len(order), dtype=bool)
-                unique_mask[order] = first
-                keep &= unique_mask
-                # Membership against visited + already-collected this level.
-                vh1, vh2 = visited
-                pos = np.searchsorted(vh1, h1n)
-                seen = np.zeros(len(h1n), dtype=bool)
-                for off in range(2):
-                    q = (pos + off).clip(0, max(len(vh1) - 1, 0))
-                    if len(vh1):
-                        seen |= (vh1[q] == h1n) & (vh2[q] == h2n)
-                for kh1, kh2 in new_keys:
-                    kpos = np.searchsorted(kh1, h1n)
-                    for off in range(2):
-                        q = (kpos + off).clip(0, max(len(kh1) - 1, 0))
-                        if len(kh1):
-                            seen |= (kh1[q] == h1n) & (kh2[q] == h2n)
-                keep &= ~seen
+                # Exception states are terminal even when the search
+                # continues past them (none here: exceptions end the run).
+                keep = np.asarray(unique)
                 if keep.any():
-                    kidxs = np.nonzero(keep)[0]
-                    ko = np.lexsort((h2n[kidxs], h1n[kidxs]))
-                    new_keys.append((h1n[kidxs][ko], h2n[kidxs][ko]))
-                expand = keep & ~pruned
-                if expand.any():
-                    idxs = np.nonzero(expand)[0]
-                    new_states.append(jax.tree.map(
+                    h1, h2 = host_keys(np.asarray(fp))
+                    idxs = np.nonzero(keep)[0]
+                    lvl_keys.append((h1[idxs], h2[idxs]))
+                    lvl_pruned.append(pruned[idxs])
+                    lvl_rows.append(idxs + start * ne)
+                    lvl_states.append(jax.tree.map(
                         lambda x: np.asarray(x)[idxs], flat))
 
-            if new_keys:
-                all_h1 = np.concatenate([k[0] for k in new_keys])
-                all_h2 = np.concatenate([k[1] for k in new_keys])
-                mh1 = np.concatenate([visited[0], all_h1])
-                mh2 = np.concatenate([visited[1], all_h2])
-                mo = np.lexsort((mh2, mh1))
-                visited = (mh1[mo], mh2[mo])
-
-            if not new_states:
+            if not lvl_keys:
                 return SearchOutcome("SPACE_EXHAUSTED", explored,
                                      len(visited[0]), depth,
                                      time.time() - t0)
 
-            nf = jax.tree.map(
-                lambda *xs: np.concatenate(xs, axis=0),
-                *new_states) if len(new_states) > 1 else new_states[0]
+            # ---- one level-wide dedup (sort-unique + visited membership)
+            h1 = np.concatenate([k[0] for k in lvl_keys])
+            h2 = np.concatenate([k[1] for k in lvl_keys])
+            pruned = np.concatenate(lvl_pruned)
+            rows = np.concatenate(lvl_rows)
+            order = np.lexsort((h2, h1))
+            h1s, h2s = h1[order], h2[order]
+            first = np.ones(len(order), dtype=bool)
+            first[1:] = (h1s[1:] != h1s[:-1]) | (h2s[1:] != h2s[:-1])
+            unique_mask = np.zeros(len(order), dtype=bool)
+            unique_mask[order] = first
+            fresh = unique_mask & ~sorted_member(visited[0], visited[1],
+                                                 h1, h2)
+
+            # ---- merge visited (sorted-merge, stays sorted by (h1, h2))
+            if fresh.any():
+                nk = np.nonzero(fresh)[0]
+                no = np.lexsort((h2[nk], h1[nk]))
+                mh1 = np.concatenate([visited[0], h1[nk][no]])
+                mh2 = np.concatenate([visited[1], h2[nk][no]])
+                mo = np.lexsort((mh2, mh1))
+                visited = (mh1[mo], mh2[mo])
+
+            expand = fresh & ~pruned
+            if not expand.any():
+                return SearchOutcome("SPACE_EXHAUSTED", explored,
+                                     len(visited[0]), depth,
+                                     time.time() - t0)
+
+            keep_idx = np.nonzero(expand)[0]
+            # lvl_states rows align 1:1 with h1/h2/rows concatenation.
+            all_states = (jax.tree.map(
+                lambda *xs: np.concatenate(xs, axis=0), *lvl_states)
+                if len(lvl_states) > 1 else lvl_states[0])
+            nf = jax.tree.map(lambda x: x[keep_idx], all_states)
+            parent_rows = rows[keep_idx]
             frontier_n = len(nf["nodes"])
             if frontier_n > self.frontier_cap:
                 return SearchOutcome("CAPACITY_EXHAUSTED", explored,

@@ -1,24 +1,35 @@
-"""Multi-chip sharded BFS level step (SPMD over a jax.sharding.Mesh).
+"""Multi-chip sharded BFS: the full device-resident search loop (SPMD).
 
-Scaling design (SURVEY §2.10, §5): the frontier is data-parallel over the
-``search`` mesh axis; every device expands its shard with the same vmapped
+Scaling design (SURVEY §2.10, §5): the frontier, the visited set, and the
+next-frontier accumulator all live in device HBM, sharded over the
+``search`` mesh axis.  Each BFS level is a sequence of chunk steps — every
+device expands a chunk of its frontier shard with the same vmapped
 transition the single-chip engine uses, then successors are exchanged by
-**fingerprint ownership** (device = h1 mod D) with ``lax.all_to_all`` over
-ICI so each device deduplicates exactly the keys it owns against its own
-visited shard.  Collectives: one all_to_all for the routed successor
-records + fingerprints, and psums for the level statistics — the classic
-hash-partitioned distributed BFS, mapped onto XLA collectives instead of
-the reference's shared-memory ConcurrentHashMap (Search.java:405-505).
+**fingerprint ownership** (device = key_hi mod D) with ``lax.all_to_all``
+over ICI so each device deduplicates exactly the keys it owns against its
+own sorted visited shard.  This is the classic hash-partitioned
+distributed BFS, mapped onto XLA collectives instead of the reference's
+shared-memory ConcurrentHashMap (Search.java:405-505); with a 1-device
+mesh it degenerates into the device-resident single-chip engine (the
+all_to_all is an identity), which is how the TPU bench runs.
 
-The routed exchange uses fixed-capacity buckets (OVERFLOW_FACTOR x the
-balanced share) — hash partitioning balances well; overflowed records are
-counted (psum) so callers can detect loss rather than silently undercount.
+Host involvement per level: one scalar readback (per-device frontier
+counts + overflow/terminal counters) to decide the next chunk count and
+check termination.  No state rows cross the host boundary until a
+terminal state must be reported.
+
+Everything on device is int32/uint32 (TPU-native dtypes; no x64).  All
+fixed-capacity structures (routing buckets, frontier shards, visited
+shards) count their drops and the driver raises
+:class:`~dslabs_tpu.tpu.engine.CapacityOverflow` — never a silent
+undercount (round-1 advisor findings: validity rides an explicit mask
+through the all_to_all, not a reserved fingerprint value).
 """
 
 from __future__ import annotations
 
-import functools
-from typing import Tuple
+import time
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -26,11 +37,14 @@ import numpy as np
 from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dslabs_tpu.tpu.engine import SENTINEL, TensorProtocol, TensorSearch
+from dslabs_tpu.tpu.engine import (CapacityOverflow, SearchOutcome,
+                                   TensorProtocol, TensorSearch,
+                                   flatten_state, state_fingerprints)
 
 __all__ = ["ShardedTensorSearch", "make_mesh"]
 
 OVERFLOW_FACTOR = 2
+MAXU32 = np.uint32(0xFFFFFFFF)
 
 
 def make_mesh(n_devices: int = None, axis: str = "search") -> Mesh:
@@ -50,109 +64,337 @@ def make_mesh(n_devices: int = None, axis: str = "search") -> Mesh:
 
 
 class ShardedTensorSearch(TensorSearch):
-    """BFS driver whose level expansion runs SPMD over a device mesh.
+    """BFS driver whose frontier, visited set, and expansion all live
+    sharded on a device mesh; ``run()`` executes the full multi-level
+    search with one scalar sync per level.
 
-    The host loop (frontier compaction, visited merging, termination) is
-    inherited; only the hot expand + ownership routing is sharded."""
+    Per-device carry (global shapes have a leading D factor):
+      cur      [F, lanes] int32   current frontier shard (owned states)
+      cur_n    [1]        int32   occupancy of cur
+      nxt      [F+1, lanes]       next-frontier accumulator (+1 dump row)
+      nxt_n    [1]                occupancy of nxt
+      visited  [V+1, 4]   uint32  sorted 128-bit keys (+1 dump row)
+      vis_n    [1]                occupancy of visited
+      counters: explored / overflow / routed-drop / frontier-drop
+      flag_cnt [n_flags], flag_rows [n_flags, lanes]: terminal detection
+        (exception -> invariant -> goal, checkState order
+        Search.java:162-231) — first-hit successor row kept per flag.
+    """
 
     def __init__(self, protocol: TensorProtocol, mesh: Mesh,
-                 chunk_per_device: int = 1 << 10, **kwargs):
+                 chunk_per_device: int = 1 << 10,
+                 frontier_cap: int = 1 << 14,
+                 visited_cap: int = 1 << 20,
+                 max_depth: Optional[int] = None,
+                 max_secs: Optional[float] = None):
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
-        self.n_devices = mesh.devices.size
-        super().__init__(protocol, chunk=chunk_per_device * self.n_devices,
-                         **kwargs)
-        self._sharded_expand = self._build_sharded_expand(chunk_per_device)
+        self.n_devices = int(mesh.devices.size)
+        if frontier_cap % chunk_per_device:
+            frontier_cap += chunk_per_device - frontier_cap % chunk_per_device
+        self.f_cap = frontier_cap          # per device
+        self.v_cap = visited_cap           # per device
+        self.cpd = chunk_per_device
+        super().__init__(protocol, frontier_cap=frontier_cap,
+                         chunk=chunk_per_device, max_depth=max_depth,
+                         max_secs=max_secs)
+        p = protocol
+        self.lanes = (p.node_width + p.net_cap * p.msg_width
+                      + p.n_nodes * p.timer_cap * p.timer_width + 1)
+        self._flag_names = (["exc"]
+                            + [f"inv:{n}" for n in p.invariants]
+                            + [f"goal:{n}" for n in p.goals])
+        self._chunk_step = jax.jit(self._build_chunk_step(),
+                                   donate_argnums=0)
+        self._finish_level = jax.jit(self._build_finish(), donate_argnums=0)
 
-    # ----------------------------------------------------------- level step
+    # ------------------------------------------------------------- helpers
 
-    def _build_sharded_expand(self, cpd: int):
+    def unflatten_rows(self, rows) -> dict:
+        """[C, lanes] int32 -> batched state pytree (inverse of
+        engine.flatten_state)."""
         p = self.p
-        ne = self._num_events()
+        c = rows.shape[0]
+        o0 = p.node_width
+        o1 = o0 + p.net_cap * p.msg_width
+        o2 = o1 + p.n_nodes * p.timer_cap * p.timer_width
+        return {
+            "nodes": rows[:, :o0],
+            "net": rows[:, o0:o1].reshape(c, p.net_cap, p.msg_width),
+            "timers": rows[:, o1:o2].reshape(
+                c, p.n_nodes, p.timer_cap, p.timer_width),
+            "exc": rows[:, o2],
+        }
+
+    # --------------------------------------------------------- level chunk
+
+    def _build_chunk_step(self):
+        p = self.p
         D = self.n_devices
+        C = self.cpd
+        F = self.f_cap
+        V = self.v_cap
+        ne = self._num_events()
         ax = self.axis
-        bucket = (cpd * ne // D + 1) * OVERFLOW_FACTOR
-        lanes = (p.node_width + p.net_cap * p.msg_width
-                 + p.n_nodes * p.timer_cap * p.timer_width)
+        lanes = self.lanes
+        bucket = (C * ne // D + 1) * OVERFLOW_FACTOR
+        nf = len(self._flag_names)
 
-        def flatten_state(s):
-            m = s["nodes"].shape[0]
-            return jnp.concatenate(
-                [s["nodes"].reshape(m, -1), s["net"].reshape(m, -1),
-                 s["timers"].reshape(m, -1)], axis=1)
-
-        def local_step(chunk_state, chunk_valid):
-            """Runs on ONE device over its [cpd] shard of the chunk."""
-            flat, valids, h1, h2, flags = self._expand_chunk(
-                chunk_state, chunk_valid)
+        def local(carry, j):
+            cur, cur_n = carry["cur"], carry["cur_n"][0]
+            start = j * C
+            rows_chunk = jax.lax.dynamic_slice(cur, (start, 0), (C, lanes))
+            valid = (start + jnp.arange(C)) < cur_n
+            states = self.unflatten_rows(rows_chunk)
+            flat, valids, fp, unique, overflow, flags = self._expand_chunk(
+                states, valid)
             rows = flatten_state(flat)
 
-            # Ownership routing: bucket successors by h1 mod D.
-            owner = (h1 % D).astype(jnp.int32)
-            owner = jnp.where(valids, owner, D)  # invalid -> dropped
-            # Stable sort by owner so each destination's records are
-            # contiguous; then scatter into [D, bucket] send buffers.
+            # ---- terminal flags, checkState order (exception first)
+            hit_list = [valids & (flat["exc"] != 0)]
+            for n in p.invariants:
+                hit_list.append(valids & ~flags[f"inv:{n}"])
+            for n in p.goals:
+                hit_list.append(flags[f"goal:{n}"])
+            hits = jnp.stack(hit_list)                       # [nf, C*E]
+            cnts = jnp.sum(hits, axis=1).astype(jnp.int32)
+            idxs = jnp.argmax(hits, axis=1)
+            new_rows_f = rows[idxs]                          # [nf, lanes]
+            fresh_flag = (carry["flag_cnt"] == 0) & (cnts > 0)
+            flag_rows = jnp.where(fresh_flag[:, None], new_rows_f,
+                                  carry["flag_rows"])
+            flag_cnt = carry["flag_cnt"] + cnts
+
+            pruned = flat["exc"] != 0
+            for n in p.prunes:
+                pruned = pruned | flags[f"prune:{n}"]
+
+            # ---- ownership routing (explicit validity mask, no sentinel
+            # fingerprint overloading)
+            owner = (fp[:, 0] % jnp.uint32(D)).astype(jnp.int32)
+            owner = jnp.where(unique, owner, D)     # non-unique -> nowhere
             order = jnp.argsort(owner, stable=True)
             owner_s = owner[order]
-            rows_s = rows[order]
-            h1_s, h2_s = h1[order], h2[order]
-            # Position of each record within its destination bucket.
             idx_in_bucket = jnp.arange(owner_s.shape[0]) - jnp.searchsorted(
                 owner_s, owner_s, side="left")
             fits = (owner_s < D) & (idx_in_bucket < bucket)
-            dropped = jnp.sum((owner_s < D) & ~fits)
-            # Column `bucket` is a write-off slot for non-fitting rows so
-            # they cannot clobber real records; it is dropped below.
-            send_rows = jnp.full((D, bucket + 1, lanes), SENTINEL, rows.dtype)
-            send_h1 = jnp.full((D, bucket + 1), jnp.int64(2 ** 62), jnp.int64)
-            send_h2 = jnp.zeros((D, bucket + 1), jnp.int64)
-            dst = owner_s.clip(0, D - 1)
-            slot = jnp.where(fits, idx_in_bucket, bucket).clip(0, bucket)
-            send_rows = send_rows.at[dst, slot].set(rows_s)
-            send_h1 = send_h1.at[dst, slot].set(
-                jnp.where(fits, h1_s, jnp.int64(2 ** 62)))
-            send_h2 = send_h2.at[dst, slot].set(jnp.where(fits, h2_s, 0))
-            send_rows = send_rows[:, :bucket]
-            send_h1 = send_h1[:, :bucket]
-            send_h2 = send_h2[:, :bucket]
+            route_drop = jnp.sum((owner_s < D) & ~fits).astype(jnp.int32)
+            dst = jnp.where(fits, owner_s, 0)
+            slot = jnp.where(fits, idx_in_bucket, bucket)
+            send_rows = jnp.zeros((D, bucket + 1, lanes), rows.dtype)
+            send_keys = jnp.zeros((D, bucket + 1, 4), jnp.uint32)
+            send_valid = jnp.zeros((D, bucket + 1), bool)
+            send_pruned = jnp.zeros((D, bucket + 1), bool)
+            send_rows = send_rows.at[dst, slot].set(rows[order])
+            send_keys = send_keys.at[dst, slot].set(fp[order])
+            send_valid = send_valid.at[dst, slot].set(fits)
+            send_pruned = send_pruned.at[dst, slot].set(pruned[order])
+            send_rows, send_keys = send_rows[:, :bucket], send_keys[:, :bucket]
+            send_valid, send_pruned = (send_valid[:, :bucket],
+                                       send_pruned[:, :bucket])
 
-            # The exchange: every device receives the bucket destined to it
-            # from every other device (ICI all-to-all).
-            recv_rows = jax.lax.all_to_all(send_rows, ax, 0, 0, tiled=False)
-            recv_h1 = jax.lax.all_to_all(send_h1, ax, 0, 0, tiled=False)
-            recv_h2 = jax.lax.all_to_all(send_h2, ax, 0, 0, tiled=False)
-            recv_rows = recv_rows.reshape(D * bucket, lanes)
-            recv_h1 = recv_h1.reshape(D * bucket)
-            recv_h2 = recv_h2.reshape(D * bucket)
+            # ---- the exchange: every device receives the bucket destined
+            # to it from every other device (ICI all_to_all)
+            recv_rows = jax.lax.all_to_all(send_rows, ax, 0, 0)
+            recv_keys = jax.lax.all_to_all(send_keys, ax, 0, 0)
+            recv_valid = jax.lax.all_to_all(send_valid, ax, 0, 0)
+            recv_pruned = jax.lax.all_to_all(send_pruned, ax, 0, 0)
+            rb = D * bucket
+            recv_rows = recv_rows.reshape(rb, lanes)
+            recv_keys = jnp.where(recv_valid.reshape(rb, 1),
+                                  recv_keys.reshape(rb, 4), MAXU32)
+            recv_pruned = recv_pruned.reshape(rb)
+            recv_valid = recv_valid.reshape(rb)
 
-            # Local owner-side dedup: sort by key, keep first occurrences.
-            o = jnp.lexsort((recv_h2, recv_h1))
-            rh1, rh2 = recv_h1[o], recv_h2[o]
-            first = jnp.ones(rh1.shape[0], bool).at[1:].set(
-                (rh1[1:] != rh1[:-1]) | (rh2[1:] != rh2[:-1]))
-            valid_recv = rh1 < jnp.int64(2 ** 62)
-            unique = first & valid_recv
-            n_explored = jnp.sum(valids)
-            # Cross-device stats ride the ICI as psums.
-            totals = {
-                "explored": jax.lax.psum(n_explored, ax),
-                "routed_unique": jax.lax.psum(jnp.sum(unique), ax),
-                "dropped": jax.lax.psum(dropped, ax),
+            # ---- owner-side dedup against the sorted visited shard:
+            # merge-sort visited keys (tag 0) with candidate keys (tag 1);
+            # a candidate is FRESH iff its predecessor in the combined
+            # order differs in any lane (covers both already-visited and
+            # duplicate-candidate cases).
+            visited, vis_n = carry["visited"], carry["vis_n"][0]
+            vkeys = visited[:V]                      # [V, 4] sorted, MAX pad
+            comb_keys = jnp.concatenate([vkeys, recv_keys])
+            tags = jnp.concatenate([
+                jnp.zeros(V, jnp.int32), jnp.ones(rb, jnp.int32)])
+            cvalid = jnp.concatenate([jnp.arange(V) < vis_n, recv_valid])
+            o = jnp.lexsort((tags, comb_keys[:, 3], comb_keys[:, 2],
+                             comb_keys[:, 1], comb_keys[:, 0]))
+            ck, ct, cv = comb_keys[o], tags[o], cvalid[o]
+            neq_prev = jnp.ones(ck.shape[0], bool).at[1:].set(
+                jnp.any(ck[1:] != ck[:-1], axis=1))
+            fresh_sorted = (ct == 1) & cv & neq_prev
+            # Keep = surviving visited entries + fresh candidates, already
+            # in key order: compact them back into the visited shard.
+            keep = ((ct == 0) & cv) | fresh_sorted
+            kpos = jnp.cumsum(keep) - 1
+            dump = jnp.where(keep & (kpos < V), kpos, V)
+            new_visited = jnp.full((V + 1, 4), MAXU32)
+            new_visited = new_visited.at[dump].set(ck)
+            n_fresh = jnp.sum(fresh_sorted).astype(jnp.int32)
+            new_vis_n = vis_n + n_fresh
+            vis_drop = jnp.maximum(new_vis_n - V, 0)
+
+            # ---- append fresh, un-pruned successors to the next frontier
+            fresh = jnp.zeros(V + rb, bool).at[o].set(fresh_sorted)[V:]
+            sel = fresh & ~recv_pruned
+            spos = jnp.cumsum(sel) - 1
+            nxt, nxt_n = carry["nxt"], carry["nxt_n"][0]
+            sdst = jnp.where(sel & (nxt_n + spos < F), nxt_n + spos, F)
+            nxt = nxt.at[sdst].set(recv_rows)
+            n_sel = jnp.sum(sel).astype(jnp.int32)
+            frontier_drop = jnp.maximum(nxt_n + n_sel - F, 0)
+
+            return {
+                "cur": cur, "cur_n": carry["cur_n"],
+                "nxt": nxt, "nxt_n": carry["nxt_n"].at[0].add(n_sel),
+                "visited": new_visited,
+                "vis_n": carry["vis_n"].at[0].add(n_fresh),
+                "explored": carry["explored"].at[0].add(
+                    jnp.sum(valids).astype(jnp.int32)),
+                "overflow": carry["overflow"].at[0].add(
+                    overflow + route_drop + vis_drop + frontier_drop),
+                "flag_cnt": flag_cnt, "flag_rows": flag_rows,
             }
-            flag_any = {k: jax.lax.psum(jnp.sum(v), ax)
-                        for k, v in flags.items()}
-            return (recv_rows[o], rh1, rh2, unique, totals, flag_any)
 
-        in_specs = (
-            {"nodes": P(ax), "net": P(ax), "timers": P(ax)}, P(ax))
-        out_specs = (P(ax), P(ax), P(ax), P(ax), P(), P())
-        fn = shard_map(local_step, mesh=self.mesh,
-                       in_specs=in_specs, out_specs=out_specs,
-                       check_rep=False)
-        return jax.jit(fn)
+        spec = self._carry_specs()
+        return shard_map(local, mesh=self.mesh,
+                         in_specs=(spec, P()), out_specs=spec,
+                         check_rep=False)
 
-    def level_step(self, chunk_state, chunk_valid):
-        """One sharded BFS level step over the mesh (the 'training step' of
-        this framework: expand + route + dedup + reduce)."""
+    def _build_finish(self):
+        F, lanes = self.f_cap, self.lanes
+        ax = self.axis
+
+        def local(carry):
+            carry = dict(carry)
+            carry["cur"] = carry["nxt"][:F]
+            carry["cur_n"] = carry["nxt_n"]
+            carry["nxt"] = jnp.zeros((F + 1, lanes), jnp.int32)
+            carry["nxt_n"] = jnp.zeros((1,), jnp.int32)
+            return carry
+
+        spec = self._carry_specs()
+        return shard_map(local, mesh=self.mesh,
+                         in_specs=(spec,), out_specs=spec,
+                         check_rep=False)
+
+    def _carry_specs(self):
+        ax = self.axis
+        return {k: P(ax) for k in
+                ("cur", "cur_n", "nxt", "nxt_n", "visited", "vis_n",
+                 "explored", "overflow", "flag_cnt", "flag_rows")}
+
+    # ----------------------------------------------------------------- run
+
+    def _init_carry(self, state) -> dict:
+        D, F, V, lanes = self.n_devices, self.f_cap, self.v_cap, self.lanes
+        rows0 = np.asarray(flatten_state(state), np.int32)     # [1, lanes]
+        fp0 = np.asarray(state_fingerprints(state), np.uint32)  # [1, 4]
+        owner = int(fp0[0, 0]) % D
+
+        cur = np.zeros((D * F, lanes), np.int32)
+        cur[owner * F] = rows0[0]
+        cur_n = np.zeros((D,), np.int32)
+        cur_n[owner] = 1
+        visited = np.full((D * (V + 1), 4), MAXU32, np.uint32)
+        visited[owner * (V + 1)] = fp0[0]
+        vis_n = np.zeros((D,), np.int32)
+        vis_n[owner] = 1
+        nf = len(self._flag_names)
+        host = {
+            "cur": cur, "cur_n": cur_n,
+            "nxt": np.zeros((D * (F + 1), lanes), np.int32),
+            "nxt_n": np.zeros((D,), np.int32),
+            "visited": visited, "vis_n": vis_n,
+            "explored": np.zeros((D,), np.int32),
+            "overflow": np.zeros((D,), np.int32),
+            "flag_cnt": np.zeros((D * nf,), np.int32).reshape(D * nf),
+            "flag_rows": np.zeros((D * nf, lanes), np.int32),
+        }
+        return {
+            k: jax.device_put(v, NamedSharding(self.mesh, P(self.axis)))
+            for k, v in host.items()
+        }
+
+    def _terminal_from_flags(self, carry, explored, vis_total, depth, t0):
+        """Resolve the first terminal flag (checkState order) from the
+        per-device counters; returns a SearchOutcome or None."""
+        nf = len(self._flag_names)
+        cnts = np.asarray(carry["flag_cnt"]).reshape(self.n_devices, nf)
+        if not cnts.any():
+            return None
+        rows = np.asarray(carry["flag_rows"]).reshape(
+            self.n_devices, nf, self.lanes)
+        for fi, fname in enumerate(self._flag_names):
+            devs = np.nonzero(cnts[:, fi])[0]
+            if not len(devs):
+                continue
+            row = rows[devs[0], fi]
+            st = jax.tree.map(np.asarray,
+                              self.unflatten_rows(row[None]))
+            elapsed = time.time() - t0
+            if fname == "exc":
+                return SearchOutcome(
+                    "EXCEPTION_THROWN", explored, vis_total, depth, elapsed,
+                    violating_state=st, exception_code=int(st["exc"][0]))
+            kind, pname = fname.split(":", 1)
+            if kind == "inv":
+                return SearchOutcome(
+                    "INVARIANT_VIOLATED", explored, vis_total, depth,
+                    elapsed, violating_state=st, predicate_name=pname)
+            return SearchOutcome(
+                "GOAL_FOUND", explored, vis_total, depth, elapsed,
+                goal_state=st, predicate_name=pname)
+        return None
+
+    def run(self, check_initial: bool = True) -> SearchOutcome:
+        t0 = time.time()
+        state = self.initial_state()
+        if check_initial:
+            out = self._check_initial(state, t0)
+            if out is not None:
+                return out
+
         with self.mesh:
-            return self._sharded_expand(chunk_state, chunk_valid)
+            carry = self._init_carry(state)
+            depth = 0
+            max_n = 1
+            while max_n > 0:
+                if self.max_depth is not None and depth >= self.max_depth:
+                    return self._limit_outcome("DEPTH_EXHAUSTED", carry,
+                                               depth, t0)
+                if (self.max_secs is not None
+                        and time.time() - t0 > self.max_secs):
+                    return self._limit_outcome("TIME_EXHAUSTED", carry,
+                                               depth, t0)
+                depth += 1
+                n_chunks = -(-max_n // self.cpd)
+                for j in range(n_chunks):
+                    carry = self._chunk_step(carry, jnp.int32(j))
+                # ---- the one host sync per level
+                overflow = int(np.asarray(carry["overflow"]).sum())
+                if overflow:
+                    raise CapacityOverflow(
+                        f"{self.p.name}: {overflow} drops at depth {depth} "
+                        f"(net/timer caps, routing bucket, frontier cap "
+                        f"{self.f_cap}/device, or visited cap "
+                        f"{self.v_cap}/device)")
+                explored = int(np.asarray(carry["explored"]).sum())
+                vis_total = int(np.asarray(carry["vis_n"]).sum())
+                out = self._terminal_from_flags(carry, explored, vis_total,
+                                                depth, t0)
+                if out is not None:
+                    return out
+                max_n = int(np.asarray(carry["nxt_n"]).max())
+                carry = self._finish_level(carry)
+
+            return SearchOutcome(
+                "SPACE_EXHAUSTED", explored, vis_total, depth,
+                time.time() - t0)
+
+    def _limit_outcome(self, cond, carry, depth, t0):
+        return SearchOutcome(
+            cond,
+            int(np.asarray(carry["explored"]).sum()),
+            int(np.asarray(carry["vis_n"]).sum()),
+            depth, time.time() - t0)
