@@ -7,23 +7,39 @@ The measured engine is the device-resident sharded BFS
 chip the all_to_all degenerates to an identity and the loop still keeps
 the frontier + visited set in HBM with one scalar sync per level.  All
 device arithmetic is int32/uint32 (round 1 crashed the TPU worker inside
-x64-emulated fingerprints; x64 is now banned from device code).
+x64-emulated fingerprints; x64 is banned from device code).
 
-Always prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}:
-configuration ladders down (chunk size, caps) on failure, and a final
-fallback reports value 0.0 with the error string rather than crashing.
+Each ladder rung runs in a SUBPROCESS: a TPU worker crash on an oversized
+config kills only that rung's process — the parent falls through to the
+next rung instead of inheriting a dead TPU client (the round-1 failure
+mode where rung 1's crash poisoned every retry).  Rungs run strict=False:
+routing/frontier capacity drops truncate expansion beam-style and are
+reported, while semantic overflow (net/timer caps, visited shard) still
+aborts the rung.
+
+Always prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 import traceback
 
 BASELINE_STATES_PER_MIN = 1e8
 
+# (chunk_per_device, frontier_cap, visited_cap) — per device.
+LADDER = [
+    (1024, 1 << 16, 1 << 21),
+    (256, 1 << 14, 1 << 20),
+    (64, 1 << 12, 1 << 18),
+]
+RUNG_TIMEOUT_SECS = 540.0
 
-def _run_config(chunk_per_device: int, frontier_cap: int, visited_cap: int,
-                max_secs: float):
+
+def _run_rung(chunk_per_device: int, frontier_cap: int, visited_cap: int,
+              max_secs: float) -> dict:
     import jax
 
     from dslabs_tpu.tpu.protocols.paxos import make_paxos_protocol
@@ -35,50 +51,84 @@ def _run_config(chunk_per_device: int, frontier_cap: int, visited_cap: int,
     mesh = make_mesh(len(jax.devices()))
     search = ShardedTensorSearch(
         protocol, mesh, chunk_per_device=chunk_per_device,
-        frontier_cap=frontier_cap, visited_cap=visited_cap, max_depth=1)
+        frontier_cap=frontier_cap, visited_cap=visited_cap, max_depth=1,
+        strict=False)
     search.run()  # warm-up: compiles the chunk/finish programs
     search.max_depth = 64
     search.max_secs = max_secs
     t0 = time.time()
     outcome = search.run()
     elapsed = max(time.time() - t0, 1e-9)
-    return outcome.unique_states / elapsed * 60.0
+    return {
+        "value": outcome.unique_states / elapsed * 60.0,
+        "unique": outcome.unique_states,
+        "explored": outcome.states_explored,
+        "depth": outcome.depth,
+        "end": outcome.end_condition,
+        "dropped": outcome.dropped,
+        "elapsed": elapsed,
+    }
+
+
+def _probe_platform() -> tuple:
+    """Platform + device count WITHOUT initialising jax in this process —
+    the accelerator must stay free for the rung subprocesses."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, json; d = jax.devices(); "
+             "print(json.dumps([d[0].platform, len(d)]))"],
+            capture_output=True, text=True, timeout=180.0)
+        return tuple(json.loads(out.stdout.strip().splitlines()[-1]))
+    except Exception:
+        return ("unknown", 0)
 
 
 def main() -> None:
-    import jax
-
-    platform = jax.devices()[0].platform
-    on_tpu = platform == "tpu"
-    max_secs = 120.0 if on_tpu else 45.0
-    ladder = [
-        (2048, 1 << 17, 1 << 22),
-        (512, 1 << 15, 1 << 20),
-        (128, 1 << 13, 1 << 18),
-    ]
-    value, err = 0.0, None
-    for chunk, f_cap, v_cap in ladder:
+    platform, n_dev = _probe_platform()
+    max_secs = 120.0 if platform != "cpu" else 45.0
+    best, err = None, None
+    for chunk, f_cap, v_cap in LADDER:
         try:
-            value = _run_config(chunk, f_cap, v_cap, max_secs)
-            err = None
-            break
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--rung",
+                 str(chunk), str(f_cap), str(v_cap), str(max_secs)],
+                capture_output=True, text=True, timeout=RUNG_TIMEOUT_SECS,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            if proc.returncode == 0:
+                best = json.loads(proc.stdout.strip().splitlines()[-1])
+                break
+            tail = (proc.stderr or proc.stdout).strip().splitlines()
+            err = (tail[-1][:300] if tail
+                   else f"rung chunk={chunk} exited rc={proc.returncode} "
+                        "with no output")
+        except subprocess.TimeoutExpired:
+            err = f"rung chunk={chunk} timed out after {RUNG_TIMEOUT_SECS}s"
         except Exception:
-            err = traceback.format_exc(limit=3)
-            continue
+            err = traceback.format_exc(limit=2).strip().splitlines()[-1][:300]
+    value = best["value"] if best else 0.0
     result = {
         "metric": ("lab3-paxos BFS unique states/min "
-                   f"(sharded tensor backend, {platform}"
-                   f" x{len(jax.devices())})"),
+                   f"(sharded tensor backend, {platform} x{n_dev})"),
         "value": round(value, 1),
         "unit": "states/min",
         "vs_baseline": round(value / BASELINE_STATES_PER_MIN, 6),
     }
-    if err is not None:
-        result["error"] = err.strip().splitlines()[-1][:300]
+    if best:
+        result["detail"] = {k: best[k] for k in
+                            ("unique", "explored", "depth", "end",
+                             "dropped", "elapsed")}
+    if err is not None and not best:
+        result["error"] = err
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 2 and sys.argv[1] == "--rung":
+        chunk, f_cap, v_cap = map(int, sys.argv[2:5])
+        print(json.dumps(_run_rung(chunk, f_cap, v_cap,
+                                   float(sys.argv[5]))))
+        sys.exit(0)
     try:
         main()
     except Exception:

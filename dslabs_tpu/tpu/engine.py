@@ -139,6 +139,7 @@ class SearchOutcome:
     predicate_name: Optional[str] = None
     exception_code: int = 0
     trace: Optional[list] = None     # [(parent event id, ...)] — see trace.py
+    dropped: int = 0                 # beam-truncation drops (strict=False)
 
 
 # ----------------------------------------------------------------- hashing

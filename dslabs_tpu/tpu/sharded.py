@@ -7,7 +7,10 @@ device expands a chunk of its frontier shard with the same vmapped
 transition the single-chip engine uses, then successors are exchanged by
 **fingerprint ownership** (device = key_hi mod D) with ``lax.all_to_all``
 over ICI so each device deduplicates exactly the keys it owns against its
-own sorted visited shard.  This is the classic hash-partitioned
+own **open-addressing hash table in HBM** (double hashing, bounded probe
+loop — membership and insert in one pass, a few [batch]-row
+gathers/scatters per probe instead of an O(V log V) sort-merge per
+chunk).  This is the classic hash-partitioned
 distributed BFS, mapped onto XLA collectives instead of the reference's
 shared-memory ConcurrentHashMap (Search.java:405-505); with a 1-device
 mesh it degenerates into the device-resident single-chip engine (the
@@ -73,8 +76,9 @@ class ShardedTensorSearch(TensorSearch):
       cur_n    [1]        int32   occupancy of cur
       nxt      [F+1, lanes]       next-frontier accumulator (+1 dump row)
       nxt_n    [1]                occupancy of nxt
-      visited  [V+1, 4]   uint32  sorted 128-bit keys (+1 dump row)
-      vis_n    [1]                occupancy of visited
+      visited  [V+1, 4]   uint32  open-addressing hash table of 128-bit
+                                  keys (+1 dump row); EMPTY = all-MAX
+      vis_n    [1]                number of keys inserted
       counters: explored / overflow / routed-drop / frontier-drop
       flag_cnt [n_flags], flag_rows [n_flags, lanes]: terminal detection
         (exception -> invariant -> goal, checkState order
@@ -86,12 +90,22 @@ class ShardedTensorSearch(TensorSearch):
                  frontier_cap: int = 1 << 14,
                  visited_cap: int = 1 << 20,
                  max_depth: Optional[int] = None,
-                 max_secs: Optional[float] = None):
+                 max_secs: Optional[float] = None,
+                 strict: bool = True):
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
         self.n_devices = int(mesh.devices.size)
+        # strict=True (search tests): ANY capacity drop is fatal — verdicts
+        # must be exact.  strict=False (throughput benches): routing-bucket
+        # and frontier-cap drops truncate expansion coverage beam-style and
+        # are reported via SearchOutcome.dropped; semantic overflow
+        # (net/timer caps, visited shard) stays fatal either way.
+        self.strict = strict
         if frontier_cap % chunk_per_device:
             frontier_cap += chunk_per_device - frontier_cap % chunk_per_device
+        if visited_cap & (visited_cap - 1):
+            raise ValueError("visited_cap must be a power of two "
+                             "(hash-table slot arithmetic)")
         self.f_cap = frontier_cap          # per device
         self.v_cap = visited_cap           # per device
         self.cpd = chunk_per_device
@@ -206,36 +220,72 @@ class ShardedTensorSearch(TensorSearch):
             recv_pruned = recv_pruned.reshape(rb)
             recv_valid = recv_valid.reshape(rb)
 
-            # ---- owner-side dedup against the sorted visited shard:
-            # merge-sort visited keys (tag 0) with candidate keys (tag 1);
-            # a candidate is FRESH iff its predecessor in the combined
-            # order differs in any lane (covers both already-visited and
-            # duplicate-candidate cases).
-            visited, vis_n = carry["visited"], carry["vis_n"][0]
-            vkeys = visited[:V]                      # [V, 4] sorted, MAX pad
-            comb_keys = jnp.concatenate([vkeys, recv_keys])
-            tags = jnp.concatenate([
-                jnp.zeros(V, jnp.int32), jnp.ones(rb, jnp.int32)])
-            cvalid = jnp.concatenate([jnp.arange(V) < vis_n, recv_valid])
-            o = jnp.lexsort((tags, comb_keys[:, 3], comb_keys[:, 2],
-                             comb_keys[:, 1], comb_keys[:, 0]))
-            ck, ct, cv = comb_keys[o], tags[o], cvalid[o]
-            neq_prev = jnp.ones(ck.shape[0], bool).at[1:].set(
-                jnp.any(ck[1:] != ck[:-1], axis=1))
-            fresh_sorted = (ct == 1) & cv & neq_prev
-            # Keep = surviving visited entries + fresh candidates, already
-            # in key order: compact them back into the visited shard.
-            keep = ((ct == 0) & cv) | fresh_sorted
-            kpos = jnp.cumsum(keep) - 1
-            dump = jnp.where(keep & (kpos < V), kpos, V)
-            new_visited = jnp.full((V + 1, 4), MAXU32)
-            new_visited = new_visited.at[dump].set(ck)
-            n_fresh = jnp.sum(fresh_sorted).astype(jnp.int32)
-            new_vis_n = vis_n + n_fresh
-            vis_drop = jnp.maximum(new_vis_n - V, 0)
+            # ---- owner-side dedup via an open-addressing hash table in
+            # HBM ([V+1, 4] uint32, double hashing, last row = scatter
+            # dump).  Membership AND insert happen in one bounded probe
+            # loop: per iteration a handful of [rb]-row gathers/scatters —
+            # no O(V log V) sort-merge per chunk (the round-1 → round-2
+            # bottleneck: sorting the whole visited shard for every chunk).
+            #
+            # The recv batch may hold the same key from different source
+            # devices; a small in-batch sort dedups it first so the
+            # empty-slot claim race below is only ever between DISTINCT
+            # keys — whoever's scatter lands, a re-gather tells each
+            # candidate whether its own key is now stored (won) or a
+            # different key beat it (advance to next probe slot).
+            visited = carry["visited"]
+            # Real keys never equal the EMPTY marker (all four lanes MAX):
+            # remap the 2^-128-probability collider.
+            all_max = jnp.all(recv_keys == MAXU32, axis=1)
+            ckeys = recv_keys.at[:, 3].set(
+                jnp.where(all_max & recv_valid, MAXU32 - 1, recv_keys[:, 3]))
+            bo = jnp.lexsort((ckeys[:, 3], ckeys[:, 2], ckeys[:, 1],
+                              ckeys[:, 0], ~recv_valid))
+            skeys = ckeys[bo]
+            svalid = recv_valid[bo]
+            batch_first = jnp.ones(rb, bool).at[1:].set(
+                jnp.any(skeys[1:] != skeys[:-1], axis=1))
+            cand = svalid & batch_first
+
+            # Probe slot from lane 2 (b_hi), NOT lane 0: ownership routing
+            # already fixed lane0 ≡ device (mod D), so a lane0-derived home
+            # slot would cluster every owned key into 1/D of the table.
+            slot0 = (skeys[:, 2] & jnp.uint32(V - 1)).astype(jnp.int32)
+            pstep = (skeys[:, 1] | jnp.uint32(1)).astype(jnp.uint32)
+
+            def probe_cond(st):
+                _, _, resolved, _, it = st
+                return (it < 64) & jnp.any(~resolved)
+
+            def probe_body(st):
+                table, slot, resolved, fresh, it = st
+                cur = table[slot]                        # [rb, 4]
+                eq = jnp.all(cur == skeys, axis=1)
+                empty = jnp.all(cur == MAXU32, axis=1)
+                unres = ~resolved
+                tryi = unres & empty
+                dst = jnp.where(tryi, slot, V)
+                table = table.at[dst].set(skeys)
+                back = table[slot]
+                won = tryi & jnp.all(back == skeys, axis=1)
+                resolved = resolved | eq | won
+                nslot = (slot.astype(jnp.uint32) + pstep).astype(
+                    jnp.int32) & (V - 1)
+                slot = jnp.where(~resolved, nslot, slot)
+                return table, slot, resolved, fresh | won, it + 1
+
+            table, _, resolved, fresh_s, _ = jax.lax.while_loop(
+                probe_cond, probe_body,
+                (visited, slot0, ~cand, jnp.zeros(rb, bool), jnp.int32(0)))
+            new_visited = table
+            # Probe exhaustion = table effectively full: semantic overflow
+            # (missed dedup would corrupt unique counts).
+            vis_drop = jnp.sum(~resolved).astype(jnp.int32)
+            n_fresh = jnp.sum(fresh_s).astype(jnp.int32)
 
             # ---- append fresh, un-pruned successors to the next frontier
-            fresh = jnp.zeros(V + rb, bool).at[o].set(fresh_sorted)[V:]
+            # (undo the in-batch sort permutation to realign with rows)
+            fresh = jnp.zeros(rb, bool).at[bo].set(fresh_s)
             sel = fresh & ~recv_pruned
             spos = jnp.cumsum(sel) - 1
             nxt, nxt_n = carry["nxt"], carry["nxt_n"][0]
@@ -243,6 +293,9 @@ class ShardedTensorSearch(TensorSearch):
             nxt = nxt.at[sdst].set(recv_rows)
             n_sel = jnp.sum(sel).astype(jnp.int32)
             frontier_drop = jnp.maximum(nxt_n + n_sel - F, 0)
+            # Occupancy counts only rows that actually landed (<= F), else
+            # the next level's chunk loop would re-expand the tail.
+            n_sel = n_sel - frontier_drop
 
             return {
                 "cur": cur, "cur_n": carry["cur_n"],
@@ -251,8 +304,14 @@ class ShardedTensorSearch(TensorSearch):
                 "vis_n": carry["vis_n"].at[0].add(n_fresh),
                 "explored": carry["explored"].at[0].add(
                     jnp.sum(valids).astype(jnp.int32)),
-                "overflow": carry["overflow"].at[0].add(
-                    overflow + route_drop + vis_drop + frontier_drop),
+                # Semantic overflow (net/timer caps, visited shard) corrupts
+                # state contents or unique counts — always fatal.  Capacity
+                # drops (routing bucket, frontier cap) only truncate
+                # *expansion coverage* (beam-style) and are tolerable when
+                # the caller opts in (bench throughput runs).
+                "overflow": carry["overflow"].at[0].add(overflow + vis_drop),
+                "drops": carry["drops"].at[0].add(
+                    route_drop + frontier_drop),
                 "flag_cnt": flag_cnt, "flag_rows": flag_rows,
             }
 
@@ -282,7 +341,7 @@ class ShardedTensorSearch(TensorSearch):
         ax = self.axis
         return {k: P(ax) for k in
                 ("cur", "cur_n", "nxt", "nxt_n", "visited", "vis_n",
-                 "explored", "overflow", "flag_cnt", "flag_rows")}
+                 "explored", "overflow", "drops", "flag_cnt", "flag_rows")}
 
     # ----------------------------------------------------------------- run
 
@@ -296,8 +355,12 @@ class ShardedTensorSearch(TensorSearch):
         cur[owner * F] = rows0[0]
         cur_n = np.zeros((D,), np.int32)
         cur_n[owner] = 1
+        # Hash-table visited shard: the root key sits at its PROBE slot.
+        key0 = fp0[0].copy()
+        if (key0 == np.uint32(MAXU32)).all():   # EMPTY-marker collider
+            key0[3] = np.uint32(MAXU32 - 1)
         visited = np.full((D * (V + 1), 4), MAXU32, np.uint32)
-        visited[owner * (V + 1)] = fp0[0]
+        visited[owner * (V + 1) + (int(key0[2]) & (V - 1))] = key0
         vis_n = np.zeros((D,), np.int32)
         vis_n[owner] = 1
         nf = len(self._flag_names)
@@ -308,6 +371,7 @@ class ShardedTensorSearch(TensorSearch):
             "visited": visited, "vis_n": vis_n,
             "explored": np.zeros((D,), np.int32),
             "overflow": np.zeros((D,), np.int32),
+            "drops": np.zeros((D,), np.int32),
             "flag_cnt": np.zeros((D * nf,), np.int32).reshape(D * nf),
             "flag_rows": np.zeros((D * nf, lanes), np.int32),
         }
@@ -375,26 +439,42 @@ class ShardedTensorSearch(TensorSearch):
                 overflow = int(np.asarray(carry["overflow"]).sum())
                 if overflow:
                     raise CapacityOverflow(
-                        f"{self.p.name}: {overflow} drops at depth {depth} "
-                        f"(net/timer caps, routing bucket, frontier cap "
-                        f"{self.f_cap}/device, or visited cap "
-                        f"{self.v_cap}/device)")
+                        f"{self.p.name}: {overflow} semantic drops at depth "
+                        f"{depth} (net_cap/timer_cap or visited cap "
+                        f"{self.v_cap}/device overflowed; raise the caps)")
+                drops = int(np.asarray(carry["drops"]).sum())
+                if drops and self.strict:
+                    raise CapacityOverflow(
+                        f"{self.p.name}: {drops} capacity drops at depth "
+                        f"{depth} (routing bucket or frontier cap "
+                        f"{self.f_cap}/device; raise caps or run "
+                        f"strict=False for beam-style truncation)")
+                vis_counts = np.asarray(carry["vis_n"])
                 explored = int(np.asarray(carry["explored"]).sum())
-                vis_total = int(np.asarray(carry["vis_n"]).sum())
+                vis_total = int(vis_counts.sum())
+                # Terminal flags first: a violation/goal found this level is
+                # a valid verdict even if the table is filling up.
                 out = self._terminal_from_flags(carry, explored, vis_total,
                                                 depth, t0)
                 if out is not None:
+                    out.dropped = drops
                     return out
+                if vis_counts.max() > 3 * self.v_cap // 4:
+                    raise CapacityOverflow(
+                        f"{self.p.name}: visited hash table > 75% full "
+                        f"({int(vis_counts.max())}/{self.v_cap} per device) "
+                        f"at depth {depth}; raise visited_cap")
                 max_n = int(np.asarray(carry["nxt_n"]).max())
                 carry = self._finish_level(carry)
 
             return SearchOutcome(
                 "SPACE_EXHAUSTED", explored, vis_total, depth,
-                time.time() - t0)
+                time.time() - t0, dropped=drops)
 
     def _limit_outcome(self, cond, carry, depth, t0):
         return SearchOutcome(
             cond,
             int(np.asarray(carry["explored"]).sum()),
             int(np.asarray(carry["vis_n"]).sum()),
-            depth, time.time() - t0)
+            depth, time.time() - t0,
+            dropped=int(np.asarray(carry["drops"]).sum()))
