@@ -233,24 +233,23 @@ def canonicalize_net(net: jnp.ndarray) -> jnp.ndarray:
 
     [CAP, MW] -> [CAP, MW]; empty rows are all-SENTINEL and sort last.
     Records are ordered by their packed 128-bit fingerprint (any total
-    order works for canonicalisation as long as it is content-determined)."""
-
-    def keys(rows):
-        empty = rows[:, 0] == SENTINEL
-        return empty, row_fingerprints(rows)
-
-    empty, k = keys(net)
+    order works for canonicalisation as long as it is content-determined).
+    One sort + one scatter-compaction — duplicates (adjacent after the
+    sort) are dropped by scattering the kept rows to their rank."""
+    cap = net.shape[0]
+    empty = net[:, 0] == SENTINEL
+    k = row_fingerprints(net)
     # lexsort: LAST key is primary — empty rows always sort to the back.
     order = jnp.lexsort((k[:, 3], k[:, 2], k[:, 1], k[:, 0], empty))
-    net = net[order]
-    k, empty = k[order], empty[order]
-    dup = jnp.zeros(net.shape[0], dtype=bool).at[1:].set(
-        jnp.all(k[1:] == k[:-1], axis=1) & ~empty[1:])
-    net = jnp.where(dup[:, None], SENTINEL, net)
-    # One more sort pushes the duplicate-cleared rows to the back.
-    empty, k = keys(net)
-    order = jnp.lexsort((k[:, 3], k[:, 2], k[:, 1], k[:, 0], empty))
-    return net[order]
+    net_s = net[order]
+    k_s, empty_s = k[order], empty[order]
+    dup = jnp.zeros(cap, dtype=bool).at[1:].set(
+        jnp.all(k_s[1:] == k_s[:-1], axis=1) & ~empty_s[1:])
+    keep = ~dup & ~empty_s
+    pos = jnp.cumsum(keep) - 1
+    out = jnp.full((cap + 1, net.shape[1]), SENTINEL, net.dtype)
+    out = out.at[jnp.where(keep, pos, cap)].set(net_s)
+    return out[:cap]
 
 
 def insert_messages(net: jnp.ndarray,

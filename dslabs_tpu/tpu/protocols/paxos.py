@@ -9,6 +9,16 @@ application state.  Handler cascades (leader self-accept/self-vote on
 P2a/P2b, execution chains with client replies) are inlined exactly as the
 object's local ``deliver_message`` calls are.
 
+Performance-critical layout decision: the handlers do NOT thread the flat
+``[NW]`` lane vector through hundreds of functional updates — that made
+each vmapped update re-read/re-write the whole [batch, NW] array and one
+chunk step moved ~40 GB of HBM traffic (measured round 2, 5.5 s for a
+24k-successor chunk on a v5e).  Instead ``_unpack`` slices the vector once
+into a dict of small per-field arrays (ballot [n], log [n, S, 4], votes
+[n, n, 1+4S], ...), every update touches only its [batch, <=1+4S] column,
+and ``_repack`` concatenates the lanes back in the exact original order —
+so fingerprints, equality, and the engine contract are unchanged.
+
 Workload model: ``n_clients`` clients each Put their own key W times
 (value = f(seq)), so the KVStore + AMO state collapses to one
 last-executed-seq lane per client.  Command ids: ``c * W + s`` (1-based);
@@ -79,8 +89,16 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
     PAYLOAD = max(1 + 4 * S, 3, 2 + S)
     MW = 3 + PAYLOAD
     TW = 4  # [tag, min, max, p0]
-    MAX_SENDS = 64 + n   # SRV_SENDS + CLI_SENDS (finalize() asserts fit)
-    MAX_SETS = 4 + 1
+    # Exact static send/set row budgets (finalize() asserts the count at
+    # trace time; a miscount fails loudly, never truncates).  Server rows:
+    # req 2 + req-p2a (n-1) + p1a 1 + p1b [S(n-1) + S + (n-1)] + p2a 1 +
+    # p2b S + hb 2 + creq 1 + crep S.  Keeping this tight matters: every
+    # blank pad row rides through canonicalize_net's sort in the hot loop.
+    SRV_SENDS = 7 + 2 * (n - 1) + S * (n - 1) + 3 * S
+    SRV_SETS = 2
+    CLI_SENDS, CLI_SETS = n, 1
+    MAX_SENDS = SRV_SENDS + CLI_SENDS
+    MAX_SETS = SRV_SETS + CLI_SETS
 
     def cmd_id(client, seq):
         return client * w + seq  # 1-based; 0 = none/noop
@@ -142,119 +160,143 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
                 rows.append(blank)
             return jnp.stack(rows)
 
-    # ----------------------------------------------------- server accessors
+    # -------------------------------------------------- unpack/repack state
+    # st is a plain dict of small arrays; helpers mutate it in place (the
+    # values themselves stay immutable jnp arrays — data flow is functional).
+    #   b, ld, hd, si, ex, cl, gc, pm : [n]      scalars per server
+    #   peer [n, n]  amo [n, NC]  prop [n, NC]  p2bv [n, S]
+    #   log [n, S, 4]  votes [n, n, 1+4S]  k [NC]
 
-    def sbase(i):
-        return i * SW
+    def _unpack(nodes):
+        def per(off, width):
+            return jnp.stack([nodes[i * SW + off:i * SW + off + width]
+                              for i in range(n)])
 
-    def get(nodes, i, off):
-        return nodes[sbase(i) + off]
+        def sc(off):
+            return jnp.stack([nodes[i * SW + off] for i in range(n)])
 
-    def setv(nodes, i, off, val):
-        return nodes.at[sbase(i) + off].set(jnp.asarray(val, jnp.int32))
+        return {
+            "b": sc(0), "ld": sc(1), "hd": sc(2), "si": sc(3),
+            "ex": sc(4), "cl": sc(5), "gc": sc(6), "pm": sc(7),
+            "peer": per(PEER, n), "amo": per(AMO, NC),
+            "prop": per(PROP, NC), "p2bv": per(P2BV, S),
+            "log": per(LOG, 4 * S).reshape(n, S, 4),
+            "votes": per(VOTES, n * (1 + 4 * S)).reshape(n, n, 1 + 4 * S),
+            "k": nodes[n * SW:],
+        }
 
-    def log_get(nodes, i, slot):
-        """slot is 1-based traced int; returns (exists, ballot, cmd, chosen)
-        with slot clamped into range (callers mask)."""
-        s0 = sbase(i) + LOG + 4 * (slot - 1).clip(0, S - 1)
-        return (jax.lax.dynamic_slice(nodes, (s0,), (4,)))
+    def _repack(st):
+        parts = []
+        for i in range(n):
+            parts.extend([
+                st["b"][i][None], st["ld"][i][None], st["hd"][i][None],
+                st["si"][i][None], st["ex"][i][None], st["cl"][i][None],
+                st["gc"][i][None], st["pm"][i][None],
+                st["peer"][i], st["amo"][i], st["prop"][i], st["p2bv"][i],
+                st["log"][i].reshape(4 * S),
+                st["votes"][i].reshape(n * (1 + 4 * S)),
+            ])
+        parts.append(st["k"])
+        return jnp.concatenate(parts).astype(jnp.int32)
 
-    def log_set(nodes, i, slot, entry, cond):
-        s0 = sbase(i) + LOG + 4 * (slot - 1).clip(0, S - 1)
+    def _set(st, key, i, val):
+        st[key] = st[key].at[i].set(jnp.asarray(val, jnp.int32))
+
+    def log_get(st, i, slot):
+        """slot is 1-based traced int; returns [4] = (exists, ballot, cmd,
+        chosen) with slot clamped into range (callers mask)."""
+        return st["log"][i][(slot - 1).clip(0, S - 1)]
+
+    def log_set(st, i, slot, entry, cond):
+        idx = (slot - 1).clip(0, S - 1)
         in_range = (slot >= 1) & (slot <= S) & cond
-        cur = jax.lax.dynamic_slice(nodes, (s0,), (4,))
+        cur = st["log"][i][idx]
         new = jnp.where(in_range, jnp.asarray(entry, jnp.int32), cur)
-        return jax.lax.dynamic_update_slice(nodes, new, (s0,))
+        st["log"] = st["log"].at[i, idx].set(new)
 
-    def exec_chain(nodes, i, sends: Sends, cond):
+    def exec_chain(st, i, sends: Sends, cond):
         """Execute contiguous chosen slots (paxos.py _execute_chosen),
         sending client replies; leader updates its own peer_executed."""
         for _ in range(S):
-            ex = get(nodes, i, 4)
-            e = log_get(nodes, i, ex + 1)
+            ex = st["ex"][i]
+            e = log_get(st, i, ex + 1)
             can = cond & (ex + 1 <= S) & (e[0] == 1) & (e[3] == 1)
-            nodes = setv(nodes, i, 4, jnp.where(can, ex + 1, ex))
+            _set(st, "ex", i, jnp.where(can, ex + 1, ex))
             cmd = e[2]
             has_cmd = can & (cmd != 0)
             cl = cmd_client(cmd).clip(0, NC - 1)
             sq = cmd_seq(cmd)
-            last = jax.lax.dynamic_index_in_dim(
-                nodes, sbase(i) + AMO + cl, keepdims=False)
+            last = st["amo"][i][cl]
             reply = has_cmd & (sq >= last)
             newlast = jnp.where(has_cmd & (sq > last), sq, last)
-            nodes = jax.lax.dynamic_update_index_in_dim(
-                nodes, newlast.astype(jnp.int32), sbase(i) + AMO + cl, 0)
+            st["amo"] = st["amo"].at[i, cl].set(newlast.astype(jnp.int32))
             sends.add(reply, REPLY, i, n + cl, [cl, sq])
         # Leader bookkeeping + GC (object: peer_executed[self]=exec; gc)
-        is_leader = (cond & (get(nodes, i, 1) == 1)
-                     & (get(nodes, i, 0) % n == i))
-        return _leader_exec_update(nodes, i, is_leader)
+        is_leader = cond & (st["ld"][i] == 1) & (st["b"][i] % n == i)
+        _leader_exec_update(st, i, is_leader)
 
-    def _leader_exec_update(nodes, i, is_leader):
-        ex = get(nodes, i, 4)
-        mask = get(nodes, i, 7)
-        nodes = setv(nodes, i, 7,
-                     jnp.where(is_leader, mask | (1 << i), mask))
-        cur = get(nodes, i, PEER + i)
-        nodes = setv(nodes, i, PEER + i, jnp.where(is_leader, ex, cur))
-        return maybe_gc(nodes, i, is_leader)
+    def _leader_exec_update(st, i, is_leader):
+        ex = st["ex"][i]
+        mask = st["pm"][i]
+        _set(st, "pm", i, jnp.where(is_leader, mask | (1 << i), mask))
+        cur = st["peer"][i][i]
+        st["peer"] = st["peer"].at[i, i].set(
+            jnp.where(is_leader, ex, cur).astype(jnp.int32))
+        maybe_gc(st, i, is_leader)
 
-    def maybe_gc(nodes, i, cond):
-        mask = get(nodes, i, 7)
+    def maybe_gc(st, i, cond):
+        mask = st["pm"][i]
         have_all = mask == (1 << n) - 1
-        floor = get(nodes, i, PEER + 0)
+        floor = st["peer"][i][0]
         for j in range(1, n):
-            floor = jnp.minimum(floor, get(nodes, i, PEER + j))
-        do = cond & have_all & (floor > get(nodes, i, 6))
-        nodes = setv(nodes, i, 6,
-                     jnp.where(do, floor, get(nodes, i, 6)))
-        return gc_to(nodes, i, floor, do)
+            floor = jnp.minimum(floor, st["peer"][i][j])
+        do = cond & have_all & (floor > st["gc"][i])
+        _set(st, "gc", i, jnp.where(do, floor, st["gc"][i]))
+        gc_to(st, i, floor, do)
 
-    def gc_to(nodes, i, through, cond):
-        through = jnp.minimum(through, get(nodes, i, 4))
-        cleared = get(nodes, i, 5)
+    def gc_to(st, i, through, cond):
+        through = jnp.minimum(through, st["ex"][i])
+        cleared = st["cl"][i]
         do = cond & (through > cleared)
         for slot in range(1, S + 1):
-            clear = do & (jnp.asarray(slot) > cleared) & (jnp.asarray(slot) <= through)
-            nodes = log_set(nodes, i, jnp.asarray(slot), [0, 0, 0, 0], clear)
-        nodes = setv(nodes, i, 5, jnp.where(do, through, cleared))
-        return nodes
+            clear = do & (jnp.asarray(slot) > cleared) & \
+                (jnp.asarray(slot) <= through)
+            log_set(st, i, jnp.asarray(slot), [0, 0, 0, 0], clear)
+        _set(st, "cl", i, jnp.where(do, through, cleared))
 
-    def accept_p2a(nodes, i, ballot, slot, cmd, cond):
+    def accept_p2a(st, i, ballot, slot, cmd, cond):
         """The acceptor body of handle_P2a (ballot already >= checked)."""
-        e = log_get(nodes, i, slot)
-        write = cond & (slot > get(nodes, i, 5)) & ~((e[0] == 1) & (e[3] == 1))
-        return log_set(nodes, i, slot, [1, ballot, cmd, 0], write)
+        e = log_get(st, i, slot)
+        write = cond & (slot > st["cl"][i]) & ~((e[0] == 1) & (e[3] == 1))
+        log_set(st, i, slot, [1, ballot, cmd, 0], write)
 
-    def record_own_p2b(nodes, i, ballot, slot, cond):
+    def record_own_p2b(st, i, ballot, slot, cond):
         """Leader self-vote (send_p2a -> self P2a -> self P2b), which can
         never reach majority alone for n >= 2 (no cascade)."""
-        e = log_get(nodes, i, slot)
-        ok = (cond & (get(nodes, i, 0) == ballot)
+        e = log_get(st, i, slot)
+        ok = (cond & (st["b"][i] == ballot)
               & (e[0] == 1) & (e[3] == 0) & (e[1] == ballot))
-        off = sbase(i) + P2BV + (slot - 1).clip(0, S - 1)
-        cur = jax.lax.dynamic_index_in_dim(nodes, off, keepdims=False)
-        return jax.lax.dynamic_update_index_in_dim(
-            nodes, jnp.where(ok, cur | (1 << i), cur).astype(jnp.int32),
-            off, 0)
+        idx = (slot - 1).clip(0, S - 1)
+        cur = st["p2bv"][i][idx]
+        st["p2bv"] = st["p2bv"].at[i, idx].set(
+            jnp.where(ok, cur | (1 << i), cur).astype(jnp.int32))
 
-    def send_p2a(nodes, i, slot, sends: Sends, cond):
+    def send_p2a(st, i, slot, sends: Sends, cond):
         """Broadcast P2a for log[slot] + inline self-accept/self-vote."""
-        e = log_get(nodes, i, slot)
-        ballot = get(nodes, i, 0)
+        e = log_get(st, i, slot)
+        ballot = st["b"][i]
         for j in range(n):
             if j == i:
                 continue
             sends.add(cond, P2A, i, j, [ballot, slot, e[2]])
-        nodes = accept_p2a(nodes, i, ballot, slot, e[2], cond)
-        nodes = setv(nodes, i, 2, jnp.where(cond, 1, get(nodes, i, 2)))
-        nodes = record_own_p2b(nodes, i, ballot, slot, cond)
-        return nodes
+        accept_p2a(st, i, ballot, slot, e[2], cond)
+        _set(st, "hd", i, jnp.where(cond, 1, st["hd"][i]))
+        record_own_p2b(st, i, ballot, slot, cond)
 
-    def heartbeat_sends(nodes, i, sends: Sends, cond):
-        ballot = get(nodes, i, 0)
-        commit = get(nodes, i, 4)
-        gc = get(nodes, i, 6)
+    def heartbeat_sends(st, i, sends: Sends, cond):
+        ballot = st["b"][i]
+        commit = st["ex"][i]
+        gc = st["gc"][i]
         for j in range(n):
             if j == i:
                 continue
@@ -262,20 +304,15 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
 
     # ----------------------------------------------------- message handlers
 
-    # Row budgets per handler block (static add-counts; asserted in
-    # finalize).  Branch blocks are mutually exclusive, so they share rows.
-    SRV_SENDS, SRV_SETS = 64, 4
-    CLI_SENDS, CLI_SETS = n, 1
-
     def step_message(nodes, msg):
         tag, frm, to = msg[0], msg[1], msg[2]
         p = msg[3:]
-        out = nodes
+        st = _unpack(nodes)
         srv_rows, srv_sets = None, None
         for i in range(n):
             here = to == i
             sends, sets = Sends(), Sets()
-            out = _server_handle(out, i, here, tag, frm, p, sends, sets)
+            _server_handle(st, i, here, tag, frm, p, sends, sets)
             r, t = sends.finalize(SRV_SENDS), sets.finalize(SRV_SETS)
             srv_rows = r if srv_rows is None else jnp.minimum(srv_rows, r)
             srv_sets = t if srv_sets is None else jnp.minimum(srv_sets, t)
@@ -283,142 +320,134 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
         for c in range(NC):
             here = to == n + c
             sends, sets = Sends(), Sets()
-            out = _client_handle(out, c, here, tag, p, sends, sets)
+            _client_handle(st, c, here, tag, p, sends, sets)
             r, t = sends.finalize(CLI_SENDS), sets.finalize(CLI_SETS)
             cli_rows = r if cli_rows is None else jnp.minimum(cli_rows, r)
             cli_sets = t if cli_sets is None else jnp.minimum(cli_sets, t)
         rows = jnp.concatenate([srv_rows, cli_rows])
         tsets = jnp.concatenate([srv_sets, cli_sets])
-        return out, rows, tsets
+        return _repack(st), rows, tsets
 
-    def _server_handle(nodes, i, here, tag, frm, p, sends, sets):
-        ballot = get(nodes, i, 0)
+    def _server_handle(st, i, here, tag, frm, p, sends, sets):
+        ballot = st["b"][i]
 
         # ---- PaxosRequest (handle_PaxosRequest, paxos.py)
         is_req = here & (tag == REQ)
         client, seq = p[0], p[1]
-        amo_last = jax.lax.dynamic_index_in_dim(
-            nodes, sbase(i) + AMO + client.clip(0, NC - 1), keepdims=False)
+        ci = client.clip(0, NC - 1)
+        amo_last = st["amo"][i][ci]
         already = seq <= amo_last
         sends.add(is_req & already & (seq == amo_last), REPLY, i,
                   n + client, [client, seq])
-        is_leader = (get(nodes, i, 1) == 1) & (ballot % n == i)
+        is_leader = (st["ld"][i] == 1) & (ballot % n == i)
         believed = ballot % n
         fwd = (is_req & ~already & ~is_leader
                & ((frm == i) | (frm >= n)) & (believed != i))
         sends.add(fwd, REQ, i, believed, [client, seq])
-        prop = jax.lax.dynamic_index_in_dim(
-            nodes, sbase(i) + PROP + client.clip(0, NC - 1), keepdims=False)
+        prop = st["prop"][i][ci]
         do_prop = is_req & ~already & is_leader & (seq > prop)
-        slot = get(nodes, i, 3)
+        slot = st["si"][i]
         in_range = slot <= S
         do_prop = do_prop & in_range
-        nodes = jax.lax.dynamic_update_index_in_dim(
-            nodes, jnp.where(do_prop, seq, prop).astype(jnp.int32),
-            sbase(i) + PROP + client.clip(0, NC - 1), 0)
-        nodes = setv(nodes, i, 3, jnp.where(do_prop, slot + 1, slot))
-        nodes = log_set(nodes, i, slot,
-                        [1, ballot, cmd_id(client, seq), 0], do_prop)
-        nodes = send_p2a(nodes, i, slot, sends, do_prop)
+        st["prop"] = st["prop"].at[i, ci].set(
+            jnp.where(do_prop, seq, prop).astype(jnp.int32))
+        _set(st, "si", i, jnp.where(do_prop, slot + 1, slot))
+        log_set(st, i, slot, [1, ballot, cmd_id(client, seq), 0], do_prop)
+        send_p2a(st, i, slot, sends, do_prop)
 
         # ---- P1a (handle_P1a)
         is_p1a = here & (tag == P1A)
         mb = p[0]
         adopt = is_p1a & (mb > ballot)
-        nodes = setv(nodes, i, 0, jnp.where(adopt, mb, get(nodes, i, 0)))
-        nodes = setv(nodes, i, 1, jnp.where(adopt, 0, get(nodes, i, 1)))
-        promise = is_p1a & (mb == get(nodes, i, 0))
-        log_flat = jax.lax.dynamic_slice(nodes, (sbase(i) + LOG,), (4 * S,))
+        _set(st, "b", i, jnp.where(adopt, mb, st["b"][i]))
+        _set(st, "ld", i, jnp.where(adopt, 0, st["ld"][i]))
+        promise = is_p1a & (mb == st["b"][i])
+        log_flat = st["log"][i].reshape(4 * S)
         sends.add(promise, P1B, i, frm,
-                  [get(nodes, i, 0)] + [log_flat[j] for j in range(4 * S)])
+                  [st["b"][i]] + [log_flat[j] for j in range(4 * S)])
 
         # ---- P1b (handle_P1b)
         is_p1b = here & (tag == P1B)
         vb = p[0]
-        accept_vote = (is_p1b & (vb == get(nodes, i, 0))
-                       & (get(nodes, i, 0) % n == i)
-                       & (get(nodes, i, 1) == 0))
-        voff = sbase(i) + VOTES + frm.clip(0, n - 1) * (1 + 4 * S)
+        accept_vote = (is_p1b & (vb == st["b"][i])
+                       & (st["b"][i] % n == i)
+                       & (st["ld"][i] == 0))
+        fi = frm.clip(0, n - 1)
         vrec = jnp.concatenate([jnp.ones((1,), jnp.int32),
                                 p[1:1 + 4 * S].astype(jnp.int32)])
-        cur_v = jax.lax.dynamic_slice(nodes, (voff,), (1 + 4 * S,))
-        nodes = jax.lax.dynamic_update_slice(
-            nodes, jnp.where(accept_vote, vrec, cur_v), (voff,))
-        nvotes = jnp.zeros((), jnp.int32)
-        for j in range(n):
-            nvotes = nvotes + get(nodes, i, VOTES + j * (1 + 4 * S))
+        cur_v = st["votes"][i][fi]
+        st["votes"] = st["votes"].at[i, fi].set(
+            jnp.where(accept_vote, vrec, cur_v))
+        nvotes = jnp.sum(st["votes"][i][:, 0])
         win = accept_vote & (nvotes >= maj)
-        nodes = _p1b_win(nodes, i, win, sends, sets)
+        _p1b_win(st, i, win, sends, sets)
 
         # ---- P2a (handle_P2a)
         is_p2a = here & (tag == P2A)
         ab, aslot, acmd = p[0], p[1], p[2]
-        ok2a = is_p2a & (ab >= get(nodes, i, 0))
-        nodes = setv(nodes, i, 1,
-                     jnp.where(ok2a & (ab > get(nodes, i, 0)), 0,
-                               get(nodes, i, 1)))
-        nodes = setv(nodes, i, 0, jnp.where(ok2a, ab, get(nodes, i, 0)))
-        nodes = setv(nodes, i, 2, jnp.where(ok2a, 1, get(nodes, i, 2)))
-        nodes = accept_p2a(nodes, i, ab, aslot, acmd, ok2a)
+        ok2a = is_p2a & (ab >= st["b"][i])
+        _set(st, "ld", i, jnp.where(ok2a & (ab > st["b"][i]), 0,
+                                    st["ld"][i]))
+        _set(st, "b", i, jnp.where(ok2a, ab, st["b"][i]))
+        _set(st, "hd", i, jnp.where(ok2a, 1, st["hd"][i]))
+        accept_p2a(st, i, ab, aslot, acmd, ok2a)
         sends.add(ok2a, P2B, i, frm, [ab, aslot])
 
         # ---- P2b (handle_P2b)
         is_p2b = here & (tag == P2B)
         bb, bslot = p[0], p[1]
-        lead_ok = (is_p2b & (bb == get(nodes, i, 0))
-                   & (get(nodes, i, 1) == 1) & (get(nodes, i, 0) % n == i))
-        e = log_get(nodes, i, bslot)
+        lead_ok = (is_p2b & (bb == st["b"][i])
+                   & (st["ld"][i] == 1) & (st["b"][i] % n == i))
+        e = log_get(st, i, bslot)
         count_ok = lead_ok & (e[0] == 1) & (e[3] == 0) & (e[1] == bb)
-        p2off = sbase(i) + P2BV + (bslot - 1).clip(0, S - 1)
-        vmask = jax.lax.dynamic_index_in_dim(nodes, p2off, keepdims=False)
-        vmask2 = jnp.where(count_ok, vmask | (1 << frm.clip(0, n - 1)), vmask)
+        bidx = (bslot - 1).clip(0, S - 1)
+        vmask = st["p2bv"][i][bidx]
+        vmask2 = jnp.where(count_ok, vmask | (1 << frm.clip(0, n - 1)),
+                           vmask)
         chosen_now = count_ok & (_popcount(vmask2) >= maj)
-        nodes = jax.lax.dynamic_update_index_in_dim(
-            nodes, jnp.where(chosen_now, 0, vmask2).astype(jnp.int32),
-            p2off, 0)
-        nodes = log_set(nodes, i, bslot, [1, e[1], e[2], 1], chosen_now)
-        nodes = _maybe_exec(nodes, i, chosen_now, sends)
+        st["p2bv"] = st["p2bv"].at[i, bidx].set(
+            jnp.where(chosen_now, 0, vmask2).astype(jnp.int32))
+        log_set(st, i, bslot, [1, e[1], e[2], 1], chosen_now)
+        exec_chain(st, i, sends, chosen_now)
 
         # ---- Heartbeat (handle_Heartbeat)
         is_hb = here & (tag == HB)
         hb_b, hb_commit, hb_gc = p[0], p[1], p[2]
-        hb_ok = is_hb & (hb_b >= get(nodes, i, 0))
-        nodes = setv(nodes, i, 1,
-                     jnp.where(hb_ok & (hb_b > get(nodes, i, 0)), 0,
-                               get(nodes, i, 1)))
-        nodes = setv(nodes, i, 0, jnp.where(hb_ok, hb_b, get(nodes, i, 0)))
-        nodes = setv(nodes, i, 2, jnp.where(hb_ok, 1, get(nodes, i, 2)))
-        nodes = gc_to(nodes, i, hb_gc, hb_ok)
-        lagging = hb_ok & (get(nodes, i, 4) < hb_commit)
-        sends.add(lagging, CREQ, i, frm, [get(nodes, i, 4) + 1])
-        sends.add(hb_ok, HBR, i, frm, [get(nodes, i, 0), get(nodes, i, 4)])
+        hb_ok = is_hb & (hb_b >= st["b"][i])
+        _set(st, "ld", i, jnp.where(hb_ok & (hb_b > st["b"][i]), 0,
+                                    st["ld"][i]))
+        _set(st, "b", i, jnp.where(hb_ok, hb_b, st["b"][i]))
+        _set(st, "hd", i, jnp.where(hb_ok, 1, st["hd"][i]))
+        gc_to(st, i, hb_gc, hb_ok)
+        lagging = hb_ok & (st["ex"][i] < hb_commit)
+        sends.add(lagging, CREQ, i, frm, [st["ex"][i] + 1])
+        sends.add(hb_ok, HBR, i, frm, [st["b"][i], st["ex"][i]])
 
         # ---- HeartbeatReply (handle_HeartbeatReply)
         is_hbr = here & (tag == HBR)
         rb, rexec = p[0], p[1]
-        hbr_ok = (is_hbr & (rb == get(nodes, i, 0))
-                  & (get(nodes, i, 1) == 1) & (get(nodes, i, 0) % n == i))
-        poff = sbase(i) + PEER + frm.clip(0, n - 1)
-        pcur = jax.lax.dynamic_index_in_dim(nodes, poff, keepdims=False)
-        nodes = jax.lax.dynamic_update_index_in_dim(
-            nodes, jnp.where(hbr_ok, jnp.maximum(pcur, rexec),
-                             pcur).astype(jnp.int32), poff, 0)
-        mask = get(nodes, i, 7)
-        nodes = setv(nodes, i, 7,
-                     jnp.where(hbr_ok, mask | (1 << frm.clip(0, n - 1)),
-                               mask))
-        nodes = maybe_gc(nodes, i, hbr_ok)
+        hbr_ok = (is_hbr & (rb == st["b"][i])
+                  & (st["ld"][i] == 1) & (st["b"][i] % n == i))
+        pfi = frm.clip(0, n - 1)
+        pcur = st["peer"][i][pfi]
+        st["peer"] = st["peer"].at[i, pfi].set(
+            jnp.where(hbr_ok, jnp.maximum(pcur, rexec),
+                      pcur).astype(jnp.int32))
+        mask = st["pm"][i]
+        _set(st, "pm", i,
+             jnp.where(hbr_ok, mask | (1 << frm.clip(0, n - 1)), mask))
+        maybe_gc(st, i, hbr_ok)
 
         # ---- CatchupRequest (handle_CatchupRequest)
         is_cq = here & (tag == CREQ)
-        from_slot = jnp.maximum(p[0], get(nodes, i, 5) + 1)
+        from_slot = jnp.maximum(p[0], st["cl"][i] + 1)
         cmds = []
         count = jnp.zeros((), jnp.int32)
         contiguous = jnp.asarray(True)
         for k in range(S):
             slot = from_slot + k
-            e = log_get(nodes, i, slot)
-            ok = (contiguous & (slot <= get(nodes, i, 4))
+            e = log_get(st, i, slot)
+            ok = (contiguous & (slot <= st["ex"][i])
                   & (e[0] == 1) & (e[3] == 1))
             contiguous = ok
             cmds.append(jnp.where(ok, e[2], 0))
@@ -432,33 +461,25 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
         for k in range(S):
             slot = base + k
             cmd = p[2 + k]
-            e = log_get(nodes, i, slot)
+            e = log_get(st, i, slot)
             install = (is_cp & (jnp.asarray(k) < ccount)
-                       & (slot > get(nodes, i, 5))
+                       & (slot > st["cl"][i])
                        & ~((e[0] == 1) & (e[3] == 1)))
-            nodes = log_set(nodes, i, slot,
-                            [1, get(nodes, i, 0), cmd, 1], install)
-        nodes = _maybe_exec(nodes, i, is_cp, sends)
-        return nodes
+            log_set(st, i, slot, [1, st["b"][i], cmd, 1], install)
+        exec_chain(st, i, sends, is_cp)
 
-    def _maybe_exec(nodes, i, cond, sends):
-        return exec_chain(nodes, i, sends, cond)
-
-    def _p1b_win(nodes, i, win, sends: Sends, sets: Sets):
+    def _p1b_win(st, i, win, sends: Sends, sets: Sets):
         """Phase-1 victory (handle_P1b body after majority)."""
-        ballot = get(nodes, i, 0)
-        nodes = setv(nodes, i, 1, jnp.where(win, 1, get(nodes, i, 1)))
+        ballot = st["b"][i]
+        _set(st, "ld", i, jnp.where(win, 1, st["ld"][i]))
         # p2b_votes = {}; peer_executed = {self: exec}
-        for s in range(S):
-            nodes = setv(nodes, i, P2BV + s,
-                         jnp.where(win, 0, get(nodes, i, P2BV + s)))
-        nodes = setv(nodes, i, 7,
-                     jnp.where(win, 1 << i, get(nodes, i, 7)))
-        for j in range(n):
-            nodes = setv(nodes, i, PEER + j,
-                         jnp.where(win & (jnp.asarray(j) == i),
-                                   get(nodes, i, 4),
-                                   jnp.where(win, 0, get(nodes, i, PEER + j))))
+        st["p2bv"] = st["p2bv"].at[i].set(
+            jnp.where(win, jnp.zeros((S,), jnp.int32), st["p2bv"][i]))
+        _set(st, "pm", i, jnp.where(win, 1 << i, st["pm"][i]))
+        me = jnp.arange(n) == i
+        st["peer"] = st["peer"].at[i].set(
+            jnp.where(win, jnp.where(me, st["ex"][i], 0),
+                      st["peer"][i]).astype(jnp.int32))
         # Adoption: per slot, chosen wins; else max-ballot accepted.
         for s in range(1, S + 1):
             a_ex = jnp.zeros((), jnp.int32)
@@ -466,12 +487,11 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
             a_c = jnp.zeros((), jnp.int32)
             a_ch = jnp.zeros((), jnp.int32)
             for j in range(n):
-                vo = sbase(i) + VOTES + j * (1 + 4 * S)
-                have = nodes[vo]
-                ex = nodes[vo + 1 + 4 * (s - 1) + 0]
-                vb = nodes[vo + 1 + 4 * (s - 1) + 1]
-                vc = nodes[vo + 1 + 4 * (s - 1) + 2]
-                vch = nodes[vo + 1 + 4 * (s - 1) + 3]
+                have = st["votes"][i][j, 0]
+                ex = st["votes"][i][j, 1 + 4 * (s - 1) + 0]
+                vb = st["votes"][i][j, 1 + 4 * (s - 1) + 1]
+                vc = st["votes"][i][j, 1 + 4 * (s - 1) + 2]
+                vch = st["votes"][i][j, 1 + 4 * (s - 1) + 3]
                 valid = (have == 1) & (ex == 1)
                 take = valid & ((vch == 1) & (a_ch == 0)
                                 | (a_ch == 0) & ((a_ex == 0) | (vb > a_b)))
@@ -479,62 +499,60 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
                 a_c = jnp.where(take, vc, a_c)
                 a_ch = jnp.where(take, jnp.maximum(a_ch, vch), a_ch)
                 a_ex = jnp.where(take, 1, a_ex)
-            mine = log_get(nodes, i, jnp.asarray(s))
-            adopt = win & (a_ex == 1) & (jnp.asarray(s) > get(nodes, i, 5)) \
+            mine = st["log"][i, s - 1]
+            adopt = win & (a_ex == 1) & (jnp.asarray(s) > st["cl"][i]) \
                 & ~((mine[0] == 1) & (mine[3] == 1))
-            nodes = log_set(nodes, i, jnp.asarray(s),
-                            [1, ballot, a_c, a_ch], adopt)
+            log_set(st, i, jnp.asarray(s), [1, ballot, a_c, a_ch], adopt)
         # top = last non-empty; fill holes with no-ops; repropose unchosen.
-        top = get(nodes, i, 5)
+        top = st["cl"][i]
         for s in range(1, S + 1):
-            e = log_get(nodes, i, jnp.asarray(s))
+            e = st["log"][i, s - 1]
             top = jnp.where(e[0] == 1, jnp.asarray(s, jnp.int32), top)
         for s in range(1, S + 1):
-            e = log_get(nodes, i, jnp.asarray(s))
-            in_span = win & (jnp.asarray(s) > get(nodes, i, 4)) & (jnp.asarray(s) <= top)
+            e = st["log"][i, s - 1]
+            in_span = win & (jnp.asarray(s) > st["ex"][i]) & \
+                (jnp.asarray(s) <= top)
             fill = in_span & (e[0] == 0)
-            nodes = log_set(nodes, i, jnp.asarray(s), [1, ballot, 0, 0], fill)
-            e2 = log_get(nodes, i, jnp.asarray(s))
+            log_set(st, i, jnp.asarray(s), [1, ballot, 0, 0], fill)
+            e2 = st["log"][i, s - 1]
             reprop = in_span & (e2[3] == 0)
-            nodes = send_p2a(nodes, i, jnp.asarray(s, jnp.int32), sends, reprop)
-        nodes = setv(nodes, i, 3, jnp.where(win, top + 1, get(nodes, i, 3)))
+            send_p2a(st, i, jnp.asarray(s, jnp.int32), sends, reprop)
+        _set(st, "si", i, jnp.where(win, top + 1, st["si"][i]))
         # proposed_seq from logged commands (max seq per client).
         for c in range(NC):
             best = jnp.zeros((), jnp.int32)
             for s in range(1, S + 1):
-                e = log_get(nodes, i, jnp.asarray(s))
+                e = st["log"][i, s - 1]
                 mine_c = (e[0] == 1) & (e[2] != 0) & (cmd_client(e[2]) == c)
-                best = jnp.where(mine_c, jnp.maximum(best, cmd_seq(e[2])), best)
-            nodes = setv(nodes, i, PROP + c,
-                         jnp.where(win, best, get(nodes, i, PROP + c)))
-        nodes = _maybe_exec(nodes, i, win, sends)
+                best = jnp.where(mine_c,
+                                 jnp.maximum(best, cmd_seq(e[2])), best)
+            st["prop"] = st["prop"].at[i, c].set(
+                jnp.where(win, best, st["prop"][i][c]).astype(jnp.int32))
+        exec_chain(st, i, sends, win)
         sets.add(win, i, T_HEARTBEAT, HEARTBEAT_MS, HEARTBEAT_MS, ballot)
-        heartbeat_sends(nodes, i, sends, win)
-        return nodes
+        heartbeat_sends(st, i, sends, win)
 
-    def _client_handle(nodes, c, here, tag, p, sends: Sends, sets: Sets):
-        koff = n * SW + c
-        k = nodes[koff]
+    def _client_handle(st, c, here, tag, p, sends: Sends, sets: Sets):
+        k = st["k"][c]
         is_reply = here & (tag == REPLY) & (p[0] == c)
         match = is_reply & (p[1] == k) & (k <= w)
         k2 = jnp.where(match, k + 1, k)
-        nodes = nodes.at[koff].set(k2)
+        st["k"] = st["k"].at[c].set(k2.astype(jnp.int32))
         has_next = match & (k2 <= w)
         for j in range(n):
             sends.add(has_next, REQ, n + c, j, [jnp.asarray(c), k2])
         sets.add(has_next, n + c, T_CLIENT, CLIENT_MS, CLIENT_MS, k2)
-        return nodes
 
     # ------------------------------------------------------- timer handlers
 
     def step_timer(nodes, node_idx, timer):
         tag, p0 = timer[0], timer[3]
-        out = nodes
+        st = _unpack(nodes)
         srv_rows, srv_sets = None, None
         for i in range(n):
             here = node_idx == i
             sends, sets = Sends(), Sets()
-            out = _server_timer(out, i, here, tag, p0, sends, sets)
+            _server_timer(st, i, here, tag, p0, sends, sets)
             r, t = sends.finalize(SRV_SENDS), sets.finalize(SRV_SETS)
             srv_rows = r if srv_rows is None else jnp.minimum(srv_rows, r)
             srv_sets = t if srv_sets is None else jnp.minimum(srv_sets, t)
@@ -542,8 +560,7 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
         for c in range(NC):
             here = node_idx == n + c
             sends, sets = Sends(), Sets()
-            koff = n * SW + c
-            k = out[koff]
+            k = st["k"][c]
             live = here & (tag == T_CLIENT) & (p0 == k) & (k <= w)
             for j in range(n):
                 sends.add(live, REQ, n + c, j, [jnp.asarray(c), k])
@@ -553,58 +570,52 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
             cli_sets = t if cli_sets is None else jnp.minimum(cli_sets, t)
         rows = jnp.concatenate([srv_rows, cli_rows])
         tsets = jnp.concatenate([srv_sets, cli_sets])
-        return out, rows, tsets
+        return _repack(st), rows, tsets
 
-    def _server_timer(nodes, i, here, tag, p0, sends: Sends, sets: Sets):
-        ballot = get(nodes, i, 0)
-        is_leader = (get(nodes, i, 1) == 1) & (ballot % n == i)
+    def _server_timer(st, i, here, tag, p0, sends: Sends, sets: Sets):
+        ballot = st["b"][i]
+        is_leader = (st["ld"][i] == 1) & (ballot % n == i)
 
         # ---- ElectionTimer (on_ElectionTimer + _start_election inline)
         is_el = here & (tag == T_ELECTION)
-        elect = is_el & ~is_leader & (get(nodes, i, 2) == 0)
+        elect = is_el & ~is_leader & (st["hd"][i] == 0)
         new_ballot = (ballot // n + 1) * n + i
-        nodes = setv(nodes, i, 0, jnp.where(elect, new_ballot, get(nodes, i, 0)))
-        nodes = setv(nodes, i, 1, jnp.where(elect, 0, get(nodes, i, 1)))
-        for j in range(n):
-            vo = sbase(i) + VOTES + j * (1 + 4 * S)
-            cur = jax.lax.dynamic_slice(nodes, (vo,), (1 + 4 * S,))
-            nodes = jax.lax.dynamic_update_slice(
-                nodes, jnp.where(elect, jnp.zeros_like(cur), cur), (vo,))
+        _set(st, "b", i, jnp.where(elect, new_ballot, st["b"][i]))
+        _set(st, "ld", i, jnp.where(elect, 0, st["ld"][i]))
+        st["votes"] = st["votes"].at[i].set(
+            jnp.where(elect, jnp.zeros((n, 1 + 4 * S), jnp.int32),
+                      st["votes"][i]))
         for j in range(n):
             if j == i:
                 continue
             sends.add(elect, P1A, i, j, [new_ballot])
         # Self-promise: own vote with own log (P1a -> P1b self-delivery).
-        log_flat = jax.lax.dynamic_slice(nodes, (sbase(i) + LOG,), (4 * S,))
-        vo = sbase(i) + VOTES + i * (1 + 4 * S)
-        own = jnp.concatenate([jnp.ones((1,), jnp.int32), log_flat])
-        cur = jax.lax.dynamic_slice(nodes, (vo,), (1 + 4 * S,))
-        nodes = jax.lax.dynamic_update_slice(
-            nodes, jnp.where(elect, own, cur), (vo,))
+        own = jnp.concatenate([jnp.ones((1,), jnp.int32),
+                               st["log"][i].reshape(4 * S)])
+        st["votes"] = st["votes"].at[i, i].set(
+            jnp.where(elect, own, st["votes"][i][i]))
         # (majority with one vote only when n == 1 — not modelled here)
-        nodes = setv(nodes, i, 2, jnp.where(is_el, 0, get(nodes, i, 2)))
+        _set(st, "hd", i, jnp.where(is_el, 0, st["hd"][i]))
         sets.add(is_el, i, T_ELECTION, ELECTION_MIN, ELECTION_MAX, 0)
 
         # ---- HeartbeatTimer (on_HeartbeatTimer)
         is_hbt = here & (tag == T_HEARTBEAT)
-        live = is_hbt & (p0 == get(nodes, i, 0)) & is_leader
-        heartbeat_sends(nodes, i, sends, live)
+        live = is_hbt & (p0 == st["b"][i]) & is_leader
+        heartbeat_sends(st, i, sends, live)
         for s in range(1, S + 1):
-            e = log_get(nodes, i, jnp.asarray(s))
-            inflight = (live & (jnp.asarray(s) > get(nodes, i, 4))
-                        & (jnp.asarray(s) < get(nodes, i, 3))
+            e = st["log"][i, s - 1]
+            inflight = (live & (jnp.asarray(s) > st["ex"][i])
+                        & (jnp.asarray(s) < st["si"][i])
                         & (e[0] == 1) & (e[3] == 0))
-            nodes = send_p2a(nodes, i, jnp.asarray(s, jnp.int32), sends,
-                             inflight)
+            send_p2a(st, i, jnp.asarray(s, jnp.int32), sends, inflight)
         sets.add(live, i, T_HEARTBEAT, HEARTBEAT_MS, HEARTBEAT_MS, p0)
-        return nodes
 
     # ------------------------------------------------------------ initials
 
     def init_nodes():
         nodes = np.zeros((NW,), np.int32)
         for i in range(n):
-            nodes[sbase(i) + 3] = 1  # slot_in = 1
+            nodes[i * SW + 3] = 1  # slot_in = 1
         for c in range(NC):
             nodes[n * SW + c] = 1    # first command in flight
         return nodes
@@ -653,9 +664,9 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
             seen = jnp.zeros((), jnp.int32)
             bad = jnp.asarray(False)
             for i in range(n):
-                e0 = nodes[sbase(i) + LOG + 4 * (s - 1)]
-                ech = nodes[sbase(i) + LOG + 4 * (s - 1) + 3]
-                ec = nodes[sbase(i) + LOG + 4 * (s - 1) + 2]
+                e0 = nodes[i * SW + LOG + 4 * (s - 1)]
+                ech = nodes[i * SW + LOG + 4 * (s - 1) + 3]
+                ec = nodes[i * SW + LOG + 4 * (s - 1) + 2]
                 is_ch = (e0 == 1) & (ech == 1)
                 bad = bad | (is_ch & (seen == 1) & (ec != chosen_cmd))
                 chosen_cmd = jnp.where(is_ch, ec, chosen_cmd)

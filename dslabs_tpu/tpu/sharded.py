@@ -435,41 +435,65 @@ class ShardedTensorSearch(TensorSearch):
                 n_chunks = -(-max_n // self.cpd)
                 for j in range(n_chunks):
                     carry = self._chunk_step(carry, jnp.int32(j))
+                    # Respect the time budget inside long levels too.  The
+                    # partial level runs the same overflow/terminal-flag
+                    # checks as a full level before reporting, so a
+                    # violation or capacity loss in the chunks already
+                    # processed is never masked by TIME_EXHAUSTED.
+                    if (self.max_secs is not None and j + 1 < n_chunks
+                            and time.time() - t0 > self.max_secs):
+                        out, _, _, drops = self._sync_checks(carry, depth,
+                                                             t0)
+                        if out is not None:
+                            return out
+                        return self._limit_outcome("TIME_EXHAUSTED", carry,
+                                                   depth, t0)
                 # ---- the one host sync per level
-                overflow = int(np.asarray(carry["overflow"]).sum())
-                if overflow:
-                    raise CapacityOverflow(
-                        f"{self.p.name}: {overflow} semantic drops at depth "
-                        f"{depth} (net_cap/timer_cap or visited cap "
-                        f"{self.v_cap}/device overflowed; raise the caps)")
-                drops = int(np.asarray(carry["drops"]).sum())
-                if drops and self.strict:
-                    raise CapacityOverflow(
-                        f"{self.p.name}: {drops} capacity drops at depth "
-                        f"{depth} (routing bucket or frontier cap "
-                        f"{self.f_cap}/device; raise caps or run "
-                        f"strict=False for beam-style truncation)")
-                vis_counts = np.asarray(carry["vis_n"])
-                explored = int(np.asarray(carry["explored"]).sum())
-                vis_total = int(vis_counts.sum())
-                # Terminal flags first: a violation/goal found this level is
-                # a valid verdict even if the table is filling up.
-                out = self._terminal_from_flags(carry, explored, vis_total,
-                                                depth, t0)
+                out, explored, vis_total, drops = self._sync_checks(
+                    carry, depth, t0)
                 if out is not None:
-                    out.dropped = drops
                     return out
-                if vis_counts.max() > 3 * self.v_cap // 4:
-                    raise CapacityOverflow(
-                        f"{self.p.name}: visited hash table > 75% full "
-                        f"({int(vis_counts.max())}/{self.v_cap} per device) "
-                        f"at depth {depth}; raise visited_cap")
                 max_n = int(np.asarray(carry["nxt_n"]).max())
                 carry = self._finish_level(carry)
 
             return SearchOutcome(
                 "SPACE_EXHAUSTED", explored, vis_total, depth,
                 time.time() - t0, dropped=drops)
+
+    def _sync_checks(self, carry, depth, t0):
+        """The per-sync check pipeline: semantic overflow (raise) ->
+        strict-mode drops (raise) -> terminal flags (checkState order) ->
+        visited load factor (raise).  Returns
+        (outcome_or_none, explored, vis_total, drops)."""
+        overflow = int(np.asarray(carry["overflow"]).sum())
+        if overflow:
+            raise CapacityOverflow(
+                f"{self.p.name}: {overflow} semantic drops at depth "
+                f"{depth} (net_cap/timer_cap or visited cap "
+                f"{self.v_cap}/device overflowed; raise the caps)")
+        drops = int(np.asarray(carry["drops"]).sum())
+        if drops and self.strict:
+            raise CapacityOverflow(
+                f"{self.p.name}: {drops} capacity drops at depth "
+                f"{depth} (routing bucket or frontier cap "
+                f"{self.f_cap}/device; raise caps or run "
+                f"strict=False for beam-style truncation)")
+        vis_counts = np.asarray(carry["vis_n"])
+        explored = int(np.asarray(carry["explored"]).sum())
+        vis_total = int(vis_counts.sum())
+        # Terminal flags before the load-factor guard: a violation/goal
+        # found this level is a valid verdict even if the table is full.
+        out = self._terminal_from_flags(carry, explored, vis_total,
+                                        depth, t0)
+        if out is not None:
+            out.dropped = drops
+            return out, explored, vis_total, drops
+        if vis_counts.max() > 3 * self.v_cap // 4:
+            raise CapacityOverflow(
+                f"{self.p.name}: visited hash table > 75% full "
+                f"({int(vis_counts.max())}/{self.v_cap} per device) "
+                f"at depth {depth}; raise visited_cap")
+        return None, explored, vis_total, drops
 
     def _limit_outcome(self, cond, carry, depth, t0):
         return SearchOutcome(
