@@ -1,0 +1,63 @@
+"""Per-test stdout/stderr capture with size caps — TeeStdOutErr
+(utils/TeeStdOutErr.java:34-134) re-designed as a context manager.
+
+Output still flows to the real streams (the console reporter interleaves
+with test output, as in the reference); the captured copy (truncated at
+``max_bytes`` with a flag) feeds the JSON results log
+(TestResults.java:86-97)."""
+
+from __future__ import annotations
+
+import io
+import sys
+
+__all__ = ["TeeStdOutErr"]
+
+
+class _TeeWriter(io.TextIOBase):
+    def __init__(self, real, cap: int):
+        self.real = real
+        self.cap = cap
+        self.buf = io.StringIO()
+        self.truncated = False
+
+    def write(self, s):
+        self.real.write(s)
+        if self.buf.tell() < self.cap:
+            self.buf.write(s[:self.cap - self.buf.tell()])
+        elif s:
+            self.truncated = True
+        return len(s)
+
+    def flush(self):
+        self.real.flush()
+
+    def captured(self) -> str:
+        return self.buf.getvalue()
+
+
+class TeeStdOutErr:
+    """``with TeeStdOutErr() as tee: ...`` then ``tee.stdout``/``tee.stderr``
+    hold the captured (possibly truncated) copies."""
+
+    def __init__(self, max_bytes: int = 1 << 20):
+        self.max_bytes = max_bytes
+        self.stdout = ""
+        self.stderr = ""
+        self.stdout_truncated = False
+        self.stderr_truncated = False
+
+    def __enter__(self):
+        self._out = _TeeWriter(sys.stdout, self.max_bytes)
+        self._err = _TeeWriter(sys.stderr, self.max_bytes)
+        self._saved = (sys.stdout, sys.stderr)
+        sys.stdout, sys.stderr = self._out, self._err
+        return self
+
+    def __exit__(self, *exc):
+        sys.stdout, sys.stderr = self._saved
+        self.stdout = self._out.captured()
+        self.stderr = self._err.captured()
+        self.stdout_truncated = self._out.truncated
+        self.stderr_truncated = self._err.truncated
+        return False

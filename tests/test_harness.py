@@ -1,0 +1,137 @@
+"""Harness self-tests: registry/selection, runner scoring + JSON results,
+tee capture, assertion helpers (mirrors the reference's framework
+self-tests junit/JUnitSanityCheckTest + TeeStdOutErrTest)."""
+
+import json
+
+import pytest
+
+from dslabs_tpu.harness import (RUN_TESTS, SEARCH_TESTS, UNRELIABLE_TESTS,
+                                FailureAccumulator, TeeStdOutErr, TestFailure,
+                                assert_end_condition_valid, assert_goal_found,
+                                assert_space_exhausted)
+from dslabs_tpu.harness.annotations import TestEntry
+from dslabs_tpu.harness.runner import run_tests, select_tests
+from dslabs_tpu.search.results import EndCondition, SearchResults
+
+
+def entry(name, lab="1", num=1, part=None, points=0, cats=(RUN_TESTS,),
+          fn=None, timeout=None):
+    return TestEntry(fn=fn or (lambda: None), lab=lab, num=num,
+                     description=name, points=points, part=part,
+                     categories=tuple(cats), timeout_secs=timeout)
+
+
+def test_selection_filters():
+    es = [
+        entry("a", lab="1", num=1, part=1, cats=(RUN_TESTS,)),
+        entry("b", lab="1", num=2, part=1, cats=(SEARCH_TESTS,)),
+        entry("c", lab="1", num=1, part=2, cats=(RUN_TESTS, UNRELIABLE_TESTS)),
+        entry("d", lab="2", num=1, cats=(RUN_TESTS,)),
+    ]
+    assert [e.description for e in select_tests(es, lab="1")] == ["a", "b", "c"]
+    assert [e.description for e in select_tests(es, lab="1", part=2)] == ["c"]
+    assert [e.description for e in select_tests(es, lab="1", nums=[2])] == ["b"]
+    assert [e.description for e in select_tests(es, lab="1",
+                                                exclude_search=True)] == \
+        ["a", "c"]
+    assert [e.description for e in select_tests(es, lab="1",
+                                                exclude_run=True)] == ["b"]
+    assert [e.description for e in
+            select_tests(es, exclude_unreliable=True)] == ["a", "b", "d"]
+
+
+def test_runner_scores_and_json(tmp_path, capsys):
+    def ok():
+        print("hello from test")
+
+    def bad():
+        raise AssertionError("boom")
+
+    es = [entry("passes", num=1, points=10, fn=ok),
+          entry("fails", num=2, points=5, fn=bad)]
+    out_file = tmp_path / "results.json"
+    report = run_tests(es, results_output_file=str(out_file))
+    assert report.num_passed == 1
+    assert report.points_earned == 10
+    assert report.points_available == 15
+    assert not report.all_passed
+    data = json.loads(out_file.read_text())
+    assert data["points_earned"] == 10
+    assert data["tests"][0]["passed"] is True
+    assert data["tests"][1]["passed"] is False
+    assert "boom" in data["tests"][1]["error"]
+    assert "hello from test" in data["tests"][0]["stdout"]
+    printed = capsys.readouterr().out
+    assert "Tests passed: 1/2" in printed
+    assert "Points: 10/15" in printed
+    assert "FAIL" in printed
+
+
+def test_runner_timeout():
+    import time
+
+    def slow():
+        time.sleep(5)
+
+    report = run_tests([entry("slow", num=1, fn=slow, timeout=0.2)])
+    assert not report.all_passed
+    assert report.results[0].timed_out
+
+
+def test_tee_capture_and_truncation(capsys):
+    with TeeStdOutErr(max_bytes=8) as tee:
+        print("0123456789abcdef")
+    assert tee.stdout.startswith("01234567")
+    assert len(tee.stdout) == 8
+    assert tee.stdout_truncated
+    # the real stream still saw everything
+    assert "0123456789abcdef" in capsys.readouterr().out
+
+
+def test_failure_accumulator():
+    acc = FailureAccumulator()
+    acc.check(True, "fine")
+    acc.assert_no_failures()
+    acc.check(False, "first")
+    acc.fail_and_continue("second")
+    with pytest.raises(TestFailure, match="2 accumulated"):
+        acc.assert_no_failures()
+
+
+def _results(end, invariants=(), goals=()):
+    r = SearchResults(list(invariants), list(goals))
+    r.end_condition = end
+    return r
+
+
+def test_assert_helpers():
+    assert_end_condition_valid(_results(EndCondition.SPACE_EXHAUSTED))
+    assert_space_exhausted(_results(EndCondition.SPACE_EXHAUSTED))
+    assert_goal_found(_results(EndCondition.GOAL_FOUND))
+    with pytest.raises(TestFailure, match="Goal not found"):
+        assert_goal_found(_results(EndCondition.TIME_EXHAUSTED))
+    with pytest.raises(TestFailure, match="not exhausted"):
+        assert_space_exhausted(_results(EndCondition.TIME_EXHAUSTED))
+    with pytest.raises(TestFailure, match="Invariant violated"):
+        assert_end_condition_valid(_results(EndCondition.INVARIANT_VIOLATED))
+
+
+def test_registry_decorator_roundtrip():
+    from dslabs_tpu.harness import lab_test
+
+    @lab_test("9", 3, "registry probe", points=7, part=2,
+              categories=(SEARCH_TESTS,))
+    def probe():
+        return 42
+
+    try:
+        e = probe._dslabs_test_entry
+        assert (e.lab, e.num, e.part, e.points) == ("9", 3, 2, 7)
+        assert e.full_number == "2.3"
+        assert probe() == 42  # function itself untouched
+        from dslabs_tpu.harness import registry
+        assert any(x.description == "registry probe" for x in registry())
+    finally:
+        from dslabs_tpu.harness.annotations import _REGISTRY
+        _REGISTRY.remove(probe._dslabs_test_entry)

@@ -6,6 +6,7 @@ including under an unreliable network (retry timer must recover losses).
 
 import pytest
 
+from dslabs_tpu.harness import RUN_TESTS, UNRELIABLE_TESTS, lab_test
 from dslabs_tpu.core.address import LocalAddress
 from dslabs_tpu.labs.pingpong.pingpong import (Ping, PingClient, PingServer,
                                                Pong)
@@ -43,6 +44,7 @@ def assert_results_ok(state):
     assert r.value, r.error_message()
 
 
+@lab_test("0", 1, "Single client ping test", categories=(RUN_TESTS,))
 def test_basic_run_multithreaded():
     state = make_state(num_clients=2)
     settings = RunSettings().max_time(10)
@@ -53,6 +55,7 @@ def test_basic_run_multithreaded():
         assert len(w.results) == 5
 
 
+@lab_test("0", 5, "Single client ping test (single-threaded engine)", categories=(RUN_TESTS,))
 def test_basic_run_single_threaded():
     state = make_state(num_clients=2)
     settings = RunSettings().max_time(10)
@@ -63,6 +66,7 @@ def test_basic_run_single_threaded():
         assert w.done()
 
 
+@lab_test("0", 3, "Client can still ping if some messages are dropped", categories=(RUN_TESTS, UNRELIABLE_TESTS,))
 def test_unreliable_network_retries_recover():
     state = make_state(num_clients=1, num_pings=3)
     settings = RunSettings().max_time(20)
@@ -73,6 +77,7 @@ def test_unreliable_network_retries_recover():
         assert w.done()
 
 
+@lab_test("0", 6, "Blocking get_result on the client interface", categories=(RUN_TESTS,))
 def test_direct_client_blocking_get_result():
     """Drive a bare client (no worker) through the blocking Client API."""
     gen = NodeGenerator(
@@ -90,6 +95,7 @@ def test_direct_client_blocking_get_result():
         state.stop()
 
 
+@lab_test("0", 7, "Client worker tracks max wait", categories=(RUN_TESTS,))
 def test_max_wait_tracked():
     state = make_state(num_clients=1, num_pings=2)
     state.run(RunSettings().max_time(10))

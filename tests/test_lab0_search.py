@@ -4,6 +4,7 @@ the all-clients-done goal, and the CLIENTS_DONE-pruned subspace is finite and
 safe (RESULTS_OK holds everywhere).
 """
 
+from dslabs_tpu.harness import SEARCH_TESTS, lab_test
 from dslabs_tpu.core.address import LocalAddress
 from dslabs_tpu.labs.pingpong.pingpong import (Ping, PingClient, PingServer,
                                                Pong)
@@ -38,6 +39,7 @@ def make_state(num_clients=1, num_pings=2):
     return state
 
 
+@lab_test("0", 4, "Single client repeatedly pings", categories=(SEARCH_TESTS,))
 def test_bfs_finds_clients_done_goal():
     state = make_state()
     settings = SearchSettings().add_invariant(RESULTS_OK).add_goal(CLIENTS_DONE)
@@ -51,6 +53,7 @@ def test_bfs_finds_clients_done_goal():
         assert w.results == [Pong("ping-1"), Pong("ping-2")]
 
 
+@lab_test("0", 8, "Pruned ping space exhausts safely", categories=(SEARCH_TESTS,))
 def test_bfs_exhausts_pruned_space_safely():
     state = make_state()
     settings = (SearchSettings().add_invariant(RESULTS_OK)
@@ -60,6 +63,7 @@ def test_bfs_exhausts_pruned_space_safely():
     assert results.end_condition == EndCondition.SPACE_EXHAUSTED
 
 
+@lab_test("0", 9, "Random DFS respects depth limit", categories=(SEARCH_TESTS,))
 def test_random_dfs_depth_limited():
     state = make_state()
     settings = (SearchSettings().add_invariant(RESULTS_OK)
@@ -70,6 +74,7 @@ def test_random_dfs_depth_limited():
     assert results.invariant_violating_state is None
 
 
+@lab_test("0", 10, "Search-state dedup on generation", categories=(SEARCH_TESTS,))
 def test_search_state_dedup():
     """Stepping the same message twice from one state yields equivalent
     states (network-as-set, delivery does not consume)."""

@@ -5,6 +5,8 @@
 
 import pytest
 
+from dslabs_tpu.harness import (RUN_TESTS, SEARCH_TESTS, UNRELIABLE_TESTS,
+                                lab_test)
 from dslabs_tpu.core.address import LocalAddress
 from dslabs_tpu.labs.clientserver.amo import AMOApplication, AMOCommand
 from dslabs_tpu.labs.clientserver.clientserver import SimpleClient, SimpleServer
@@ -28,6 +30,7 @@ SERVER = LocalAddress("server")
 
 # ------------------------------------------------------------- KVStore unit
 
+@lab_test("1", 1, "Basic key-value operations", points=5, part=1, categories=(RUN_TESTS,))
 def test_kvstore_semantics():
     kv = KVStore()
     assert kv.execute(Get("k")) == KeyNotFound()
@@ -38,6 +41,7 @@ def test_kvstore_semantics():
     assert kv.execute(Get("k2")) == GetResult("x")
 
 
+@lab_test("1", 2, "KVStore state equality", part=1, categories=(RUN_TESTS,))
 def test_kvstore_equality():
     a, b = KVStore(), KVStore()
     a.execute(Put("k", "v"))
@@ -48,6 +52,7 @@ def test_kvstore_equality():
 
 # ----------------------------------------------------------------- AMO unit
 
+@lab_test("1", 6, "AMO application deduplicates", part=2, categories=(RUN_TESTS,))
 def test_amo_deduplicates():
     c1 = LocalAddress("c1")
     app = AMOApplication(KVStore())
@@ -59,6 +64,7 @@ def test_amo_deduplicates():
     assert app.application.execute(Get("k")) == GetResult("a")
 
 
+@lab_test("1", 7, "AMO per-client sequencing", part=2, categories=(RUN_TESTS,))
 def test_amo_per_client_sequencing():
     c1, c2 = LocalAddress("c1"), LocalAddress("c2")
     app = AMOApplication(KVStore())
@@ -89,12 +95,14 @@ def assert_ok(state):
     assert r.value, r.error_message()
 
 
+@lab_test("1", 2, "Single client basic operations", points=20, part=2, categories=(RUN_TESTS,))
 def test_single_client_simple_workload():
     state = make_run_state(workload_factory=simple_workload)
     state.run(RunSettings().max_time(10))
     assert_ok(state)
 
 
+@lab_test("1", 3, "Multi-client different key appends", points=20, part=2, categories=(RUN_TESTS,))
 def test_multi_client_different_keys():
     state = make_run_state(
         num_clients=3,
@@ -103,6 +111,7 @@ def test_multi_client_different_keys():
     assert_ok(state)
 
 
+@lab_test("1", 1, "Single client basic operations", points=20, part=3, categories=(RUN_TESTS, UNRELIABLE_TESTS,))
 def test_unreliable_network_exactly_once():
     state = make_run_state(
         num_clients=2,
@@ -113,6 +122,7 @@ def test_unreliable_network_exactly_once():
     assert_ok(state)
 
 
+@lab_test("1", 4, "Multi-client same key appends", points=30, part=2, categories=(RUN_TESTS,))
 def test_same_key_appends_linearizable():
     state = make_run_state(
         num_clients=3,
@@ -136,6 +146,7 @@ def make_search_state(num_clients=1, workload=None):
     return state
 
 
+@lab_test("1", 7, "Single client; Put, Append, Get", points=20, part=3, categories=(SEARCH_TESTS,))
 def test_search_exactly_once_under_duplication():
     """BFS over the full duplication/reordering space: results always match
     (the AMO layer absorbs duplicate deliveries).  Port of
@@ -156,6 +167,7 @@ def test_search_exactly_once_under_duplication():
     assert results2.end_condition == EndCondition.SPACE_EXHAUSTED
 
 
+@lab_test("1", 10, "Multi-client same key", points=20, part=3, categories=(SEARCH_TESTS,))
 def test_search_two_clients_linearizable_appends():
     workload = append_same_key_workload(1)
     state = make_search_state(num_clients=2, workload=workload)

@@ -7,6 +7,8 @@ import time
 
 import pytest
 
+from dslabs_tpu.harness import (RUN_TESTS, SEARCH_TESTS, UNRELIABLE_TESTS,
+                                lab_test)
 from dslabs_tpu.core.address import LocalAddress
 from dslabs_tpu.labs.clientserver.kv_workload import (
     APPENDS_LINEARIZABLE, append_different_key_workload,
@@ -68,6 +70,7 @@ def settle(state, settings, secs):
 
 # ------------------------------------------------------------------ run tests
 
+@lab_test("2", 2, "Single client, single server, simple operations", points=5, part=2, categories=(RUN_TESTS,))
 def test02_basic():
     state = make_run_state(simple_workload)
     state.add_server(server(1))
@@ -76,6 +79,7 @@ def test02_basic():
     assert_ok(state)
 
 
+@lab_test("2", 4, "Backup is chosen", points=5, part=2, categories=(RUN_TESTS,))
 def test04_backup_chosen_and_replicates():
     state = make_run_state(simple_workload)
     settings = RunSettings().max_time(15)
@@ -87,6 +91,7 @@ def test04_backup_chosen_and_replicates():
     assert_ok(state)
 
 
+@lab_test("2", 6, "Backup takes over", points=10, part=2, categories=(RUN_TESTS,))
 def test06_backup_takes_over():
     state = make_run_state()
     settings = RunSettings().max_time(15)
@@ -112,6 +117,7 @@ def test06_backup_takes_over():
     state.stop()
 
 
+@lab_test("2", 7, "Kill all servers", points=10, part=2, categories=(RUN_TESTS,))
 def test07_kill_all_servers():
     state = make_run_state()
     settings = RunSettings().max_time(15)
@@ -136,6 +142,7 @@ def test07_kill_all_servers():
     state.stop()
 
 
+@lab_test("2", 8, "At-most-once append", points=15, part=2, categories=(RUN_TESTS, UNRELIABLE_TESTS,))
 def test08_at_most_once_unreliable():
     state = make_run_state(lambda: append_different_key_workload(10))
     settings = RunSettings().max_time(30)
@@ -148,6 +155,7 @@ def test08_at_most_once_unreliable():
     assert_ok(state)
 
 
+@lab_test("2", 11, "Concurrent appends, same key, fail to backup", points=15, part=2, categories=(RUN_TESTS,))
 def test11_concurrent_appends_linearizable_failover():
     state = make_run_state(lambda: append_same_key_workload(5))
     settings = RunSettings().max_time(30)
@@ -186,6 +194,7 @@ def make_search_state(workload):
     return state
 
 
+@lab_test("2", 16, "Single client, single server", points=15, part=2, categories=(SEARCH_TESTS,))
 def test16_single_client_search():
     workload = kv_workload(["PUT:foo:bar", "GET:foo"], ["PutOk", "bar"])
     state = make_search_state(workload)
@@ -207,6 +216,7 @@ def test16_single_client_search():
                                       EndCondition.TIME_EXHAUSTED), results2
 
 
+@lab_test("2", 18, "Multi-client, multi-server; writes visible", points=20, part=2, categories=(SEARCH_TESTS,))
 def test18_two_client_appends_linearizable_search():
     """Staged search in the reference's initView style
     (PrimaryBackupTest.java:124-187): first reach the synced two-server view

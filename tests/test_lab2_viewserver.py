@@ -5,8 +5,7 @@ hooks and fed messages/timers by hand (no engine), mirroring the reference's
 test pattern (SURVEY §4.1 "unit-style tests without any engine").
 """
 
-import pytest
-
+from dslabs_tpu.harness import RUN_TESTS, lab_test
 from dslabs_tpu.core.address import LocalAddress
 from dslabs_tpu.core.node import NodeConfig
 from dslabs_tpu.labs.primarybackup.viewserver import (GetView, INITIAL_VIEWNUM,
@@ -77,31 +76,36 @@ class ViewServerHarness:
             self.timeout()
 
 
-@pytest.fixture
-def h():
-    return ViewServerHarness()
-
-
-def test01_startup_view_correct(h):
+@lab_test("2", 1, "Startup view", points=5, part=1, categories=(RUN_TESTS,))
+def test01_startup_view_correct():
+    h = ViewServerHarness()
     h.check(None, None, STARTUP_VIEWNUM)
 
 
-def test02_first_primary(h):
+@lab_test("2", 2, "Primary initialized", points=5, part=1, categories=(RUN_TESTS,))
+def test02_first_primary():
+    h = ViewServerHarness()
     h.setup_view(server(1), None)
 
 
-def test03_first_backup(h):
+@lab_test("2", 3, "Backup initialized", points=5, part=1, categories=(RUN_TESTS,))
+def test03_first_backup():
+    h = ViewServerHarness()
     h.setup_view(server(1), server(2))
 
 
-def test04_backup_pings_first(h):
+@lab_test("2", 4, "Backup pings first, initialized", points=5, part=1, categories=(RUN_TESTS,))
+def test04_backup_pings_first():
+    h = ViewServerHarness()
     h.setup_view(server(1), None)
     h.send_ping(STARTUP_VIEWNUM, server(2))
     h.send_ping(INITIAL_VIEWNUM, server(1))
     h.check(server(1), server(2), INITIAL_VIEWNUM + 1)
 
 
-def test05_backup_takes_over(h):
+@lab_test("2", 5, "Backup takes over", points=5, part=1, categories=(RUN_TESTS,))
+def test05_backup_takes_over():
+    h = ViewServerHarness()
     h.setup_view(server(1), server(2), ack_view=True)
     h.send_ping(INITIAL_VIEWNUM + 1, server(2))
     h.check(server(1), server(2), INITIAL_VIEWNUM + 1)
@@ -112,7 +116,9 @@ def test05_backup_takes_over(h):
     h.check(server(2), None, INITIAL_VIEWNUM + 2)
 
 
-def test06_old_server_becomes_backup(h):
+@lab_test("2", 6, "Old primary becomes backup", points=5, part=1, categories=(RUN_TESTS,))
+def test06_old_server_becomes_backup():
+    h = ViewServerHarness()
     h.setup_view(server(1), server(2), ack_view=True)
     h.timeout_fully(server(2))
     h.check(server(2), None, INITIAL_VIEWNUM + 2)
@@ -121,13 +127,17 @@ def test06_old_server_becomes_backup(h):
     h.check(server(2), server(1), INITIAL_VIEWNUM + 3)
 
 
-def test07_idle_third_server_becomes_backup(h):
+@lab_test("2", 7, "Idle server becomes backup", points=5, part=1, categories=(RUN_TESTS,))
+def test07_idle_third_server_becomes_backup():
+    h = ViewServerHarness()
     h.setup_view(server(1), server(2), ack_view=True)
     h.timeout_fully(server(2), server(3))
     h.check(server(2), server(3), INITIAL_VIEWNUM + 2)
 
 
-def test08_wait_for_primary_ack(h):
+@lab_test("2", 8, "Wait for primary ACK", points=5, part=1, categories=(RUN_TESTS,))
+def test08_wait_for_primary_ack():
+    h = ViewServerHarness()
     h.send_ping(STARTUP_VIEWNUM, server(1))
     h.send_ping(STARTUP_VIEWNUM, server(2))
     h.check(server(1), None, INITIAL_VIEWNUM)
@@ -139,13 +149,17 @@ def test08_wait_for_primary_ack(h):
     h.check(server(1), server(2), INITIAL_VIEWNUM + 1)
 
 
-def test09_dead_backup_removed(h):
+@lab_test("2", 9, "Dead backup removed", points=5, part=1, categories=(RUN_TESTS,))
+def test09_dead_backup_removed():
+    h = ViewServerHarness()
     h.setup_view(server(1), server(2), ack_view=True)
     h.timeout_fully(server(1))
     h.check(server(1), None, INITIAL_VIEWNUM + 2)
 
 
-def test10_uninitialized_not_promoted(h):
+@lab_test("2", 10, "Uninitialized server not made primary", points=5, part=1, categories=(RUN_TESTS,))
+def test10_uninitialized_not_promoted():
+    h = ViewServerHarness()
     h.setup_view(server(1), server(2), ack_view=True)
     h.timeout_fully(server(2), server(3))
     h.check(server(2), server(3), INITIAL_VIEWNUM + 2)
@@ -153,7 +167,9 @@ def test10_uninitialized_not_promoted(h):
     h.check(server(2), server(3), INITIAL_VIEWNUM + 2)
 
 
-def test11_dead_server_not_made_backup(h):
+@lab_test("2", 11, "Dead idle server shouldn't become backup", points=5, part=1, categories=(RUN_TESTS,))
+def test11_dead_server_not_made_backup():
+    h = ViewServerHarness()
     h.setup_view(server(1), None)
     h.send_ping(STARTUP_VIEWNUM, server(2))
     h.timeout_fully()
@@ -161,7 +177,9 @@ def test11_dead_server_not_made_backup(h):
     h.check(server(1), None, INITIAL_VIEWNUM)
 
 
-def test12_new_view_not_started(h):
+@lab_test("2", 12, "Consecutive views have different configurations", points=5, part=1, categories=(RUN_TESTS,))
+def test12_new_view_not_started():
+    h = ViewServerHarness()
     h.setup_view(server(1), None)
     h.timeout_fully(server(1))
     h.check(server(1), None, INITIAL_VIEWNUM)

@@ -10,6 +10,8 @@ import time
 
 import pytest
 
+from dslabs_tpu.harness import (RUN_TESTS, SEARCH_TESTS, UNRELIABLE_TESTS,
+                                lab_test)
 from dslabs_tpu.core.address import LocalAddress
 from dslabs_tpu.labs.clientserver.kv_workload import (
     APPENDS_LINEARIZABLE, append_same_key_workload,
@@ -79,6 +81,7 @@ def assert_logs_consistent(state, all_slots=True):
 
 # ------------------------------------------------------------------ run tests
 
+@lab_test("3", 2, "Single client, simple operations", points=5, categories=(RUN_TESTS,))
 def test02_basic():
     state = make_run_state(3, simple_workload)
     state.add_client_worker(client(1))
@@ -101,6 +104,7 @@ def test02_basic():
                    for p in state.servers.values()), f"slot {i} undecided"
 
 
+@lab_test("3", 4, "Progress in majority", points=5, categories=(RUN_TESTS,))
 def test04_progress_in_majority():
     state = make_run_state(5)
     c = state.add_client(client(1))
@@ -112,6 +116,7 @@ def test04_progress_in_majority():
     state.stop()
 
 
+@lab_test("3", 5, "No progress in minority", points=5, categories=(RUN_TESTS,))
 def test05_no_progress_in_minority():
     state = make_run_state(5)
     c = state.add_client(client(1))
@@ -125,6 +130,7 @@ def test05_no_progress_in_minority():
     state.stop()
 
 
+@lab_test("3", 6, "Progress after partition healed", points=5, categories=(RUN_TESTS,))
 def test06_progress_after_heal():
     state = make_run_state(5)
     c1 = state.add_client(client(1))
@@ -142,6 +148,7 @@ def test06_progress_after_heal():
     state.stop()
 
 
+@lab_test("3", 9, "Multiple clients, concurrent appends", points=10, categories=(RUN_TESTS,))
 def test09_concurrent_appends():
     n_clients, n_rounds = 5, 3
     state = make_run_state(3, lambda: append_same_key_workload(n_rounds))
@@ -154,6 +161,7 @@ def test09_concurrent_appends():
     assert_logs_consistent(state)
 
 
+@lab_test("3", 10, "Message count", points=10, categories=(RUN_TESTS,))
 def test10_message_count():
     n_rounds, n_servers = 100, 5
     state = make_run_state(n_servers, lambda: append_same_key_workload(n_rounds))
@@ -168,6 +176,7 @@ def test10_message_count():
         f"Too many messages: {per_agreement:.1f}/agreement (allowed {allowed})"
 
 
+@lab_test("3", 11, "Old commands garbage collected", points=15, categories=(RUN_TESTS,))
 def test11_clears_memory():
     """Scaled-down port of test11ClearsMemory: bulk values are garbage
     collected once the partitioned server heals and catches up."""
@@ -206,6 +215,7 @@ def test11_clears_memory():
     assert_logs_consistent(state, all_slots=False)
 
 
+@lab_test("3", 12, "Single client, simple operations", points=10, categories=(RUN_TESTS, UNRELIABLE_TESTS,))
 def test12_basic_unreliable():
     state = make_run_state(3, lambda: append_different_key_workload(5))
     state.add_client_worker(client(1))
@@ -218,6 +228,7 @@ def test12_basic_unreliable():
 
 # --------------------------------------------------------------- search tests
 
+@lab_test("3", 20, "Single client, simple operations", points=20, categories=(SEARCH_TESTS,))
 def test20_basic_search():
     state = make_search_state(3)
     state.add_client_worker(client(1), kv_workload(["PUT:foo:bar", "GET:foo"],
@@ -252,6 +263,7 @@ def test20_basic_search():
                                       EndCondition.TIME_EXHAUSTED), results3
 
 
+@lab_test("3", 21, "Single client, no progress in minority", points=15, categories=(SEARCH_TESTS,))
 def test21_no_progress_in_minority_search():
     state = make_search_state(5, lambda: kv_workload(["PUT:foo:bar"]))
     state.add_client_worker(client(1))
@@ -271,6 +283,7 @@ def test21_no_progress_in_minority_search():
                                      EndCondition.TIME_EXHAUSTED), results
 
 
+@lab_test("3", 25, "Three server random search", points=20, categories=(SEARCH_TESTS,))
 def test25_random_search():
     state = make_search_state(3, lambda: kv_workload(["APPEND:foo:x"]))
     state.add_client_worker(client(1))

@@ -1,8 +1,7 @@
 """Lab 4 part 1 tests — behavioural port of ShardMasterTest.java:43-372
 (pure-Application unit tests, including the determinism check test08)."""
 
-import pytest
-
+from dslabs_tpu.harness import RUN_TESTS, lab_test
 from dslabs_tpu.core.address import LocalAddress
 from dslabs_tpu.labs.shardedstore.shardmaster import (Error, Join, Leave,
                                                       Move, Ok, Query,
@@ -77,12 +76,9 @@ class Harness:
             assert num_moved == 1
 
 
-@pytest.fixture
-def h():
-    return Harness()
-
-
-def test01_commands_return_ok(h):
+@lab_test("4", 1, "Commands return OK", points=5, part=1, categories=(RUN_TESTS,))
+def test01_commands_return_ok():
+    h = Harness()
     assert h.execute(Join(1, group(1))) == Ok()
     assert h.execute(Join(2, group(2))) == Ok()
     config = h.get_config()
@@ -91,11 +87,15 @@ def test01_commands_return_ok(h):
     assert h.execute(Leave(2)) == Ok()
 
 
-def test02_initial_query_returns_no_config(h):
+@lab_test("4", 2, "Initial query returns NO_CONFIG", points=5, part=1, categories=(RUN_TESTS,))
+def test02_initial_query_returns_no_config():
+    h = Harness()
     assert h.execute(Query(-1)) == Error()
 
 
-def test03_commands_return_error(h):
+@lab_test("4", 3, "Bad commands return ERROR", points=5, part=1, categories=(RUN_TESTS,))
+def test03_commands_return_error():
+    h = Harness()
     h.execute(Join(1, group(1)))
     assert h.execute(Join(1, group(1))) == Error()
     assert h.execute(Leave(2)) == Error()
@@ -108,14 +108,16 @@ def test03_commands_return_error(h):
     assert h.execute(Move(2, NUM_SHARDS + 1)) == Error()
 
 
-def test04_initial_config_correct(h):
+@lab_test("4", 4, "Initial config correct", points=5, part=1, categories=(RUN_TESTS,))
+def test04_initial_config_correct():
+    h = Harness()
     h.execute(Join(1, group(1)))
     received = h.get_config(check_is_next=True)
     assert received == ShardConfig(
         INITIAL_CONFIG_NUM, {1: (group(1), frozenset(full_range()))})
 
 
-def test05_basic_join_leave(h):
+def _basic_join_leave(h):
     h.execute(Join(1, group(1)))
     previous = h.get_config(check_is_next=True)
     h.check_config(previous, [1])
@@ -135,13 +137,22 @@ def test05_basic_join_leave(h):
         previous = nxt
 
 
-def test06_historical_queries(h):
-    test05_basic_join_leave(h)
+@lab_test("4", 5, "Basic join/leave", points=5, part=1, categories=(RUN_TESTS,))
+def test05_basic_join_leave():
+    _basic_join_leave(Harness())
+
+
+@lab_test("4", 6, "Historical queries", points=5, part=1, categories=(RUN_TESTS,))
+def test06_historical_queries():
+    h = Harness()
+    _basic_join_leave(h)
     for i in range(5):
         h.get_config(INITIAL_CONFIG_NUM + i)
 
 
-def test07_move_shards(h):
+@lab_test("4", 7, "Move command", points=5, part=1, categories=(RUN_TESTS,))
+def test07_move_shards():
+    h = Harness()
     h.execute(Join(1, group(1)))
     h.execute(Join(2, group(2)))
     config = h.get_config()
@@ -162,6 +173,7 @@ def test07_move_shards(h):
     h.check_config(config, [1, 2, 3])
 
 
+@lab_test("4", 8, "Application deterministic", points=10, part=1, categories=(RUN_TESTS,))
 def test08_determinism():
     reference = None
     for _ in range(10):

@@ -6,6 +6,7 @@ import time
 
 import pytest
 
+from dslabs_tpu.harness import RUN_TESTS, lab_test
 from dslabs_tpu.core.address import LocalAddress
 from dslabs_tpu.labs.clientserver.kv_workload import get, get_result, put, put_ok
 from dslabs_tpu.labs.clientserver.kvstore import KeyNotFound
@@ -43,6 +44,7 @@ def group(g, n=3):
 
 # --------------------------------------------------------- unit: txkvstore
 
+@lab_test("4", 12, "TransactionalKVStore semantics", part=3, categories=(RUN_TESTS,))
 def test_txkvstore_semantics():
     kv = TransactionalKVStore()
     assert kv.execute(MultiPut({"a": "1", "b": "2"})) == MultiPutOk()
@@ -60,6 +62,7 @@ def test_txkvstore_semantics():
     assert kv.execute(get("x")) == get_result("y")
 
 
+@lab_test("4", 15, "keyToShard matches reference hashing", part=2, categories=(RUN_TESTS,))
 def test_key_to_shard():
     assert key_to_shard("key-3", 10) == 3
     assert key_to_shard("key-10", 10) == 10  # 10 mod 10 = 0 -> +10
@@ -106,6 +109,7 @@ def send_check(client, command, expected, timeout=8):
     assert result == expected, f"{command} -> {result} (expected {expected})"
 
 
+@lab_test("4", 1, "Single group, basic workload", points=10, part=2, categories=(RUN_TESTS,))
 def test_basic_single_group():
     state = make_state(1)
     settings = RunSettings().max_time(30)
@@ -121,6 +125,7 @@ def test_basic_single_group():
     state.stop()
 
 
+@lab_test("4", 3, "Shards move when group joins", points=15, part=2, categories=(RUN_TESTS,))
 def test_join_moves_shards():
     state = make_state(2)
     settings = RunSettings().max_time(60)
@@ -149,6 +154,7 @@ def test_join_moves_shards():
     state.stop()
 
 
+@lab_test("4", 4, "Shards move when moved by ShardMaster", points=15, part=2, categories=(RUN_TESTS,))
 def test_move_command_relocates_data():
     state = make_state(2)
     settings = RunSettings().max_time(60)
@@ -172,6 +178,7 @@ def test_move_command_relocates_data():
     state.stop()
 
 
+@lab_test("4", 1, "Single group, simple transactional workload", points=5, part=3, categories=(RUN_TESTS,))
 def test_single_group_transactions():
     """Transactions whose key set lives in one group run without 2PC."""
     state = make_state(1)
@@ -189,6 +196,7 @@ def test_single_group_transactions():
     state.stop()
 
 
+@lab_test("4", 2, "Multi-group, simple transactional workload", points=5, part=3, categories=(RUN_TESTS,))
 def test_cross_group_transactions():
     """2PC: transactions spanning groups commit atomically."""
     state = make_state(2)
@@ -213,6 +221,7 @@ def test_cross_group_transactions():
     state.stop()
 
 
+@lab_test("4", 5, "Repeated MultiPuts and MultiGets, concurrent swaps", points=20, part=3, categories=(RUN_TESTS,))
 def test_concurrent_cross_group_swaps():
     """Concurrent conflicting 2PC transactions stay atomic: swaps permute
     values, so the value multiset is preserved (TransactionalKVStoreWorkload
