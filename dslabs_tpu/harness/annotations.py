@@ -27,6 +27,8 @@ UNRELIABLE_TESTS = "UnreliableTests"
 
 @dataclasses.dataclass(frozen=True)
 class TestEntry:
+    __test__ = False          # not itself a pytest collectable
+
     fn: Callable
     lab: str                       # "0".."4" (string, like @Lab)
     num: int                       # test number (test01Foo -> 1)

@@ -112,28 +112,41 @@ def select_tests(entries: Sequence[TestEntry],
     return out
 
 
-def _run_one(entry: TestEntry) -> TestResult:
+def _run_one(entry: TestEntry, routers=None) -> TestResult:
+    from dslabs_tpu.harness.tee import _TeeWriter
+
     start = time.time()
     err_box: List[Optional[BaseException]] = [None]
+    out_router, err_router = routers
+    out_w = _TeeWriter(out_router.real, 1 << 20)
+    err_w = _TeeWriter(err_router.real, 1 << 20)
 
     def target():
+        ident = threading.get_ident()
+        out_router.route(ident, out_w)
+        err_router.route(ident, err_w)
         try:
             entry.fn()
         except BaseException as e:  # noqa: BLE001 — reported, not swallowed
             err_box[0] = e
+        finally:
+            # A thread that outlives its timeout stays routed to its own
+            # abandoned buffer until the function finally returns — its
+            # late output can never land in a later test's capture.
+            out_router.unroute(ident)
+            err_router.unroute(ident)
 
     timeout = entry.timeout_secs
     if GlobalSettings.test_timeouts_disabled:
         timeout = None
-    with TeeStdOutErr() as tee:
-        if timeout is None:
-            target()
-            timed_out = False
-        else:
-            th = threading.Thread(target=target, daemon=True)
-            th.start()
-            th.join(timeout)
-            timed_out = th.is_alive()
+    if timeout is None:
+        target()
+        timed_out = False
+    else:
+        th = threading.Thread(target=target, daemon=True)
+        th.start()
+        th.join(timeout)
+        timed_out = th.is_alive()
     end = time.time()
     err = err_box[0]
     error_text = None
@@ -145,26 +158,43 @@ def _run_one(entry: TestEntry) -> TestResult:
     return TestResult(
         entry=entry, passed=error_text is None,
         elapsed_secs=end - start, error=error_text, timed_out=timed_out,
-        stdout=tee.stdout, stderr=tee.stderr,
-        stdout_truncated=tee.stdout_truncated,
-        stderr_truncated=tee.stderr_truncated,
+        stdout=out_w.captured(), stderr=err_w.captured(),
+        stdout_truncated=out_w.truncated,
+        stderr_truncated=err_w.truncated,
         start_time=start, end_time=end)
 
 
-def run_tests(entries: Sequence[TestEntry],
-              results_output_file: Optional[str] = None) -> RunReport:
-    t0 = time.time()
-    results: List[TestResult] = []
+def _run_all(entries, out_router, err_router):
+    results = []
     for e in entries:
         print(SMALL_SEP)
         print(f"TEST {e.full_number}: {e.description} ({e.points}pts)")
         print(f"  START [{_now()}]...\n")
-        r = _run_one(e)
+        r = _run_one(e, routers=(out_router, err_router))
         results.append(r)
         if r.error is not None:
             print(r.error)
         verdict = "...PASS" if r.passed else "...FAIL"
         print(f"{verdict} [{_now()}] ({r.elapsed_secs:.2f}s)")
+    return results
+
+
+def run_tests(entries: Sequence[TestEntry],
+              results_output_file: Optional[str] = None) -> RunReport:
+    import sys
+
+    from dslabs_tpu.harness.tee import ThreadRouter
+
+    t0 = time.time()
+    results: List[TestResult] = []
+    out_router = ThreadRouter(sys.stdout)
+    err_router = ThreadRouter(sys.stderr)
+    saved = (sys.stdout, sys.stderr)
+    sys.stdout, sys.stderr = out_router, err_router
+    try:
+        results.extend(_run_all(entries, out_router, err_router))
+    finally:
+        sys.stdout, sys.stderr = saved
     report = RunReport(results=results, total_secs=time.time() - t0)
 
     print(LARGE_SEP)

@@ -23,9 +23,10 @@ class _TeeWriter(io.TextIOBase):
 
     def write(self, s):
         self.real.write(s)
-        if self.buf.tell() < self.cap:
-            self.buf.write(s[:self.cap - self.buf.tell()])
-        elif s:
+        room = self.cap - self.buf.tell()
+        if room > 0:
+            self.buf.write(s[:room])
+        if s and len(s) > max(room, 0):
             self.truncated = True
         return len(s)
 
@@ -34,6 +35,36 @@ class _TeeWriter(io.TextIOBase):
 
     def captured(self) -> str:
         return self.buf.getvalue()
+
+
+class ThreadRouter(io.TextIOBase):
+    """Routes writes by thread: threads registered via :meth:`route` write
+    to their own `_TeeWriter`; everything else goes to the real stream.
+
+    The CLI runner installs one router per stream for the whole run so a
+    timed-out test's orphaned thread keeps writing to ITS OWN (abandoned)
+    capture buffer instead of contaminating the next test's capture."""
+
+    def __init__(self, real):
+        self.real = real
+        self.routes = {}
+
+    def route(self, thread_ident, writer) -> None:
+        self.routes[thread_ident] = writer
+
+    def unroute(self, thread_ident) -> None:
+        self.routes.pop(thread_ident, None)
+
+    def write(self, s):
+        import threading
+
+        w = self.routes.get(threading.get_ident())
+        if w is not None:
+            return w.write(s)
+        return self.real.write(s)
+
+    def flush(self):
+        self.real.flush()
 
 
 class TeeStdOutErr:

@@ -1,0 +1,135 @@
+"""Tensorised twin of lab 1 exactly-once client/server.
+
+Object model being mirrored (dslabs_tpu/labs/clientserver/clientserver.py:
+SimpleServer = AMOApplication(KVStore), SimpleClient with a 100 ms retry
+timer; reference spec ClientServerPart2Test.java:175-281): ``n_clients``
+ClientWorker-wrapped clients each Put their own key W times.
+
+State collapse (same discipline as the paxos twin, tpu/protocols/paxos.py):
+under this workload every object-state component is determined by two
+small integers per client —
+
+  a_c  server-side AMO last-executed seq for client c (KVStore key_c and
+       the AMO result cache are functions of a_c: commands arrive in
+       client order, the AMO layer executes a prefix 1..a_c),
+  k_c  client progress: waiting on command k (ClientWorker pumps the next
+       command inside the reply handler, ClientWorker.java:174-235), or
+       done (W+1).
+
+Lanes:
+  nodes  = [a_0..a_{NC-1}, k_0..k_{NC-1}]   node 0 = server, 1+c = client c
+  msg    = [tag, c, seq]                    REQ -> server, REPLY -> client c
+  timer  = [tag, min, max, seq]             ClientTimer on node 1+c
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from dslabs_tpu.tpu.engine import SENTINEL, TensorProtocol
+
+__all__ = ["make_clientserver_protocol"]
+
+REQ, REPLY = 0, 1
+T_CLIENT = 1
+CLIENT_MS = 100  # lab1 Timers.java ClientTimer
+
+
+def make_clientserver_protocol(n_clients: int = 1, w: int = 1,
+                               net_cap: int = 16,
+                               timer_cap: int = 4) -> TensorProtocol:
+    NC = n_clients
+    MW, TW = 3, 4
+    NW = 2 * NC
+    N_NODES = 1 + NC
+
+    def msg_row(cond, tag, c, seq):
+        rec = jnp.stack([jnp.asarray(tag, jnp.int32),
+                         jnp.asarray(c, jnp.int32),
+                         jnp.asarray(seq, jnp.int32)])
+        return jnp.where(cond, rec, jnp.full((MW,), SENTINEL, jnp.int32))[None]
+
+    def timer_row(cond, c, seq):
+        rec = jnp.stack([jnp.asarray(1 + c, jnp.int32),
+                         jnp.asarray(T_CLIENT, jnp.int32),
+                         jnp.asarray(CLIENT_MS, jnp.int32),
+                         jnp.asarray(CLIENT_MS, jnp.int32),
+                         jnp.asarray(seq, jnp.int32)])
+        return jnp.where(cond, rec,
+                         jnp.full((1 + TW,), SENTINEL, jnp.int32))[None]
+
+    def step_message(nodes, msg):
+        tag, c, s = msg[0], msg[1], msg[2]
+        ci = c.clip(0, NC - 1)
+
+        # ---- server: handle_Request (SimpleServer.handle_Request; AMO
+        # executes fresh seqs, replies for fresh or exactly-cached seqs)
+        is_req = tag == REQ
+        a = nodes[ci]
+        fresh = is_req & (s > a)
+        nodes = nodes.at[ci].set(jnp.where(fresh, s, a).astype(jnp.int32))
+        reply = is_req & (s >= a)          # fresh -> reply; s == a -> cached
+        sends = msg_row(reply, REPLY, c, s)
+
+        # ---- client c: handle_Reply (ClientWorker pumps the next command)
+        is_rep = tag == REPLY
+        k = nodes[NC + ci]
+        match = is_rep & (s == k) & (k <= w)
+        k2 = jnp.where(match, k + 1, k)
+        nodes = nodes.at[NC + ci].set(k2.astype(jnp.int32))
+        has_next = match & (k2 <= w)
+        sends = jnp.minimum(sends, msg_row(has_next, REQ, c, k2))
+        tsets = timer_row(has_next, ci, k2)
+        return nodes, sends, tsets
+
+    def step_timer(nodes, node_idx, timer):
+        # ClientTimer on node 1+c: retry iff still waiting on that seq
+        # (SimpleClient.on_ClientTimer).
+        tag, s = timer[0], timer[3]
+        ci = (node_idx - 1).clip(0, NC - 1)
+        k = nodes[NC + ci]
+        live = (node_idx >= 1) & (tag == T_CLIENT) & (s == k) & (k <= w)
+        sends = msg_row(live, REQ, ci, k)
+        tsets = timer_row(live, ci, k)
+        return nodes, sends, tsets
+
+    def init_nodes():
+        nodes = np.zeros((NW,), np.int32)
+        nodes[NC:] = 1            # every client waiting on command 1
+        return nodes
+
+    def init_messages():
+        return np.array([[REQ, c, 1] for c in range(NC)], np.int32)
+
+    def init_timers():
+        return np.array([[1 + c, T_CLIENT, CLIENT_MS, CLIENT_MS, 1]
+                         for c in range(NC)], np.int32)
+
+    def msg_dest(msg):
+        return jnp.where(msg[0] == REQ, 0, 1 + msg[1])
+
+    def clients_done(state):
+        done = jnp.asarray(True)
+        for c in range(NC):
+            done = done & (state["nodes"][NC + c] == w + 1)
+        return done
+
+    return TensorProtocol(
+        name=f"clientserver-c{NC}-w{w}",
+        n_nodes=N_NODES,
+        node_width=NW,
+        msg_width=MW,
+        timer_width=TW,
+        net_cap=net_cap,
+        timer_cap=timer_cap,
+        max_sends=1,
+        max_sets=1,
+        init_nodes=init_nodes,
+        init_messages=init_messages,
+        init_timers=init_timers,
+        step_message=step_message,
+        step_timer=step_timer,
+        msg_dest=msg_dest,
+        goals={"CLIENTS_DONE": clients_done},
+    )

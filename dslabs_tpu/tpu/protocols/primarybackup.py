@@ -1,0 +1,499 @@
+"""Tensorised twin of lab 2 primary-backup (ViewServer + PBServer/PBClient).
+
+Mirrors the object implementation handler-for-handler
+(dslabs_tpu/labs/primarybackup/viewserver.py, pb.py; reference spec
+PrimaryBackupTest.java:75-905, ViewServerTest.java:40-303), including the
+pieces that make the search graph what it is: the ViewServer's
+first-ping-order idle selection and unbounded tick counters, the
+ack-before-view-change rule, primary state transfer with refusal to serve
+until acked, one-outstanding-op forwarding, and the client's re-poll of
+the view on every retry.
+
+Workload model (same as the lab-1 twin): each of ``n_clients`` clients
+Puts its own key W times, so the AMO/KV state per application collapses to
+one last-executed-seq lane per client.
+
+Node order: 0 = ViewServer, 1..NS = PBServers, NS+1.. = clients.
+
+Lanes:
+  ViewServer: [vn, prim, back, acked, next_rank] + per server [rank, ticks]
+              (rank 0 = never pinged; rank order = dict insertion order,
+              which breaks idle-selection ties, viewserver.py:112-116)
+  PBServer s: [vn, prim, back, synced, pend_client+1, pend_seq] + amo[NC]
+  Client c:   [k, vn, prim, back]          k = seq in flight, W+1 = done
+
+Messages [tag, frm, to, payload...]:
+  PING [vn]    GETVIEW []      VIEWREPLY [vn, prim, back]
+  REQ [c, s]   REPLY [c, s]    FWD [vn, c, s]   FWDACK [vn, c, s]
+  XFER [vn, prim, back, amo_0..amo_NC-1]        XFERACK [vn]
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from dslabs_tpu.tpu.engine import SENTINEL, TensorProtocol
+
+__all__ = ["make_pb_protocol"]
+
+PING, GETVIEW, VIEWREPLY, REQ, REPLY, FWD, FWDACK, XFER, XFERACK = range(9)
+T_PINGCHECK, T_PING, T_CLIENT = 1, 2, 3
+PINGCHECK_MS = 100
+PING_MS = 25
+CLIENT_MS = 100
+DEAD_TICKS = 2
+
+
+def make_pb_protocol(ns: int = 2, n_clients: int = 1, w: int = 1,
+                     net_cap: int = 32, timer_cap: int = 4) -> TensorProtocol:
+    NS, NC = ns, n_clients
+    VSW = 5 + 2 * NS
+    SW = 6 + NC
+    CW = 4
+    NW = VSW + NS * SW + NC * CW
+    N_NODES = 1 + NS + NC
+    PAYLOAD = max(3 + NC, 3)
+    MW = 3 + PAYLOAD
+    TW = 4
+    # rows: vs 1 + server 2 + client 2 (see finalize calls below)
+    MAX_SENDS = 5
+    MAX_SETS = 3
+
+    # ------------------------------------------------------- un/pack state
+
+    def _unpack(nodes):
+        st = {
+            "vvn": nodes[0], "vp": nodes[1], "vb": nodes[2],
+            "vack": nodes[3], "vnext": nodes[4],
+            "rank": nodes[5:5 + 2 * NS:2], "ticks": nodes[6:5 + 2 * NS:2],
+        }
+        base = VSW
+        st["svn"] = jnp.stack([nodes[base + s * SW + 0] for s in range(NS)])
+        st["sp"] = jnp.stack([nodes[base + s * SW + 1] for s in range(NS)])
+        st["sb"] = jnp.stack([nodes[base + s * SW + 2] for s in range(NS)])
+        st["sync"] = jnp.stack([nodes[base + s * SW + 3] for s in range(NS)])
+        st["pc"] = jnp.stack([nodes[base + s * SW + 4] for s in range(NS)])
+        st["ps"] = jnp.stack([nodes[base + s * SW + 5] for s in range(NS)])
+        st["amo"] = jnp.stack([nodes[base + s * SW + 6:base + s * SW + 6 + NC]
+                               for s in range(NS)])
+        cb = VSW + NS * SW
+        st["k"] = jnp.stack([nodes[cb + c * CW + 0] for c in range(NC)])
+        st["cvn"] = jnp.stack([nodes[cb + c * CW + 1] for c in range(NC)])
+        st["cp"] = jnp.stack([nodes[cb + c * CW + 2] for c in range(NC)])
+        st["cb"] = jnp.stack([nodes[cb + c * CW + 3] for c in range(NC)])
+        return st
+
+    def _repack(st):
+        parts = [st["vvn"][None], st["vp"][None], st["vb"][None],
+                 st["vack"][None], st["vnext"][None]]
+        for s in range(NS):
+            parts.extend([st["rank"][s][None], st["ticks"][s][None]])
+        for s in range(NS):
+            parts.extend([st["svn"][s][None], st["sp"][s][None],
+                          st["sb"][s][None], st["sync"][s][None],
+                          st["pc"][s][None], st["ps"][s][None],
+                          st["amo"][s]])
+        for c in range(NC):
+            parts.extend([st["k"][c][None], st["cvn"][c][None],
+                          st["cp"][c][None], st["cb"][c][None]])
+        return jnp.concatenate(parts).astype(jnp.int32)
+
+    # ------------------------------------------------------------ builders
+
+    def mk_row(cond, tag, frm, to, payload):
+        lanes = [jnp.asarray(tag, jnp.int32), jnp.asarray(frm, jnp.int32),
+                 jnp.asarray(to, jnp.int32)]
+        for v in payload:
+            lanes.append(jnp.asarray(v, jnp.int32))
+        while len(lanes) < MW:
+            lanes.append(jnp.zeros((), jnp.int32))
+        rec = jnp.stack(lanes)
+        return jnp.where(cond, rec, jnp.full((MW,), SENTINEL, jnp.int32))
+
+    def mk_set(cond, node, tag, ms, p0):
+        rec = jnp.stack([jnp.asarray(node, jnp.int32),
+                         jnp.asarray(tag, jnp.int32),
+                         jnp.asarray(ms, jnp.int32),
+                         jnp.asarray(ms, jnp.int32),
+                         jnp.asarray(p0, jnp.int32)])
+        return jnp.where(cond, rec, jnp.full((1 + TW,), SENTINEL, jnp.int32))
+
+    class Rows:
+        def __init__(self):
+            self.rows = []
+
+        def add(self, row):
+            self.rows.append(row)
+
+        def finalize(self, count):
+            assert len(self.rows) <= count, (len(self.rows), count)
+            blank = jnp.full((self.rows[0].shape[-1] if self.rows else MW,),
+                             SENTINEL, jnp.int32)
+            rows = list(self.rows)
+            while len(rows) < count:
+                rows.append(blank if rows else
+                            jnp.full((MW,), SENTINEL, jnp.int32))
+            return jnp.stack(rows)
+
+    # -------------------------------------------------- ViewServer helpers
+
+    def vs_alive(st, a):
+        """a is a 1-based server id (0 = None)."""
+        ai = (a - 1).clip(0, NS - 1)
+        return (a > 0) & (st["rank"][ai] > 0) & (st["ticks"][ai] < DEAD_TICKS)
+
+    def vs_idle(st):
+        """First alive non-primary/backup server in first-ping (rank)
+        order; 0 if none (viewserver.py:112-116)."""
+        best_rank = jnp.full((), 1 << 30, jnp.int32)
+        best = jnp.zeros((), jnp.int32)
+        for s in range(NS):
+            sid = s + 1
+            ok = ((st["rank"][s] > 0) & (st["ticks"][s] < DEAD_TICKS)
+                  & (st["vp"] != sid) & (st["vb"] != sid)
+                  & (st["rank"][s] < best_rank))
+            best_rank = jnp.where(ok, st["rank"][s], best_rank)
+            best = jnp.where(ok, sid, best)
+        return best
+
+    def vs_evaluate(st, cond):
+        """The view-change rules (viewserver.py:118-139), as masks."""
+        prim, back, acked = st["vp"], st["vb"], st["vack"]
+        idle = vs_idle(st)
+        ap = vs_alive(st, prim)
+        ab = vs_alive(st, back)
+        c0 = cond & (prim == 0) & (idle > 0)                  # startup
+        guard = cond & (prim != 0) & (acked == 1)
+        c1 = guard & ~ap & ab                                 # promote backup
+        c2 = guard & ~ap & (back == 0) & (idle > 0)           # dead solo prim
+        c3 = guard & ap & (back != 0) & ~ab                   # replace backup
+        c4 = guard & ap & (back == 0) & (idle > 0)            # fill backup
+        did = c0 | c1 | c2 | c3 | c4
+        np_ = jnp.where(c0, idle, jnp.where(c1, back, prim))
+        nb = jnp.where(c0, 0, jnp.where(c1 | c2 | c3 | c4, idle, back))
+        # c1's idle excludes the OLD primary/backup — correct: the old
+        # primary is dead and the old backup is the new primary, and
+        # vs_idle already skipped both.
+        st["vp"] = jnp.where(did, np_, prim).astype(jnp.int32)
+        st["vb"] = jnp.where(did, nb, back).astype(jnp.int32)
+        st["vvn"] = jnp.where(did, st["vvn"] + 1, st["vvn"]).astype(jnp.int32)
+        st["vack"] = jnp.where(did, 0, st["vack"]).astype(jnp.int32)
+
+    def vs_view_reply(st, cond, to, sends: Rows):
+        sends.add(mk_row(cond, VIEWREPLY, 0, to,
+                         [st["vvn"], st["vp"], st["vb"]]))
+
+    # ---------------------------------------------------- PBServer helpers
+
+    def srv_adopt(st, s, view, sends: Rows, can_send: bool):
+        """_adopt (pb.py:123-137) for server index s (0-based). view =
+        (vn, prim, back) lanes; cond rides inside view[0] > svn."""
+        sid = s + 1
+        vn, prim, back = view
+        do = vn > st["svn"][s]
+        st["svn"] = st["svn"].at[s].set(
+            jnp.where(do, vn, st["svn"][s]).astype(jnp.int32))
+        st["sp"] = st["sp"].at[s].set(
+            jnp.where(do, prim, st["sp"][s]).astype(jnp.int32))
+        st["sb"] = st["sb"].at[s].set(
+            jnp.where(do, back, st["sb"][s]).astype(jnp.int32))
+        st["pc"] = st["pc"].at[s].set(
+            jnp.where(do, 0, st["pc"][s]).astype(jnp.int32))
+        st["ps"] = st["ps"].at[s].set(
+            jnp.where(do, 0, st["ps"][s]).astype(jnp.int32))
+        is_p = do & (prim == sid)
+        is_b = do & (back == sid)
+        new_sync = jnp.where(
+            is_p, jnp.where(back != 0, 0, 1),
+            jnp.where(is_b, 0, 1))
+        st["sync"] = st["sync"].at[s].set(
+            jnp.where(do, new_sync, st["sync"][s]).astype(jnp.int32))
+        if can_send:
+            xfer = is_p & (back != 0)
+            sends.add(mk_row(xfer, XFER, sid, back,
+                             [vn, prim, back] + [st["amo"][s][c]
+                                                 for c in range(NC)]))
+        return do
+
+    # ----------------------------------------------------- message handler
+
+    def step_message(nodes, msg):
+        tag, frm, to = msg[0], msg[1], msg[2]
+        p = msg[3:]
+        st = _unpack(nodes)
+
+        # ---------------- ViewServer (node 0)
+        vs_here = to == 0
+        vs_sends = Rows()
+        is_ping = vs_here & (tag == PING)
+        si = (frm - 1).clip(0, NS - 1)
+        # first ping assigns the next rank (dict insertion order)
+        newcomer = is_ping & (st["rank"][si] == 0)
+        st["vnext"] = jnp.where(newcomer, st["vnext"] + 1,
+                                st["vnext"]).astype(jnp.int32)
+        st["rank"] = st["rank"].at[si].set(
+            jnp.where(newcomer, st["vnext"], st["rank"][si]).astype(jnp.int32))
+        st["ticks"] = st["ticks"].at[si].set(
+            jnp.where(is_ping, 0, st["ticks"][si]).astype(jnp.int32))
+        st["vack"] = jnp.where(
+            is_ping & (frm == st["vp"]) & (p[0] == st["vvn"]),
+            1, st["vack"]).astype(jnp.int32)
+        vs_evaluate(st, is_ping)
+        is_gv = vs_here & (tag == GETVIEW)
+        vs_view_reply(st, is_ping | is_gv, frm, vs_sends)
+        vs_rows = vs_sends.finalize(1)
+
+        # ---------------- PBServers (nodes 1..NS)
+        srv_rows = None
+        for s in range(NS):
+            sid = s + 1
+            here = to == sid
+            sends = Rows()
+            # handle_ViewReply -> _adopt (may send a state transfer)
+            is_vr = here & (tag == VIEWREPLY)
+            srv_adopt(st, s, (jnp.where(is_vr, p[0], -1), p[1], p[2]),
+                      sends, can_send=True)
+
+            # handle_Request (pb.py:155-171)
+            is_rq = here & (tag == REQ)
+            c, sq = p[0].clip(0, NC - 1), p[1]
+            serving = (is_rq & (st["sp"][s] == sid)
+                       & (st["sync"][s] == 1))
+            amo_c = st["amo"][s][c]
+            already = serving & (sq <= amo_c)
+            reply_cached = already & (sq == amo_c)
+            solo = serving & ~already & (st["sb"][s] == 0)
+            st["amo"] = st["amo"].at[s, c].set(
+                jnp.where(solo, sq, st["amo"][s][c]).astype(jnp.int32))
+            can_fwd = (serving & ~already & (st["sb"][s] != 0)
+                       & (st["pc"][s] == 0))
+            st["pc"] = st["pc"].at[s].set(
+                jnp.where(can_fwd, c + 1, st["pc"][s]).astype(jnp.int32))
+            st["ps"] = st["ps"].at[s].set(
+                jnp.where(can_fwd, sq, st["ps"][s]).astype(jnp.int32))
+
+            # handle_ForwardRequest (backup executes + acks)
+            is_fw = here & (tag == FWD)
+            fw_ok = (is_fw & (st["sb"][s] == sid)
+                     & (p[0] == st["svn"][s]) & (st["sync"][s] == 1))
+            fc, fs = p[1].clip(0, NC - 1), p[2]
+            st["amo"] = st["amo"].at[s, fc].set(
+                jnp.where(fw_ok & (fs > st["amo"][s][fc]), fs,
+                          st["amo"][s][fc]).astype(jnp.int32))
+
+            # handle_ForwardAck (primary commits + replies)
+            is_fa = here & (tag == FWDACK)
+            fa_ok = (is_fa & (st["sp"][s] == sid)
+                     & (p[0] == st["svn"][s])
+                     & (st["pc"][s] == p[1] + 1) & (st["ps"][s] == p[2]))
+            ac, asq = p[1].clip(0, NC - 1), p[2]
+            st["pc"] = st["pc"].at[s].set(
+                jnp.where(fa_ok, 0, st["pc"][s]).astype(jnp.int32))
+            st["ps"] = st["ps"].at[s].set(
+                jnp.where(fa_ok, 0, st["ps"][s]).astype(jnp.int32))
+            fa_reply = fa_ok & (asq >= st["amo"][s][ac])
+            st["amo"] = st["amo"].at[s, ac].set(
+                jnp.where(fa_ok & (asq > st["amo"][s][ac]), asq,
+                          st["amo"][s][ac]).astype(jnp.int32))
+
+            # handle_StateTransfer (pb.py:190-199)
+            is_xf = here & (tag == XFER)
+            mine = is_xf & (p[2] == sid)
+            srv_adopt(st, s, (jnp.where(mine, p[0], -1), p[1], p[2]),
+                      sends, can_send=False)
+            cur = mine & (st["svn"][s] == p[0])
+            install = cur & (st["sync"][s] == 0)
+            for c2 in range(NC):
+                st["amo"] = st["amo"].at[s, c2].set(
+                    jnp.where(install, p[3 + c2],
+                              st["amo"][s][c2]).astype(jnp.int32))
+            st["sync"] = st["sync"].at[s].set(
+                jnp.where(install, 1, st["sync"][s]).astype(jnp.int32))
+
+            # handle_StateTransferAck
+            is_xa = here & (tag == XFERACK)
+            xa_ok = is_xa & (st["sp"][s] == sid) & (st["svn"][s] == p[0])
+            st["sync"] = st["sync"].at[s].set(
+                jnp.where(xa_ok, 1, st["sync"][s]).astype(jnp.int32))
+
+            # merged reply row (mutually exclusive reply branches)
+            rep = reply_cached | solo | fa_reply
+            rep_c = jnp.where(fa_reply, ac, c)
+            rep_s = jnp.where(fa_reply, asq, sq)
+            sends.add(jnp.minimum(jnp.minimum(
+                mk_row(rep, REPLY, sid, 1 + NS + rep_c, [rep_c, rep_s]),
+                mk_row(can_fwd, FWD, sid, st["sb"][s],
+                       [st["svn"][s], c, sq])),
+                jnp.minimum(
+                    mk_row(fw_ok, FWDACK, sid, frm, [p[0], fc, fs]),
+                    mk_row(cur, XFERACK, sid, frm, [p[0]]))))
+            r = sends.finalize(2)
+            srv_rows = r if srv_rows is None else jnp.minimum(srv_rows, r)
+
+        # ---------------- Clients (nodes NS+1..)
+        cli_rows, cli_sets = None, None
+        for c in range(NC):
+            cid = 1 + NS + c
+            here = to == cid
+            sends, sets = Rows(), Rows()
+            # handle_ViewReply (pb.py:243-247); cvn == -1 means view=None
+            # (distinct from an adopted View(0, None, None) in the object)
+            is_vr = here & (tag == VIEWREPLY)
+            newer = is_vr & ((st["cvn"][c] == -1) | (p[0] > st["cvn"][c]))
+            st["cvn"] = st["cvn"].at[c].set(
+                jnp.where(newer, p[0], st["cvn"][c]).astype(jnp.int32))
+            st["cp"] = st["cp"].at[c].set(
+                jnp.where(newer, p[1], st["cp"][c]).astype(jnp.int32))
+            st["cb"] = st["cb"].at[c].set(
+                jnp.where(newer, p[2], st["cb"][c]).astype(jnp.int32))
+            k = st["k"][c]
+            waiting = k <= w
+            vr_send = newer & waiting & (st["cp"][c] > 0)
+            vr_gv = newer & waiting & (st["cp"][c] == 0)
+
+            # handle_Reply — worker pumps the next command
+            is_rp = here & (tag == REPLY) & (p[0] == c)
+            match = is_rp & (p[1] == k) & waiting
+            k2 = jnp.where(match, k + 1, k)
+            st["k"] = st["k"].at[c].set(k2.astype(jnp.int32))
+            has_next = match & (k2 <= w)
+            nx_req = has_next & (st["cp"][c] > 0)
+            nx_gv = has_next & (st["cp"][c] == 0)
+            seq = jnp.where(has_next, k2, k)
+            sends.add(jnp.minimum(
+                mk_row(vr_send, REQ, cid, st["cp"][c], [c, k]),
+                mk_row(nx_req, REQ, cid, st["cp"][c], [c, seq])))
+            sends.add(mk_row(vr_gv | nx_gv, GETVIEW, cid, 0, []))
+            sets.add(mk_set(has_next, cid, T_CLIENT, CLIENT_MS, k2))
+            r = sends.finalize(2)
+            t = sets.finalize(1)
+            cli_rows = r if cli_rows is None else jnp.minimum(cli_rows, r)
+            cli_sets = t if cli_sets is None else jnp.minimum(cli_sets, t)
+
+        rows = jnp.concatenate([vs_rows, srv_rows, cli_rows])
+        blank_sets = jnp.full((MAX_SETS - 1, 1 + TW), SENTINEL, jnp.int32)
+        tsets = jnp.concatenate([cli_sets, blank_sets])
+        return _repack(st), rows, tsets
+
+    # ------------------------------------------------------ timer handler
+
+    def step_timer(nodes, node_idx, timer):
+        tag, p0 = timer[0], timer[3]
+        st = _unpack(nodes)
+
+        # ---- ViewServer PingCheckTimer (viewserver.py:101-105)
+        is_pc = (node_idx == 0) & (tag == T_PINGCHECK)
+        for s in range(NS):
+            known = is_pc & (st["rank"][s] > 0)
+            st["ticks"] = st["ticks"].at[s].set(
+                jnp.where(known, st["ticks"][s] + 1,
+                          st["ticks"][s]).astype(jnp.int32))
+        vs_evaluate(st, is_pc)
+        vs_sets = mk_set(is_pc, 0, T_PINGCHECK, PINGCHECK_MS, 0)
+
+        # ---- PBServer PingTimer (pb.py:144-153)
+        srv_rows, srv_sets = None, None
+        for s in range(NS):
+            sid = s + 1
+            here = (node_idx == sid) & (tag == T_PING)
+            sends = Rows()
+            is_p = st["sp"][s] == sid
+            has_b = st["sb"][s] != 0
+            # svn == -1 means view=None (pings 0, pb.py:114-121)
+            acked_vn = jnp.where(
+                st["svn"][s] == -1, 0,
+                jnp.where(is_p & has_b & (st["sync"][s] == 0),
+                          st["svn"][s] - 1, st["svn"][s]))
+            sends.add(mk_row(here, PING, sid, 0, [acked_vn]))
+            resend_x = here & is_p & has_b & (st["sync"][s] == 0)
+            refwd = (here & is_p & has_b & (st["sync"][s] == 1)
+                     & (st["pc"][s] > 0))
+            sends.add(jnp.minimum(
+                mk_row(resend_x, XFER, sid, st["sb"][s],
+                       [st["svn"][s], st["sp"][s], st["sb"][s]]
+                       + [st["amo"][s][c] for c in range(NC)]),
+                mk_row(refwd, FWD, sid, st["sb"][s],
+                       [st["svn"][s], st["pc"][s] - 1, st["ps"][s]])))
+            t = mk_set(here, sid, T_PING, PING_MS, 0)
+            r = sends.finalize(2)
+            srv_rows = r if srv_rows is None else jnp.minimum(srv_rows, r)
+            srv_sets = t if srv_sets is None else jnp.minimum(srv_sets, t)
+
+        # ---- Client ClientTimer (pb.py:256-260)
+        cli_rows, cli_sets = None, None
+        for c in range(NC):
+            cid = 1 + NS + c
+            here = (node_idx == cid) & (tag == T_CLIENT)
+            k = st["k"][c]
+            live = here & (p0 == k) & (k <= w)
+            sends = Rows()
+            sends.add(mk_row(live, GETVIEW, cid, 0, []))
+            sends.add(mk_row(live & (st["cp"][c] > 0), REQ, cid,
+                             st["cp"][c], [c, k]))
+            t = mk_set(live, cid, T_CLIENT, CLIENT_MS, k)
+            r = sends.finalize(2)
+            cli_rows = r if cli_rows is None else jnp.minimum(cli_rows, r)
+            cli_sets = t if cli_sets is None else jnp.minimum(cli_sets, t)
+
+        rows = jnp.concatenate([
+            jnp.full((1, MW), SENTINEL, jnp.int32), srv_rows, cli_rows])
+        tsets = jnp.stack([vs_sets, srv_sets, cli_sets])
+        return _repack(st), rows, tsets
+
+    # ------------------------------------------------------------ initials
+
+    def init_nodes():
+        return np.array(
+            [0] * VSW
+            + sum([[-1, 0, 0, 1, 0, 0] + [0] * NC for _ in range(NS)], [])
+            + sum([[1, -1, 0, 0] for _ in range(NC)], []), np.int32)
+
+    def init_messages():
+        msgs = []
+        for s in range(NS):
+            rec = np.zeros((MW,), np.int32)
+            rec[0:3] = [PING, s + 1, 0]
+            msgs.append(rec)
+        for c in range(NC):
+            rec = np.zeros((MW,), np.int32)
+            rec[0:3] = [GETVIEW, 1 + NS + c, 0]
+            msgs.append(rec)
+        return np.stack(msgs)
+
+    def init_timers():
+        recs = [[0, T_PINGCHECK, PINGCHECK_MS, PINGCHECK_MS, 0]]
+        for s in range(NS):
+            recs.append([s + 1, T_PING, PING_MS, PING_MS, 0])
+        for c in range(NC):
+            recs.append([1 + NS + c, T_CLIENT, CLIENT_MS, CLIENT_MS, 1])
+        return np.array(recs, np.int32)
+
+    def msg_dest(msg):
+        return msg[2]
+
+    def clients_done(state):
+        done = jnp.asarray(True)
+        cb = VSW + NS * SW
+        for c in range(NC):
+            done = done & (state["nodes"][cb + c * CW] == w + 1)
+        return done
+
+    return TensorProtocol(
+        name=f"pb-s{NS}-c{NC}-w{w}",
+        n_nodes=N_NODES,
+        node_width=NW,
+        msg_width=MW,
+        timer_width=TW,
+        net_cap=net_cap,
+        timer_cap=timer_cap,
+        max_sends=MAX_SENDS,
+        max_sets=MAX_SETS,
+        init_nodes=init_nodes,
+        init_messages=init_messages,
+        init_timers=init_timers,
+        step_message=step_message,
+        step_timer=step_timer,
+        msg_dest=msg_dest,
+        goals={"CLIENTS_DONE": clients_done},
+    )

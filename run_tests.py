@@ -124,7 +124,7 @@ def _replay_traces() -> int:
         if not ok:
             failures += 1
             state = (results.invariant_violating_state
-                     or results.exceptional_state())
+                     or results.exceptional_state)
             if state is not None:
                 state.print_trace()
     print(f"\n{len(traces) - failures}/{len(traces)} saved traces pass")

@@ -79,6 +79,26 @@ def test_runner_timeout():
     assert report.results[0].timed_out
 
 
+def test_timed_out_thread_does_not_contaminate_next_capture():
+    import time
+
+    def slow_then_print():
+        time.sleep(0.5)
+        print("LATE OUTPUT FROM TIMED-OUT TEST")
+
+    def quick():
+        time.sleep(0.8)   # long enough for the orphan thread to wake
+        print("quick output")
+
+    report = run_tests([
+        entry("slow", num=1, fn=slow_then_print, timeout=0.1),
+        entry("quick", num=2, fn=quick),
+    ])
+    assert report.results[0].timed_out
+    assert "LATE OUTPUT" not in report.results[1].stdout
+    assert "quick output" in report.results[1].stdout
+
+
 def test_tee_capture_and_truncation(capsys):
     with TeeStdOutErr(max_bytes=8) as tee:
         print("0123456789abcdef")

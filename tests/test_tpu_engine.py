@@ -80,6 +80,110 @@ def test_exhaustive_unique_state_parity(w):
         f"tensor discovered {ten.unique_states}")
 
 
+def _clientserver_object_search(nc, w, prune_done=False):
+    from dslabs_tpu.labs.clientserver.clientserver import (SimpleClient,
+                                                           SimpleServer)
+    from dslabs_tpu.labs.clientserver.kv_workload import kv_workload
+    from dslabs_tpu.labs.clientserver.kvstore import KVStore
+
+    server = LocalAddress("server")
+    gen = NodeGenerator(
+        server_supplier=lambda a: SimpleServer(a, KVStore()),
+        client_supplier=lambda a: SimpleClient(a, server),
+        workload_supplier=lambda a: None)
+    state = SearchState(gen)
+    state.add_server(server)
+    for c in range(nc):
+        state.add_client_worker(
+            LocalAddress(f"client{c}"),
+            kv_workload([f"PUT:key{c}:v{i}" for i in range(1, w + 1)],
+                        ["PutOk"] * w))
+    settings = SearchSettings().add_invariant(RESULTS_OK)
+    if prune_done:
+        settings.add_prune(CLIENTS_DONE)
+    else:
+        settings.add_goal(CLIENTS_DONE)
+    settings.max_time(120)
+    return bfs(state, settings)
+
+
+@pytest.mark.parametrize("nc,w", [(1, 1), (1, 2), (2, 1)])
+def test_clientserver_exhaustive_unique_state_parity(nc, w):
+    """Lab 1 twin: same pruned-space unique-state count as the object
+    checker (ClientServerPart2Test.java:175-281 semantics)."""
+    import dataclasses as dc
+
+    from dslabs_tpu.tpu.protocols.clientserver import \
+        make_clientserver_protocol
+
+    obj = _clientserver_object_search(nc, w, prune_done=True)
+    p = make_clientserver_protocol(n_clients=nc, w=w)
+    p = dc.replace(p, goals={},
+                   prunes={"CLIENTS_DONE": p.goals["CLIENTS_DONE"]})
+    ten = TensorSearch(p, chunk=256).run()
+    assert obj.end_condition == EndCondition.SPACE_EXHAUSTED
+    assert ten.end_condition == "SPACE_EXHAUSTED"
+    assert ten.unique_states == obj.discovered_count, (
+        f"object {obj.discovered_count} != tensor {ten.unique_states}")
+
+
+def test_clientserver_goal_parity():
+    from dslabs_tpu.tpu.protocols.clientserver import \
+        make_clientserver_protocol
+
+    obj = _clientserver_object_search(1, 2)
+    ten = TensorSearch(make_clientserver_protocol(n_clients=1, w=2),
+                       chunk=256).run()
+    assert obj.end_condition == EndCondition.GOAL_FOUND
+    assert ten.end_condition == "GOAL_FOUND"
+
+
+def _pb_object_search(ns, nc, w, max_depth):
+    from dslabs_tpu.labs.clientserver.kv_workload import kv_workload
+    from dslabs_tpu.labs.clientserver.kvstore import KVStore
+    from dslabs_tpu.labs.primarybackup.pb import PBClient, PBServer
+    from dslabs_tpu.labs.primarybackup.viewserver import ViewServer
+    from dslabs_tpu.search.search import BFS
+
+    vsa = LocalAddress("viewserver")
+
+    def server_supplier(a):
+        if a == vsa:
+            return ViewServer(a)
+        return PBServer(a, vsa, KVStore())
+
+    gen = NodeGenerator(
+        server_supplier=server_supplier,
+        client_supplier=lambda a: PBClient(a, vsa),
+        workload_supplier=lambda a: None)
+    state = SearchState(gen)
+    state.add_server(vsa)
+    for s in range(1, ns + 1):
+        state.add_server(LocalAddress(f"server{s}"))
+    for c in range(nc):
+        state.add_client_worker(
+            LocalAddress(f"client{c}"),
+            kv_workload([f"PUT:key{c}:v{i}" for i in range(1, w + 1)],
+                        ["PutOk"] * w))
+    settings = SearchSettings().add_invariant(RESULTS_OK)
+    settings.set_max_depth(max_depth).max_time(300)
+    return BFS(settings).run(state)
+
+
+@pytest.mark.parametrize("ns,depth", [(1, 3), (2, 3), (2, 4)])
+def test_pb_depth_parity(ns, depth):
+    """Lab 2 twin: depth-limited unique-state parity against the object
+    checker (PrimaryBackupTest.java:660-905 search semantics), covering
+    view formation, pings/ticks, and the state-transfer machinery."""
+    from dslabs_tpu.tpu.protocols.primarybackup import make_pb_protocol
+
+    obj = _pb_object_search(ns, 1, 1, depth)
+    p = make_pb_protocol(ns=ns, n_clients=1, w=1)
+    ten = TensorSearch(p, chunk=256, max_depth=depth).run()
+    assert ten.unique_states == obj.discovered_count, (
+        f"object {obj.discovered_count} != tensor {ten.unique_states}")
+
+
 @pytest.mark.skipif(not __import__("os").environ.get("DSLABS_SLOW_TESTS"),
                     reason="multi-minute XLA compile; set DSLABS_SLOW_TESTS=1")
 def test_paxos_depth_parity():
