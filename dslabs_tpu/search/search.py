@@ -140,7 +140,7 @@ class Search:
         # Sequential worker loop.  The Java engine runs a one-depth-at-a-time
         # thread pool (Search.java:240-347); under CPython the object oracle
         # is sequential — the *parallel* engine is the TPU backend, where one
-        # BFS level is one vmapped XLA program (dslabs_tpu/tpu/frontier.py).
+        # BFS level is one vmapped XLA program (dslabs_tpu/tpu/engine.py).
         while (not self.results.terminal_found()
                and not self.space_exhausted()
                and not self._time_exhausted()):

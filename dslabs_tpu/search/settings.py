@@ -34,6 +34,14 @@ class SearchSettings(TestSettings):
 
     # fluent helpers -------------------------------------------------------
 
+    def clear(self) -> "SearchSettings":
+        """Full reset (SearchSettings.java's clear(): invariants, goals,
+        prunes, network matrix, timer gating, depth) keeping only the time
+        budget defaults — used between staged-search phases
+        (PaxosTest.java:1063)."""
+        self.__init__()
+        return self
+
     def add_prune(self, predicate: StatePredicate) -> "SearchSettings":
         self.prunes.append(predicate)
         return self

@@ -78,6 +78,12 @@ class TestSettings:
             self._timer_delivery[address_or_flag] = value
         return self
 
+    def clear_deliver_timers(self) -> "TestSettings":
+        """Reset all per-address timer gating (TestSettings.java:94)."""
+        self.deliver_timers_default = True
+        self._timer_delivery.clear()
+        return self
+
     def should_deliver_timer(self, to: Address) -> bool:
         return self._timer_delivery.get(to.root_address(),
                                         self.deliver_timers_default)

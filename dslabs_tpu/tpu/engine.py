@@ -53,11 +53,19 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["TensorProtocol", "TensorState", "TensorSearch", "SearchOutcome",
-           "CapacityOverflow", "SENTINEL"]
+           "CapacityOverflow", "SENTINEL", "drop_pending_messages"]
 
 # Empty slots in the network / timer arrays hold SENTINEL in every lane, so
 # they sort after every real record and hash consistently.
 SENTINEL = np.int32(2 ** 31 - 1)
+
+
+def drop_pending_messages(state: dict) -> dict:
+    """The staged-search ``dropPendingMessages`` analog
+    (SearchState.java:534-561): a copy of the state with an empty network
+    (timers survive, so retry timers re-drive the protocol)."""
+    return {**state, "net": jnp.full_like(jnp.asarray(state["net"]),
+                                          SENTINEL)}
 
 
 class CapacityOverflow(RuntimeError):
@@ -122,6 +130,12 @@ class TensorProtocol:
     # optional masks: deliver_message(msg)->bool, deliver_timer(node)->bool
     deliver_message: Optional[Callable] = None
     deliver_timer: Optional[Callable] = None
+    # optional object-twin decoders for trace reconstruction
+    # (tpu/trace.py): decode_message(np_record) -> (from_addr, to_addr,
+    # Message); decode_timer(node_idx, np_record) -> (to_addr, Timer,
+    # min_ms, max_ms).  Addresses follow the twin's parity-test naming.
+    decode_message: Optional[Callable] = None
+    decode_timer: Optional[Callable] = None
 
 
 @dataclasses.dataclass
@@ -557,10 +571,22 @@ class TensorSearch:
         events.reverse()
         return events
 
-    def run(self, check_initial: bool = True) -> SearchOutcome:
+    def run(self, check_initial: bool = True,
+            initial: Optional[dict] = None) -> SearchOutcome:
+        """Run the BFS.  ``initial`` (a batch-1 state pytree, e.g. a prior
+        outcome's ``goal_state``) starts the search from an arbitrary
+        state — the staged-search pattern (PaxosTest.java:886-1096):
+        extract a goal state, change the settings masks
+        (``dataclasses.replace(protocol, deliver_message=...)``), and
+        search onward from it."""
         import time
         t0 = time.time()
-        state = self.initial_state()
+        state = (jax.tree.map(jnp.asarray, initial) if initial is not None
+                 else self.initial_state())
+        # The root this run's trace event-ids are relative to (staged
+        # searches start from arbitrary states; tpu/trace.py replays from
+        # here, not from the protocol's initial state).
+        self._trace_root = jax.tree.map(np.asarray, state)
         fp0 = np.asarray(state_fingerprints(state))
         visited = host_keys(fp0)
         explored = 0

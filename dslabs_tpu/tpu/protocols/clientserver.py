@@ -109,6 +109,40 @@ def make_clientserver_protocol(n_clients: int = 1, w: int = 1,
     def msg_dest(msg):
         return jnp.where(msg[0] == REQ, 0, 1 + msg[1])
 
+    # ---- object-twin decoders (tpu/trace.py): the canonical parity
+    # config — server "server", clients "client{c}", workload
+    # PUT:key{c}:v{i} (tests/test_tpu_engine.py).
+
+    def _amo_cmd(c, s):
+        from dslabs_tpu.core.address import LocalAddress
+        from dslabs_tpu.labs.clientserver.amo import AMOCommand
+        from dslabs_tpu.labs.clientserver.kvstore import Put
+
+        return AMOCommand(Put(f"key{c}", f"v{s}"), LocalAddress(f"client{c}"),
+                          s)
+
+    def decode_message(rec):
+        from dslabs_tpu.core.address import LocalAddress
+        from dslabs_tpu.labs.clientserver.amo import AMOResult
+        from dslabs_tpu.labs.clientserver.clientserver import Reply, Request
+        from dslabs_tpu.labs.clientserver.kvstore import PutOk
+
+        tag, c, s = int(rec[0]), int(rec[1]), int(rec[2])
+        server = LocalAddress("server")
+        client = LocalAddress(f"client{c}")
+        if tag == REQ:
+            return client, server, Request(_amo_cmd(c, s))
+        return server, client, Reply(AMOResult(PutOk(), s))
+
+    def decode_timer(node_idx, rec):
+        from dslabs_tpu.core.address import LocalAddress
+        from dslabs_tpu.labs.clientserver.clientserver import ClientTimer
+
+        c = node_idx - 1
+        s = int(rec[3])
+        return (LocalAddress(f"client{c}"), ClientTimer(_amo_cmd(c, s)),
+                CLIENT_MS, CLIENT_MS)
+
     def clients_done(state):
         done = jnp.asarray(True)
         for c in range(NC):
@@ -132,4 +166,6 @@ def make_clientserver_protocol(n_clients: int = 1, w: int = 1,
         step_timer=step_timer,
         msg_dest=msg_dest,
         goals={"CLIENTS_DONE": clients_done},
+        decode_message=decode_message,
+        decode_timer=decode_timer,
     )

@@ -34,6 +34,29 @@ def make_pingpong_protocol(workload_size: int) -> TensorProtocol:
     mw, tw = 2, 4
     max_sends, max_sets = 1, 1
 
+    # ---- object-twin decoders (tpu/trace.py): canonical parity config —
+    # server "pingserver", client "client1", workload hi-{i}
+    # (tests/test_tpu_engine.py).
+
+    def decode_message(rec):
+        from dslabs_tpu.core.address import LocalAddress
+        from dslabs_tpu.labs.pingpong.pingpong import (Ping, PingRequest,
+                                                       Pong, PongReply)
+
+        tag, i = int(rec[0]), int(rec[1])
+        server = LocalAddress("pingserver")
+        client = LocalAddress("client1")
+        if tag == REQ:
+            return client, server, PingRequest(Ping(f"hi-{i}"))
+        return server, client, PongReply(Pong(f"hi-{i}"))
+
+    def decode_timer(node_idx, rec):
+        from dslabs_tpu.core.address import LocalAddress
+        from dslabs_tpu.labs.pingpong.pingpong import Ping, PingTimer
+
+        return (LocalAddress("client1"), PingTimer(Ping(f"hi-{int(rec[3])}")),
+                PING_MS, PING_MS)
+
     def init_nodes():
         return np.array([1], np.int32)  # waiting on command 1
 
@@ -116,4 +139,6 @@ def make_pingpong_protocol(workload_size: int) -> TensorProtocol:
         msg_dest=msg_dest,
         invariants={"RESULTS_OK": results_ok},
         goals={"CLIENTS_DONE": clients_done},
+        decode_message=decode_message,
+        decode_timer=decode_timer,
     )

@@ -1,0 +1,109 @@
+"""TPU trace reconstruction: from a violating/goal row in the tensor
+search back to a minimized, human-readable OBJECT trace.
+
+Pipeline (SURVEY §8.1 "trace reconstruction"; SearchState.java:361-474,
+TraceMinimizer.java:33-61):
+
+1. The engine spills (parent frontier row, event id) per level when
+   ``record_trace=True``; ``SearchOutcome.trace`` is the root-first event-id
+   list for the terminal row (engine._reconstruct).
+2. :func:`decode_trace` replays that list in TENSOR space one state at a
+   time, reading each step's concrete message/timer lanes *before*
+   stepping — event ids alone are meaningless without the parent state's
+   canonical network/timer contents.
+3. :func:`replay_on_object` maps each record through the protocol's
+   ``decode_message``/``decode_timer`` and replays the resulting envelopes
+   on the object-twin SearchState, rebuilding the parent chain the
+   existing minimizer and human-readable printer consume.
+
+The result: a TPU INVARIANT_VIOLATED/GOAL_FOUND outcome yields the same
+trace artifact (minimizable, printable, saveable) as the object backend.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from dslabs_tpu.testing.events import MessageEnvelope, TimerEnvelope
+from dslabs_tpu.tpu.engine import SearchOutcome, TensorSearch
+
+__all__ = ["decode_trace", "replay_on_object", "reconstruct_object_trace"]
+
+
+def decode_trace(search: TensorSearch,
+                 outcome: SearchOutcome) -> List[Tuple[str, tuple]]:
+    """Replay ``outcome.trace`` (event-id list) in tensor space; return
+    root-first records ``("message", lanes)`` / ``("timer", node, lanes)``."""
+    if outcome.trace is None:
+        raise ValueError("outcome has no trace "
+                         "(run the search with record_trace=True)")
+    p = search.p
+    # Replay from the root the trace was recorded against — for staged
+    # searches (run(initial=...)) that is NOT the protocol initial state.
+    root = getattr(search, "_trace_root", None)
+    if root is None:
+        root = jax.tree.map(np.asarray, search.initial_state())
+    state = jax.tree.map(lambda x: np.asarray(x)[0], root)
+    step = jax.jit(search._step_one)
+    records: List[Tuple[str, tuple]] = []
+    for ev in outcome.trace:
+        if ev < p.net_cap:
+            rec = np.asarray(state["net"][ev]).copy()
+            records.append(("message", (rec,)))
+        else:
+            t_idx = ev - p.net_cap
+            node, slot = t_idx // p.timer_cap, t_idx % p.timer_cap
+            rec = np.asarray(state["timers"][node, slot]).copy()
+            records.append(("timer", (node, rec)))
+        succ, valid, _ = step(
+            jax.tree.map(lambda x: jax.numpy.asarray(x), state),
+            jax.numpy.asarray(ev))
+        assert bool(valid), (
+            f"trace replay hit an undeliverable event {ev} — "
+            "reconstruction mapping is corrupt")
+        state = jax.tree.map(np.asarray, succ)
+    return records
+
+
+def replay_on_object(search: TensorSearch, outcome: SearchOutcome,
+                     initial_object_state,
+                     settings=None):
+    """Replay the reconstructed record list on the object twin, returning
+    the final object SearchState (whose parent chain IS the trace)."""
+    p = search.p
+    if p.decode_message is None or p.decode_timer is None:
+        raise ValueError(f"{p.name}: protocol has no object-twin decoders")
+    state = initial_object_state
+    for kind, payload in decode_trace(search, outcome):
+        if kind == "message":
+            frm, to, msg = p.decode_message(payload[0])
+            event = MessageEnvelope(frm, to, msg)
+        else:
+            node, rec = payload
+            to, timer, mn, mx = p.decode_timer(node, rec)
+            event = TimerEnvelope(to, timer, mn, mx)
+        nxt = state.step_event(event, settings, skip_checks=True)
+        assert nxt is not None, (
+            f"object twin rejected reconstructed event {event!r} — "
+            "tensor/object divergence")
+        state = nxt
+    return state
+
+
+def reconstruct_object_trace(search: TensorSearch, outcome: SearchOutcome,
+                             initial_object_state, predicate=None,
+                             settings=None, minimize: bool = True):
+    """Full pipeline: tensor outcome -> replayed object state ->
+    (optionally) minimized against ``predicate`` (the object analog of the
+    violated invariant / matched goal).  Returns the final SearchState;
+    ``.print_trace()`` gives the human-readable causal trace."""
+    end = replay_on_object(search, outcome, initial_object_state, settings)
+    if minimize and predicate is not None:
+        from dslabs_tpu.search.minimize import minimize_trace
+
+        result = predicate.check(end)
+        end = minimize_trace(end, result)
+    return end

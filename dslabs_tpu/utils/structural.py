@@ -30,6 +30,8 @@ from __future__ import annotations
 import copy
 from typing import Any
 
+from dslabs_tpu.utils.flags import GlobalSettings
+
 __all__ = ["sfreeze", "shash", "clone", "StructEq", "ImmutableMarker"]
 
 
@@ -105,11 +107,29 @@ def clone(obj: Any):
 
     Equivalent role to the reference's Cloning.clone (utils/Cloning.java:109-141):
     used for clone-on-send and copy-on-write successor states.  Immutable-marked
-    objects are returned as-is.
+    objects are returned as-is.  Under ``do_error_checks`` every clone is
+    verified equal-and-hash-consistent with its original and failures are
+    routed to the CheckLogger (Cloning.java:130-138).
     """
     if obj is None or isinstance(obj, (bool, int, float, str, bytes, ImmutableMarker)):
         return obj
-    return copy.deepcopy(obj)
+    out = copy.deepcopy(obj)
+    if GlobalSettings.do_error_checks():
+        from dslabs_tpu.utils.check_logger import CheckLogger
+
+        try:
+            eq = bool(out == obj)
+        except Exception:  # noqa: BLE001 — incomparable (e.g. array-valued
+            eq = None      # __eq__); cannot judge, not a conformance finding
+        if eq is False:
+            CheckLogger.clone_not_equal(obj)
+        elif eq:
+            try:
+                if shash(out) != shash(obj):
+                    CheckLogger.hash_inconsistent(obj)
+            except Exception:  # noqa: BLE001 — unhashable: nothing to check
+                pass
+    return out
 
 
 class StructEq:

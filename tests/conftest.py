@@ -7,7 +7,11 @@ contract.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard override, not setdefault: the driver environment pins
+# JAX_PLATFORMS to the real accelerator, but the test suite must run on
+# the virtual CPU mesh (the accelerator is reserved for bench runs, and
+# every jit would otherwise pay a multi-minute TPU compile).
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (

@@ -15,6 +15,7 @@ from dslabs_tpu.runner.run_state import RunState
 from dslabs_tpu.testing.generator import NodeGenerator
 from dslabs_tpu.testing.predicates import RESULTS_OK
 from dslabs_tpu.testing.workload import Workload
+from dslabs_tpu.utils.structural import clone
 
 SERVER = LocalAddress("pingserver")
 
@@ -103,3 +104,18 @@ def test_max_wait_tracked():
         mw = w.max_wait(state.stop_time)
         assert mw is not None
         assert mw[0] < 1.0  # reliable local network: sub-second waits
+
+
+@lab_test("0", 2, "Multiple clients can ping simultaneously", categories=(RUN_TESTS,))
+def test02_multiple_clients_ping():
+    """PingTest.test02MultipleClientsPing: ten clients, %a-templated
+    workload (each pings its own address string)."""
+    state = make_state(num_clients=0, num_pings=1)
+    workload = Workload(command_strings=["hello from %a"],
+                        result_strings=["hello from %a"],
+                        parser=ping_parser)
+    for i in range(1, 11):
+        state.add_client_worker(LocalAddress(f"client{i}"), clone(workload))
+    state.run(RunSettings().max_time(10))
+    r = RESULTS_OK.check(state)
+    assert r.value, r.error_message()

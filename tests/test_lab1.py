@@ -11,19 +11,22 @@ from dslabs_tpu.core.address import LocalAddress
 from dslabs_tpu.labs.clientserver.amo import AMOApplication, AMOCommand
 from dslabs_tpu.labs.clientserver.clientserver import SimpleClient, SimpleServer
 from dslabs_tpu.labs.clientserver.kv_workload import (
-    APPENDS_LINEARIZABLE, append_different_key_workload,
-    append_same_key_workload, kv_workload, put_get_workload, simple_workload)
+    APPENDS_LINEARIZABLE, append, append_different_key_workload,
+    append_result, append_same_key_workload,
+    different_keys_infinite_workload, get, kv_workload, put, put_get_workload,
+    put_ok, simple_workload)
 from dslabs_tpu.labs.clientserver.kvstore import (Append, AppendResult, Get,
                                                   GetResult, KVStore,
                                                   KeyNotFound, Put, PutOk)
 from dslabs_tpu.runner.run_settings import RunSettings
 from dslabs_tpu.runner.run_state import RunState
 from dslabs_tpu.search.results import EndCondition
-from dslabs_tpu.search.search import bfs
+from dslabs_tpu.search.search import bfs, dfs
 from dslabs_tpu.search.search_state import SearchState
 from dslabs_tpu.search.settings import SearchSettings
 from dslabs_tpu.testing.generator import NodeGenerator
-from dslabs_tpu.testing.predicates import CLIENTS_DONE, RESULTS_OK
+from dslabs_tpu.testing.predicates import (CLIENTS_DONE, NONE_DECIDED,
+                                           RESULTS_OK)
 
 SERVER = LocalAddress("server")
 
@@ -176,3 +179,209 @@ def test_search_two_clients_linearizable_appends():
     settings.max_time(60)
     results = bfs(state, settings)
     assert results.end_condition == EndCondition.GOAL_FOUND
+
+
+@lab_test("1", 1, "Client throws InterruptedException", points=5, part=2, categories=(RUN_TESTS,))
+def test01_throws_exception():
+    """ClientServerPart1Test.test01ThrowsException: with the run state
+    never started, get_result must block (and time out) rather than
+    return."""
+    import pytest as _pytest
+
+    state = make_run_state(num_clients=0)
+    c = state.add_client(LocalAddress("client1"))
+    c.send_command(get("FOO"))
+    with _pytest.raises(TimeoutError):
+        c.get_result(timeout=0.5)
+
+
+@lab_test("1", 5, "Single client can finish operations", points=20, part=2, categories=(RUN_TESTS, UNRELIABLE_TESTS,))
+def test05_single_client_finishes_unreliable():
+    """ClientServerPart1Test.test05: 25 appends complete despite 50% loss."""
+    state = make_run_state(
+        num_clients=1,
+        workload_factory=lambda: append_different_key_workload(25))
+    settings = RunSettings().max_time(30)
+    settings.network_unreliable(True)
+    state.run(settings)
+    assert_ok(state)
+
+
+@lab_test("1", 2, "Single client sequential appends", points=20, part=3, categories=(RUN_TESTS, UNRELIABLE_TESTS,))
+def test02_single_client_appends_unreliable():
+    """ClientServerPart2Test.test02: 50 appends at deliver rate 0.8."""
+    state = make_run_state(
+        num_clients=1,
+        workload_factory=lambda: append_different_key_workload(50))
+    settings = RunSettings().max_time(30)
+    settings.network_deliver_rate(0.8)
+    state.run(settings)
+    assert_ok(state)
+
+
+@lab_test("1", 3, "Multi-client different key appends", points=20, part=3, categories=(RUN_TESTS, UNRELIABLE_TESTS,))
+def test03_multi_client_different_key_unreliable():
+    """ClientServerPart2Test.test03 (scaled 10x100 -> 5x20 for the Python
+    runner's wall clock; same shape: many clients, own keys, 0.8)."""
+    state = make_run_state(
+        num_clients=5,
+        workload_factory=lambda: append_different_key_workload(20))
+    settings = RunSettings().max_time(30)
+    settings.network_deliver_rate(0.8)
+    state.run(settings)
+    assert_ok(state)
+
+
+@lab_test("1", 4, "Multi-client same key appends", points=20, part=3, categories=(RUN_TESTS, UNRELIABLE_TESTS,))
+def test04_multi_client_same_key_unreliable():
+    """ClientServerPart2Test.test04: 10 clients x 5 same-key appends at
+    0.8, checked with APPENDS_LINEARIZABLE."""
+    state = make_run_state(
+        num_clients=10,
+        workload_factory=lambda: append_same_key_workload(5))
+    settings = RunSettings().max_time(30)
+    settings.network_deliver_rate(0.8)
+    state.run(settings)
+    r = APPENDS_LINEARIZABLE.check(state)
+    assert r.value, r.error_message()
+
+
+@lab_test("1", 5, "Old commands garbage collected", points=20, part=3, categories=(RUN_TESTS,))
+def test05_garbage_collection():
+    """ClientServerPart2Test.test05GarbageCollection (scaled 1MBx5x3x5 ->
+    100KBx3x2x2): server memory returns under the small bound once values
+    are overwritten — the AMO result cache must not retain old results."""
+    import cloudpickle
+
+    from dslabs_tpu.utils.structural import sfreeze
+
+    value_size, items, iters, num_clients = 100_000, 3, 2, 2
+    small_bound = 500_000
+
+    state = make_run_state(num_clients=0)
+    clients = [state.add_client(LocalAddress(f"client{c}"))
+               for c in range(1, num_clients + 1)]
+
+    def nodes_size():
+        # serialized size of the nodes' PUBLIC state (the reference's
+        # nodesSize nulls transient fields before serializing,
+        # BaseJUnitTest.java:453-467)
+        return len(cloudpickle.dumps(sfreeze(
+            {**dict(state.servers), **dict(state.clients)})))
+
+    assert nodes_size() < small_bound
+    state.start(RunSettings().max_time(120))
+    kv = {}
+    for _ in range(iters):
+        for key in range(items):
+            for ci, c in enumerate(clients, start=1):
+                k = f"client{ci}-key{key}"
+                v = "x" * value_size
+                kv[k] = kv.get(k, "") + v
+                c.send_command(append(k, v))
+                assert c.get_result(timeout=5) == append_result(kv[k])
+    assert nodes_size() > value_size * items * num_clients
+
+    for key in range(items):
+        for ci, c in enumerate(clients, start=1):
+            c.send_command(put(f"client{ci}-key{key}", ""))
+            assert c.get_result(timeout=5) == put_ok()
+    state.stop()
+    final = nodes_size()
+    assert final < small_bound, f"{final} bytes retained after overwrite"
+
+
+@lab_test("1", 6, "Long-running workload", points=20, part=3, categories=(RUN_TESTS,))
+def test06_long_running_workload():
+    """ClientServerPart2Test.test06 (30s -> 8s): infinite workloads keep
+    making progress and no client waits >1s."""
+    state = make_run_state(
+        num_clients=2,
+        workload_factory=lambda: different_keys_infinite_workload())
+    state.run(RunSettings().max_time(8))
+    assert_ok(state)
+    for w in state.client_workers().values():
+        mw = w.max_wait(state.stop_time)
+        assert mw is not None and mw[0] < 1.0
+
+
+def _search_state(num_clients=1, workload_factory=None):
+    gen = NodeGenerator(
+        server_supplier=lambda a: SimpleServer(a, KVStore()),
+        client_supplier=lambda a: SimpleClient(a, SERVER),
+        workload_supplier=lambda a: (workload_factory()
+                                     if workload_factory else None))
+    state = SearchState(gen)
+    state.add_server(SERVER)
+    for i in range(1, num_clients + 1):
+        state.add_client_worker(LocalAddress(f"client{i}"))
+    return state
+
+
+@lab_test("1", 8, "Single client; Append, Append, Get", points=20, part=3, categories=(SEARCH_TESTS,))
+def test08_single_client_append_search():
+    """ClientServerPart2Test.test08: goal reachable, then pruned space
+    exhausts safely."""
+    state = _search_state(workload_factory=lambda: kv_workload(
+        ["APPEND:foo:x", "APPEND:foo:y", "GET:foo"],
+        ["x", "xy", "xy"]))
+    settings = SearchSettings().add_invariant(RESULTS_OK)
+    settings.add_goal(CLIENTS_DONE).max_time(30)
+    results = bfs(state, settings)
+    assert results.end_condition == EndCondition.GOAL_FOUND
+
+    settings.clear_goals().add_prune(CLIENTS_DONE)
+    results = bfs(state, settings)
+    assert results.end_condition == EndCondition.SPACE_EXHAUSTED
+
+
+@lab_test("1", 12, "No progress without communication", points=0, part=3, categories=(SEARCH_TESTS,))
+def test07b_no_progress_without_network():
+    """ClientServerPart2Test.test07 phase 3: with the network off, the
+    NONE_DECIDED invariant holds across the whole (exhausted) space."""
+    state = _search_state(workload_factory=lambda: kv_workload(
+        ["PUT:foo:bar", "APPEND:foo:baz", "GET:foo"],
+        ["PutOk", "barbaz", "barbaz"]))
+    settings = SearchSettings().add_invariant(NONE_DECIDED)
+    settings.network_active(False).max_time(15)
+    results = bfs(state, settings)
+    assert results.end_condition == EndCondition.SPACE_EXHAUSTED
+
+
+@lab_test("1", 9, "Multi-client different keys", points=20, part=3, categories=(SEARCH_TESTS,))
+def test09_multi_client_different_key_search():
+    """ClientServerPart2Test.test09 (scaled 3 -> 2 rounds for the Python
+    checker): goal + pruned exhaustion with two clients on own keys."""
+    state = _search_state(
+        num_clients=2,
+        workload_factory=lambda: append_different_key_workload(2))
+    settings = SearchSettings().add_invariant(RESULTS_OK)
+    settings.add_goal(CLIENTS_DONE).max_time(60)
+    results = bfs(state, settings)
+    assert results.end_condition == EndCondition.GOAL_FOUND
+
+    settings.clear_goals().add_prune(CLIENTS_DONE)
+    results = bfs(state, settings)
+    assert results.end_condition == EndCondition.SPACE_EXHAUSTED
+
+
+@lab_test("1", 11, "Infinite workload searches", points=20, part=3, categories=(SEARCH_TESTS,))
+def test11_random_search_infinite_workloads():
+    """ClientServerPart2Test.test11: invariant-only BFS under a time
+    budget, then randomized DFS probes, then again with a second client."""
+    state = _search_state(
+        workload_factory=lambda: different_keys_infinite_workload())
+    settings = SearchSettings().add_invariant(RESULTS_OK)
+    settings.max_time(5)
+    results = bfs(state, settings)
+    assert results.end_condition in (EndCondition.TIME_EXHAUSTED,
+                                     EndCondition.SPACE_EXHAUSTED)
+
+    settings.set_max_depth(1000).max_time(5)
+    results = dfs(state, settings)
+    assert not results.terminal_found()
+
+    state.add_client_worker(LocalAddress("client2"),
+                            different_keys_infinite_workload())
+    results = dfs(state, settings)
+    assert not results.terminal_found()

@@ -14,9 +14,9 @@ from dslabs_tpu.harness import (RUN_TESTS, SEARCH_TESTS, UNRELIABLE_TESTS,
                                 lab_test)
 from dslabs_tpu.core.address import LocalAddress
 from dslabs_tpu.labs.clientserver.kv_workload import (
-    APPENDS_LINEARIZABLE, append_same_key_workload,
-    append_different_key_workload, get, get_result, kv_workload, put,
-    put_get_workload, put_ok, simple_workload)
+    APPENDS_LINEARIZABLE, append, append_same_key_workload,
+    append_different_key_workload, different_keys_infinite_workload, get,
+    get_result, kv_workload, put, put_get_workload, put_ok, simple_workload)
 from dslabs_tpu.labs.clientserver.kvstore import KVStore
 from dslabs_tpu.labs.paxos.paxos import (PaxosClient, PaxosLogSlotStatus,
                                          PaxosServer)
@@ -30,8 +30,8 @@ from dslabs_tpu.search.search import bfs, dfs
 from dslabs_tpu.search.search_state import SearchState
 from dslabs_tpu.search.settings import SearchSettings
 from dslabs_tpu.testing.generator import NodeGenerator
-from dslabs_tpu.testing.predicates import (CLIENTS_DONE, NONE_DECIDED,
-                                           RESULTS_OK)
+from dslabs_tpu.testing.predicates import (ALL_RESULTS_SAME, CLIENTS_DONE,
+                                           NONE_DECIDED, RESULTS_OK)
 
 
 def server(i):
@@ -295,3 +295,448 @@ def test25_random_search():
     settings.add_prune(CLIENTS_DONE)
     results = dfs(state, settings)
     assert results.end_condition == EndCondition.TIME_EXHAUSTED, results
+
+
+@lab_test("3", 1, "Client throws InterruptedException", points=5, categories=(RUN_TESTS,))
+def test01_throws_exception():
+    """PaxosTest.test01ThrowsException: get_result must block (time out)
+    when the run state was never started."""
+    state = make_run_state(3)
+    c = state.add_client(client(1))
+    c.send_command(get("FOO"))
+    with pytest.raises(TimeoutError):
+        c.get_result(timeout=0.5)
+
+
+@lab_test("3", 3, "Progress with no partition", points=5, categories=(RUN_TESTS,))
+def test03_no_partition():
+    """PaxosTest.test03NoPartition: three direct clients, 5 servers."""
+    state = make_run_state(5)
+    c1, c2, c3 = (state.add_client(client(i)) for i in (1, 2, 3))
+    state.start(RunSettings().max_time(30))
+    c1.send_command(put("foo", "bar"))
+    assert c1.get_result(timeout=5) == put_ok()
+    c2.send_command(put("foo", "baz"))
+    assert c2.get_result(timeout=5) == put_ok()
+    c3.send_command(get("foo"))
+    assert c3.get_result(timeout=5) == get_result("baz")
+    state.stop()
+
+
+@lab_test("3", 7, "One server switches partitions", points=10, categories=(RUN_TESTS,))
+def test07_server_switches_partitions():
+    """PaxosTest.test07: a value decided in {1,2,3} must be visible from
+    {3,4,5} after the overlap server switches sides."""
+    state = make_run_state(5)
+    c1 = state.add_client(client(1))
+    c2 = state.add_client(client(2))
+    settings = RunSettings().max_time(30)
+    settings.partition(server(1), server(2), server(3), client(1))
+    state.start(settings)
+    c1.send_command(put("foo", "bar"))
+    assert c1.get_result(timeout=10) == put_ok()
+    state.stop()
+
+    settings.reset_network()
+    settings.partition(server(3), server(4), server(5), client(2))
+    state.start(settings)
+    c2.send_command(get("foo"))
+    assert c2.get_result(timeout=10) == get_result("bar")
+    state.stop()
+
+
+@lab_test("3", 8, "Multiple clients, synchronous put/get", points=10, categories=(RUN_TESTS,))
+def test08_synchronous_clients():
+    """PaxosTest.test08 (scaled 15x20 -> 5x5): all clients issue the same
+    command each round via addCommand; every round's results must agree."""
+    n_iters, n_clients = 5, 5
+    state = make_run_state(3, lambda: kv_workload([]))
+    for i in range(1, n_clients + 1):
+        state.add_client_worker(client(i))
+    state.start(RunSettings().max_time(60))
+    for i in range(n_iters):
+        state.add_command("PUT:foo:%r8")
+        state.wait_for()
+        state.add_command("GET:foo")
+        state.wait_for()
+    state.stop()
+    r = ALL_RESULTS_SAME.check(state)
+    assert r.value, r.error_message()
+    assert_logs_consistent(state)
+
+
+@lab_test("3", 13, "Two sequential clients", points=10, categories=(RUN_TESTS, UNRELIABLE_TESTS,))
+def test13_simple_put_get_unreliable():
+    state = make_run_state(3)
+    c1 = state.add_client(client(1))
+    c2 = state.add_client(client(2))
+    settings = RunSettings().max_time(30)
+    settings.network_deliver_rate(0.8)
+    state.start(settings)
+    c1.send_command(put("foo", "bar"))
+    assert c1.get_result(timeout=15) == put_ok()
+    c2.send_command(get("foo"))
+    assert c2.get_result(timeout=15) == get_result("bar")
+    state.stop()
+
+
+@lab_test("3", 14, "Multiple clients, synchronous put/get", points=15, categories=(RUN_TESTS, UNRELIABLE_TESTS,))
+def test14_synchronous_clients_unreliable():
+    """PaxosTest.test14: test08 at deliver rate 0.8 (scaled)."""
+    n_iters, n_clients = 3, 4
+    state = make_run_state(3, lambda: kv_workload([]))
+    for i in range(1, n_clients + 1):
+        state.add_client_worker(client(i))
+    settings = RunSettings().max_time(90)
+    settings.network_deliver_rate(0.8)
+    state.start(settings)
+    for i in range(n_iters):
+        state.add_command("PUT:foo:%r8")
+        state.wait_for()
+        state.add_command("GET:foo")
+        state.wait_for()
+    state.stop()
+    r = ALL_RESULTS_SAME.check(state)
+    assert r.value, r.error_message()
+    assert_logs_consistent(state)
+
+
+@lab_test("3", 15, "Multiple clients, concurrent appends", points=15, categories=(RUN_TESTS, UNRELIABLE_TESTS,))
+def test15_concurrent_appends_unreliable():
+    """PaxosTest.test15 (scaled 25x5 -> 8x3): same-key appends at 0.8 stay
+    linearizable."""
+    state = make_run_state(3, lambda: append_same_key_workload(3))
+    for i in range(1, 9):
+        state.add_client_worker(client(i))
+    settings = RunSettings().max_time(60)
+    settings.network_deliver_rate(0.8)
+    state.run(settings)
+    assert_ok(state)
+    r = APPENDS_LINEARIZABLE.check(state)
+    assert r.value, r.error_message()
+    assert_logs_consistent(state)
+
+
+def _repartition_loop(state, settings, stop, n_servers, n_clients,
+                      period=1.0):
+    import random as _random
+
+    addrs = [server(i) for i in range(1, n_servers + 1)]
+    clients = [client(i) for i in range(1, n_clients + 1)]
+    while not stop.is_set():
+        for _ in range(2):
+            _random.shuffle(addrs)
+            majority = addrs[:n_servers // 2 + 1]
+            settings.reconnect().partition(*(clients + majority))
+            if stop.wait(period):
+                return
+        settings.reconnect()
+        if stop.wait(period):
+            return
+
+
+@lab_test("3", 16, "Multiple clients, single partition and heal", points=15, categories=(RUN_TESTS,))
+def test16_single_partition():
+    """PaxosTest.test16: infinite workloads keep running through one
+    partition-and-heal cycle; max wait stays under 3s."""
+    n_clients = 3
+    state = make_run_state(5, different_keys_infinite_workload)
+    for i in range(1, n_clients + 1):
+        state.add_client_worker(client(i))
+    settings = RunSettings().max_time(60)
+    state.start(settings)
+    time.sleep(3)
+    settings.partition(server(1), server(2), server(3),
+                       *(client(i) for i in range(1, n_clients + 1)))
+    time.sleep(2)
+    settings.reconnect()
+    time.sleep(3)
+    state.stop()
+    assert_ok(state)
+    assert_logs_consistent(state, all_slots=False)
+    for w in state.client_workers().values():
+        mw = w.max_wait(state.stop_time)
+        assert mw is not None and mw[0] < 3.0, f"max wait {mw}"
+
+
+def _constant_repartition(deliver_rate=None, length_secs=10):
+    import threading
+
+    n_clients, n_servers = 3, 5
+    state = make_run_state(
+        n_servers, lambda: different_keys_infinite_workload(10))
+    for i in range(1, n_clients + 1):
+        state.add_client_worker(client(i))
+    settings = RunSettings().max_time(length_secs + 30)
+    if deliver_rate is not None:
+        settings.network_deliver_rate(deliver_rate)
+    stop = threading.Event()
+    th = threading.Thread(target=_repartition_loop,
+                          args=(state, settings, stop, n_servers, n_clients),
+                          daemon=True)
+    th.start()
+    state.start(settings)
+    time.sleep(length_secs)
+    stop.set()
+    th.join(5)
+    state.stop()
+    assert_ok(state)
+    assert_logs_consistent(state, all_slots=False)
+    for w in state.client_workers().values():
+        mw = w.max_wait(state.stop_time)
+        assert mw is not None and mw[0] < 2.5, f"max wait {mw}"
+    return state
+
+
+@lab_test("3", 17, "Constant repartitioning, check maximum wait time", points=20, categories=(RUN_TESTS,))
+def test17_constant_repartition():
+    """PaxosTest.test17 (30s -> 10s): live repartition thread grabbing a
+    fresh majority every period; waits stay bounded."""
+    _constant_repartition()
+
+
+@lab_test("3", 18, "Constant repartitioning, check maximum wait time", points=30, categories=(RUN_TESTS, UNRELIABLE_TESTS,))
+def test18_constant_repartition_unreliable():
+    """PaxosTest.test18: test17 at deliver rate 0.8."""
+    _constant_repartition(deliver_rate=0.8)
+
+
+@lab_test("3", 19, "Constant repartitioning, full throughput", points=30, categories=(RUN_TESTS, UNRELIABLE_TESTS,))
+def test19_repartition_full_throughput():
+    """PaxosTest.test19 (scaled): after a repartition storm, a FRESH batch
+    of clients replacing the old ones must still complete (no deadlock)."""
+    state = _constant_repartition(deliver_rate=0.8, length_secs=8)
+    n_clients, n_rounds = 3, 4
+    for i in range(1, n_clients + 1):
+        state.remove_node(client(i))
+    for i in range(1, n_clients + 1):
+        state.add_client_worker(client(i + n_clients),
+                                append_different_key_workload(n_rounds))
+    settings = RunSettings().max_time(60)
+    state.run(settings)
+    assert_ok(state)
+
+
+@lab_test("3", 22, "Two clients, sequential appends visible", points=30, categories=(SEARCH_TESTS,))
+def test22_two_clients_search():
+    """PaxosTest.test22: append X decided in partition {1,2}; append Y must
+    then be able to complete (result XY) in BOTH other majorities."""
+    state = make_search_state(3, lambda: None)
+    state.add_client_worker(client(1), kv_workload(["APPEND:foo:X"], ["X"]))
+    state.add_client_worker(client(2), kv_workload(["APPEND:foo:Y"], ["XY"]))
+
+    settings = SearchSettings().max_time(60)
+    settings.add_invariant(RESULTS_OK).add_invariant(LOGS_CONSISTENT_ALL_SLOTS)
+    settings.add_goal(NONE_DECIDED.negate())
+    settings.partition(server(1), server(2), client(1))
+    results = bfs(state, settings)
+    assert results.end_condition == EndCondition.GOAL_FOUND, results
+    first_append = results.goal_matching_state
+
+    for other, spectator in (((server(1), server(3)), server(2)),
+                             ((server(2), server(3)), server(1))):
+        s2 = SearchSettings().max_time(180)
+        s2.add_invariant(RESULTS_OK).add_invariant(LOGS_CONSISTENT_ALL_SLOTS)
+        s2.add_goal(CLIENTS_DONE)
+        s2.partition(*other, client(2))
+        # Retry/election timers of nodes outside the partition explode the
+        # Python checker's branching without adding behaviours; gate them
+        # (the reference narrows with deliverTimers the same way,
+        # PaxosTest.java:1028-1031).
+        s2.deliver_timers(client(1), False).deliver_timers(client(2), False)
+        s2.deliver_timers(spectator, False)
+        results = bfs(first_append, s2)
+        assert results.end_condition == EndCondition.GOAL_FOUND, results
+
+    # Linearizability in the narrowed subspaces, timers frozen (the
+    # reference's final phases, PaxosTest.java:973-985).
+    for other in ((server(1), server(3)), (server(2), server(3))):
+        s3 = SearchSettings().max_time(20)
+        s3.set_max_depth(first_append.depth + 4)
+        s3.add_invariant(RESULTS_OK).add_invariant(LOGS_CONSISTENT_ALL_SLOTS)
+        s3.add_prune(CLIENTS_DONE)
+        s3.partition(*other, client(2))
+        s3.deliver_timers(False)
+        results = bfs(first_append, s3)
+        assert results.end_condition in (EndCondition.SPACE_EXHAUSTED,
+                                         EndCondition.TIME_EXHAUSTED), results
+
+
+@lab_test("3", 23, "Two clients, five servers, multiple leader changes", points=20, categories=(SEARCH_TESTS,))
+def test23_quorum_checking_search():
+    """PaxosTest.test23QuorumCheckingSearch: surgical staged narrowing —
+    two commands forced onto disjoint quorums through multiple leader
+    changes; slot 1 must stay valid throughout and c1 must win."""
+    from dslabs_tpu.labs.paxos.paxos import PaxosLogSlotStatus as S
+    from dslabs_tpu.labs.paxos.predicates import has_command, has_status
+
+    state = make_search_state(5, lambda: None)
+    c1 = append("foo", "X")
+    c2 = append("foo", "Y")
+    state.add_client_worker(client(1), kv_workload(["APPEND:foo:X"]))
+    state.add_client_worker(client(2), kv_workload(["APPEND:foo:Y"]))
+
+    def base_settings():
+        s = SearchSettings().max_time(60)
+        s.add_invariant(slot_valid(1))
+        for i in range(1, 6):
+            s.add_prune(has_status(server(i), 2, S.EMPTY).negate())
+            s.add_prune(has_status(server(i), 1, S.CLEARED))
+        s.add_prune(has_status(server(1), 1, S.EMPTY).negate())
+        s.add_prune(has_status(server(2), 1, S.EMPTY).negate())
+        s.node_active(client(1), False)
+        s.link_active(client(1), server(4), True)
+        s.node_active(client(2), False)
+        s.link_active(client(2), server(5), True)
+        s.add_prune(has_command(server(4), 1, c2))
+        s.add_prune(has_command(server(5), 1, c1))
+        return s
+
+    # c1's command to server 4, then on to server 3 (quorum {2,3,4}).
+    s = base_settings()
+    s.node_active(server(1), False).node_active(server(5), False)
+    s.deliver_timers(server(1), False).deliver_timers(server(5), False)
+    s.deliver_timers(client(2), False)
+    s.add_goal(has_command(server(4), 1, c1))
+    results = bfs(state, s)
+    assert results.end_condition == EndCondition.GOAL_FOUND, results
+    c1_at_s4 = results.goal_matching_state
+
+    s.clear_goals().add_goal(has_command(server(3), 1, c1))
+    results = bfs(c1_at_s4, s)
+    assert results.end_condition == EndCondition.GOAL_FOUND, results
+    c1_at_s3 = results.goal_matching_state
+
+    # c2's command via quorum {1,2,3,5} (servers 3 & 4 asleep first).
+    s = base_settings()
+    s.node_active(server(4), False).node_active(server(3), False)
+    s.clear_deliver_timers()
+    s.deliver_timers(server(4), False).deliver_timers(server(3), False)
+    s.deliver_timers(client(1), False)
+    s.add_goal(has_command(server(5), 1, c2))
+    results = bfs(c1_at_s3, s)
+    assert results.end_condition == EndCondition.GOAL_FOUND, results
+    c2_at_s5 = results.goal_matching_state
+
+    s.node_active(server(3), True).deliver_timers(server(3), True)
+    s.clear_goals().add_goal(has_command(server(3), 1, c2))
+    results = bfs(c2_at_s5, s)
+    assert results.end_condition == EndCondition.GOAL_FOUND, results
+    c2_at_s3 = results.goal_matching_state
+
+    # Clear the narrowing; drop all pending messages; force c1 back onto
+    # server 1 and make sure it can still be CHOSEN (the overwrite path).
+    c2_at_s3.drop_pending_messages()
+    s = SearchSettings().max_time(60)
+    s.add_invariant(slot_valid(1))
+    for i in range(1, 6):
+        s.add_prune(has_status(server(i), 1, S.CLEARED))
+    s.add_prune(has_command(server(4), 1, c2))
+    s.add_prune(has_command(server(2), 1, c2))
+    s.add_prune(has_command(server(1), 1, c2))
+    s.node_active(server(5), False).node_active(server(3), False)
+    s.node_active(client(2), False)
+    s.link_active(server(1), server(2), False)
+    s.link_active(server(2), server(1), False)
+    s.deliver_timers(server(5), False).deliver_timers(server(3), False)
+    s.deliver_timers(client(2), False)
+    # c1 is already in s4's log, so the idle client's retries are noise
+    # (s1/s2 elections stay enabled — they are what dethrone s4's stale
+    # leadership so it can re-elect and re-propose c1).
+    s.deliver_timers(client(1), False)
+    s.max_time(240)
+    s.add_goal(has_command(server(1), 1, c1))
+    results = bfs(c2_at_s3, s)
+    assert results.end_condition == EndCondition.GOAL_FOUND, results
+    c1_at_s1 = results.goal_matching_state
+
+    s.clear_goals().add_goal(has_status(server(4), 1, S.CHOSEN))
+    results = bfs(c1_at_s1, s)
+    assert results.end_condition == EndCondition.GOAL_FOUND, results
+
+    # Re-admit server 3's dropped messages and keep the space clean.
+    c1_at_s1.undrop_messages_from(server(3))
+    s.clear_goals()
+    s.link_active(server(3), server(4), True)
+    s.set_max_depth(c1_at_s1.depth + 4)
+    results = bfs(c1_at_s1, s)
+    assert results.end_condition in (EndCondition.SPACE_EXHAUSTED,
+                                     EndCondition.TIME_EXHAUSTED), results
+
+
+@lab_test("3", 24, "Handling of logs with holes", points=0, categories=(SEARCH_TESTS,))
+def test24_logs_with_holes_search():
+    """PaxosTest.test24: find a state where slot 2 is chosen while slot 1
+    is not, drop pending messages, and verify the space stays clean."""
+    from dslabs_tpu.labs.paxos.paxos import PaxosLogSlotStatus as S
+    from dslabs_tpu.labs.paxos.predicates import has_status
+
+    state = make_search_state(3, lambda: None)
+    state.add_client_worker(client(1), kv_workload(
+        ["APPEND:foo:x", "APPEND:foo:z"]))
+    state.add_client_worker(client(2), kv_workload(
+        ["APPEND:foo:y", "APPEND:foo:w"]))
+
+    settings = SearchSettings().max_time(30)
+    settings.add_invariant(APPENDS_LINEARIZABLE)
+    settings.add_invariant(LOGS_CONSISTENT_ALL_SLOTS)
+    settings.add_prune(CLIENTS_DONE)
+    for i in range(1, 4):
+        settings.add_goal(
+            has_status(server(i), 2, S.CHOSEN).and_(
+                has_status(server(i), 1, S.ACCEPTED).or_(
+                    has_status(server(i), 1, S.EMPTY))))
+    results = bfs(state, settings)
+
+    # Not all correct implementations reach such states (the reference
+    # returns silently too, PaxosTest.java:1125-1127).
+    if results.end_condition != EndCondition.GOAL_FOUND:
+        return
+    hole = results.goal_matching_state
+    hole.drop_pending_messages()
+
+    settings.clear_goals().max_time(20)
+    settings.set_max_depth(hole.depth + 4)
+    results = bfs(hole, settings)
+    assert results.end_condition in (EndCondition.SPACE_EXHAUSTED,
+                                     EndCondition.TIME_EXHAUSTED), results
+
+
+@lab_test("3", 26, "Five server random search", points=20, categories=(SEARCH_TESTS,))
+def test26_five_server_random_search():
+    """PaxosTest.test26: randomized DFS probes over five servers."""
+    state = make_search_state(5, lambda: None)
+    state.add_client_worker(client(1), kv_workload(["APPEND:foo:x"]))
+    state.add_client_worker(client(2), kv_workload(["APPEND:foo:y"]))
+
+    settings = SearchSettings()
+    settings.set_max_depth(1000).max_time(8)
+    settings.add_invariant(APPENDS_LINEARIZABLE).add_invariant(LOGS_CONSISTENT)
+    settings.add_prune(CLIENTS_DONE)
+    results = dfs(state, settings)
+    assert not results.terminal_found()
+
+
+@lab_test("3", 27, "Paxos runs in singleton group", points=0, categories=(RUN_TESTS, SEARCH_TESTS,))
+def test27_singleton_paxos():
+    """PaxosTest.test27: a single-server Paxos group both runs and
+    searches correctly (the degenerate quorum of one)."""
+    state = make_run_state(1, lambda: append_different_key_workload(3))
+    state.add_client_worker(client(1))
+    state.run(RunSettings().max_time(20))
+    assert_ok(state)
+    assert_logs_consistent(state)
+
+    sstate = make_search_state(1)
+    sstate.add_client_worker(client(1), kv_workload(["PUT:foo:bar", "GET:foo"],
+                                                    ["PutOk", "bar"]))
+    settings = SearchSettings().max_time(30)
+    settings.add_invariant(RESULTS_OK).add_invariant(LOGS_CONSISTENT_ALL_SLOTS)
+    settings.add_goal(CLIENTS_DONE)
+    results = bfs(sstate, settings)
+    assert results.end_condition == EndCondition.GOAL_FOUND, results
+
+    settings.clear_goals().add_prune(CLIENTS_DONE)
+    results = bfs(sstate, settings)
+    assert results.end_condition in (EndCondition.SPACE_EXHAUSTED,
+                                     EndCondition.TIME_EXHAUSTED), results

@@ -81,3 +81,55 @@ def test_human_readable_trace_reaches_same_verdict():
     # Events are causally ordered: every message delivery happens after its
     # send (checked implicitly by successful replay inside the reordering).
     assert len(hr) <= len(end.trace())
+
+
+def test_saved_traces_directory_sweep(tmp_path):
+    """CheckSavedTracesTest analog (CheckSavedTracesTest.java:44-108): every
+    trace in a directory is re-checked as its own case, stale files are
+    skipped with a warning rather than failing the sweep."""
+    end = violating_state()
+    save_trace(end, [NONE_DECIDED], "0", None, "PingTest", "t1",
+               directory=str(tmp_path))
+    save_trace(end, [NONE_DECIDED], "0", 1, "PingTest", "t2",
+               directory=str(tmp_path))
+    # A stale/corrupt trace file must be skipped, not crash the sweep.
+    (tmp_path / "lab9_corrupt.trace").write_bytes(b"not a pickle")
+
+    traces = SerializableTrace.traces(str(tmp_path))
+    assert len(traces) == 2
+    for t in traces:
+        settings = SearchSettings()
+        for inv in t.invariants:
+            settings.add_invariant(inv)
+        results = replay_trace(t.initial_state(), t.history, settings)
+        assert results.end_condition == EndCondition.INVARIANT_VIOLATED
+
+
+def test_clone_conformance_checks_route_to_check_logger():
+    """Cloning.java:130-138 analog: under do_error_checks every clone is
+    verified equal + hash-consistent; violations land in the CheckLogger."""
+    from dslabs_tpu.utils.check_logger import CheckLogger
+    from dslabs_tpu.utils.flags import GlobalSettings
+    from dslabs_tpu.utils.structural import clone
+
+    class IdentityEq:
+        """Broken: equality by identity, so a clone is never equal."""
+
+        def __eq__(self, other):
+            return self is other
+
+        def __hash__(self):
+            return id(self)
+
+    CheckLogger.clear()
+    saved = GlobalSettings.error_checks_temporarily_enabled
+    GlobalSettings.error_checks_temporarily_enabled = True
+    try:
+        good = clone({"k": [1, 2, 3]})
+        assert good == {"k": [1, 2, 3]}
+        clone(IdentityEq())
+        kinds = {k for (k, _loc) in CheckLogger.findings}
+        assert "CLONE_NOT_EQUAL" in kinds
+    finally:
+        GlobalSettings.error_checks_temporarily_enabled = saved
+        CheckLogger.clear()
