@@ -77,6 +77,10 @@ def _parse_args(argv):
                    help="re-check all saved traces in traces/")
     p.add_argument("--visualize-trace", metavar="TRACE",
                    help="open a saved trace in the trace viewer")
+    p.add_argument("--debugger", nargs="*", metavar="ARG",
+                   help="render a lab's initial system in the viewer: "
+                        "--debugger <numServers> <numClients> <workload> "
+                        "(with --lab); VizConfig analog")
     return p.parse_args(argv)
 
 
@@ -131,6 +135,29 @@ def _replay_traces() -> int:
     return 1 if failures else 0
 
 
+def _debugger(lab, dbg_args) -> int:
+    """VizClient.main analog (VizClient.java:39-102): build a lab's
+    initial state from CLI args and render it in the viewer."""
+    from dslabs_tpu.viz import viz_configs
+    from dslabs_tpu.viz.server import state_dump
+
+    configs = viz_configs()
+    if lab is None or str(lab) not in configs:
+        print(f"No viz config for lab {lab!r}; available: "
+              f"{sorted(configs)}")
+        return 1
+    state = configs[str(lab)](list(dbg_args))
+    import json as _json
+
+    out = f"debugger-lab{lab}.json"
+    with open(out, "w") as f:
+        _json.dump(state_dump(state), f, indent=2)
+    print(f"Initial lab {lab} system state written to {out} "
+          f"({len(list(state.addresses()))} nodes); save a trace with -s "
+          "and open it with --visualize-trace for stepping")
+    return 0
+
+
 def _visualize_trace(path: str) -> int:
     try:
         from dslabs_tpu.viz.server import serve_trace
@@ -148,6 +175,8 @@ def main(argv=None) -> int:
         return _replay_traces()
     if args.visualize_trace:
         return _visualize_trace(args.visualize_trace)
+    if args.debugger is not None:
+        return _debugger(args.lab, args.debugger)
 
     from dslabs_tpu.harness import registry, run_tests, select_tests
 

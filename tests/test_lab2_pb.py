@@ -15,13 +15,15 @@ from dslabs_tpu.labs.clientserver.kv_workload import (
     append_same_key_workload, kv_workload, put_get_workload, simple_workload)
 from dslabs_tpu.labs.clientserver.kvstore import KVStore
 from dslabs_tpu.labs.primarybackup.pb import PBClient, PBServer
+from dslabs_tpu.labs.primarybackup.pb import PING_MILLIS
 from dslabs_tpu.labs.primarybackup.viewserver import (PING_CHECK_MILLIS,
                                                       ViewServer)
-from dslabs_tpu.labs.clientserver.kv_workload import get, put, get_result, put_ok
+from dslabs_tpu.labs.clientserver.kv_workload import (
+    different_keys_infinite_workload, get, put, get_result, put_ok)
 from dslabs_tpu.runner.run_settings import RunSettings
 from dslabs_tpu.runner.run_state import RunState
 from dslabs_tpu.search.results import EndCondition
-from dslabs_tpu.search.search import bfs
+from dslabs_tpu.search.search import bfs, dfs
 from dslabs_tpu.search.search_state import SearchState
 from dslabs_tpu.search.settings import SearchSettings
 from dslabs_tpu.testing.generator import NodeGenerator
@@ -255,3 +257,393 @@ def test18_two_client_appends_linearizable_search():
     stage2.deliver_timers(server(1), False).deliver_timers(server(2), False)
     results = bfs(synced_state, stage2)
     assert results.end_condition == EndCondition.GOAL_FOUND, results
+
+
+# ------------------------------------------------ additional reference ports
+
+def current_view(state):
+    return state.servers[VSA].view
+
+
+def wait_for_view(state, primary, backup, ticks=8):
+    """waitForView (PrimaryBackupTest.java:233-247): poll until the
+    expected (primary, backup) view is active."""
+    for _ in range(ticks):
+        v = current_view(state)
+        if v.primary == primary and v.backup == backup:
+            return v
+        time.sleep(PING_CHECK_MILLIS / 1000)
+    v = current_view(state)
+    assert v.primary == primary and v.backup == backup, \
+        f"expected ({primary},{backup}), got {v}"
+    return v
+
+
+def setup_run_view(state, settings, primary, backup):
+    """setupRunView (PrimaryBackupTest.java:249-264)."""
+    state.start(settings)
+    state.add_server(primary)
+    wait_for_view(state, primary, None)
+    if backup is not None:
+        state.add_server(backup)
+        wait_for_view(state, primary, backup)
+        time.sleep(PING_CHECK_MILLIS * 4 / 1000)  # let the backup sync
+    state.stop()
+
+
+@lab_test("2", 1, "Client throws InterruptedException", points=5, part=2, categories=(RUN_TESTS,))
+def test01_throws_exception():
+    state = make_run_state()
+    c = state.add_client(client(1))
+    c.send_command(get("foo"))
+    with pytest.raises(TimeoutError):
+        c.get_result(timeout=0.5)
+
+
+@lab_test("2", 3, "Primary chosen", points=5, part=2, categories=(RUN_TESTS,))
+def test03_primary_chosen():
+    state = make_run_state()
+    settings = RunSettings().max_time(10)
+    setup_run_view(state, settings, server(1), None)
+
+
+@lab_test("2", 5, "Count number of ViewServer requests", points=10, part=2, categories=(RUN_TESTS,))
+def test05_max_viewserver_pings_count():
+    """test05MaxViewServerPingsCount (scaled 500 -> 60 rounds): servers may
+    not spam the ViewServer beyond the ping-interval budget."""
+    state = make_run_state()
+    settings = RunSettings().max_time(60)
+    state.add_server(server(1))
+    state.add_server(server(2))
+    c = state.add_client(client(1))
+    state.start(settings)
+
+    t1 = time.time()
+    for i in range(60):
+        c.send_command(put(f"xk{i}", str(i)))
+        assert c.get_result(timeout=5) == put_ok()
+        c.send_command(get(f"xk{i}"))
+        assert c.get_result(timeout=5) == get_result(str(i))
+        time.sleep(PING_MILLIS / 10 / 1000)
+    elapsed_ms = (time.time() - t1) * 1000
+    state.stop()
+
+    received = state.network.num_messages_received(VSA)
+    # numNodes x 2 pings per PING_MILLIS (PrimaryBackupTest.java:341)
+    allowed = elapsed_ms / PING_MILLIS * (len(state.servers)
+                                          + len(state.clients)) * 2
+    assert received <= allowed, \
+        f"Too many ViewServer messages: {received} (allowed {allowed:.0f})"
+
+
+@lab_test("2", 9, "Fail to new backup", points=10, part=2, categories=(RUN_TESTS,))
+def test09_fail_put():
+    """test09FailPut: acknowledged writes survive a backup death, a
+    promotion to a fresh backup, and then a primary death."""
+    state = make_run_state()
+    settings = RunSettings().max_time(30)
+    setup_run_view(state, settings, server(1), server(2))
+    state.add_server(server(3))
+    c = state.add_client(client(1))
+    state.start(settings)
+
+    for k, v in (("a", "aa"), ("b", "bb"), ("c", "cc")):
+        c.send_command(put(k, v))
+        assert c.get_result(timeout=5) == put_ok()
+        c.send_command(get(k))
+        assert c.get_result(timeout=5) == get_result(v)
+
+    state.remove_node(server(2))
+    c.send_command(put("a", "aaa"))
+    assert c.get_result(timeout=5) == put_ok()
+    c.send_command(get("a"))
+    assert c.get_result(timeout=5) == get_result("aaa")
+    wait_for_view(state, server(1), server(3))
+    time.sleep(PING_CHECK_MILLIS * 4 / 1000)
+    c.send_command(get("a"))
+    assert c.get_result(timeout=5) == get_result("aaa")
+
+    state.remove_node(server(1))
+    c.send_command(put("b", "bbb"))
+    assert c.get_result(timeout=10) == put_ok()
+    wait_for_view(state, server(3), None)
+    for k, v in (("a", "aaa"), ("b", "bbb"), ("c", "cc")):
+        c.send_command(get(k))
+        assert c.get_result(timeout=5) == get_result(v)
+    state.stop()
+
+
+def _concurrent_fail_to_backup(workload_factory, read_cmds, deliver_rate=None):
+    """Shared body of test10/test11 (PrimaryBackupTest.java:455-563): run
+    concurrent writers, heal, read from the primary, kill it, read from the
+    promoted backup — both reads must agree (ALL_RESULTS_SAME)."""
+    state = make_run_state(workload_factory)
+    settings = RunSettings().max_time(60)
+    if deliver_rate is not None:
+        settings.network_deliver_rate(deliver_rate)
+    setup_run_view(state, settings, server(1), server(2))
+    for i in range(1, 4):
+        state.add_client_worker(client(i))
+    state.run(settings)
+
+    for a in list(state.client_workers()):
+        state.remove_node(a)
+
+    # Heal fully, then read the keys from the primary.
+    settings.reset_network()
+    state.start(settings)
+    time.sleep(PING_CHECK_MILLIS * 4 / 1000)
+    state.stop()
+
+    state.add_client_worker(LocalAddress("client-readprimary"),
+                            kv_workload(read_cmds))
+    state.run(settings)
+
+    state.remove_node(server(1))
+    state.start(settings)
+    wait_for_view(state, server(2), None)
+    state.stop()
+
+    state.add_client_worker(LocalAddress("client-readbackup"),
+                            kv_workload(read_cmds))
+    state.run(settings)
+    r = ALL_RESULTS_SAME.check(state)
+    assert r.value, r.error_message()
+
+
+@lab_test("2", 10, "Concurrent puts, same keys, fail to backup", points=15, part=2, categories=(RUN_TESTS,))
+def test10_concurrent_put():
+    import random as _random
+
+    rng = _random.Random(7)
+
+    def puts():
+        return kv_workload([f"PUT:k{rng.randrange(2)}:{rng.randrange(1000)}"
+                            for _ in range(30)])
+
+    _concurrent_fail_to_backup(puts, ["GET:k0", "GET:k1"])
+
+
+@lab_test("2", 21, "Concurrent appends failover read-back (extended)", points=0, part=2, categories=(RUN_TESTS,))
+def test11b_concurrent_append_fail_to_backup():
+    _concurrent_fail_to_backup(lambda: append_same_key_workload(20),
+                               ["GET:the-key"])
+
+
+@lab_test("2", 12, "Concurrent puts, same keys, fail to backup", points=20, part=2, categories=(RUN_TESTS, UNRELIABLE_TESTS,))
+def test12_concurrent_put_unreliable():
+    import random as _random
+
+    rng = _random.Random(11)
+
+    def puts():
+        return kv_workload([f"PUT:k{rng.randrange(2)}:{rng.randrange(1000)}"
+                            for _ in range(15)])
+
+    _concurrent_fail_to_backup(puts, ["GET:k0", "GET:k1"], deliver_rate=0.8)
+
+
+@lab_test("2", 13, "Concurrent appends, same key, fail to backup", points=20, part=2, categories=(RUN_TESTS, UNRELIABLE_TESTS,))
+def test13_concurrent_append_unreliable():
+    _concurrent_fail_to_backup(lambda: append_same_key_workload(10),
+                               ["GET:the-key"], deliver_rate=0.8)
+
+
+def _repeated_crashes(deliver_rate=None, length_secs=10):
+    """test14/test15 (PrimaryBackupTest.java:565-635, scaled 30s -> 10s):
+    randomly crash a server and add a fresh one while infinite-workload
+    clients keep running."""
+    import random as _random
+    import threading
+
+    state = make_run_state(lambda: different_keys_infinite_workload(10))
+    settings = RunSettings().max_time(length_secs + 30)
+    if deliver_rate is not None:
+        settings.network_deliver_rate(deliver_rate)
+        settings.node_unreliable(VSA, False)
+    servers = [server(i) for i in range(1, 4)]
+    for a in servers:
+        state.add_server(a)
+    state.start(settings)
+    stop = threading.Event()
+    total = [3]
+
+    def crasher():
+        rng = _random.Random(5)
+        stop.wait(PING_CHECK_MILLIS * 10 / 1000)
+        while not stop.is_set():
+            to_kill = servers[rng.randrange(len(servers))]
+            total[0] += 1
+            to_add = server(total[0])
+            servers.append(to_add)
+            state.add_server(to_add)
+            servers.remove(to_kill)
+            state.remove_node(to_kill)
+            if stop.wait(PING_CHECK_MILLIS * 10 / 1000):
+                return
+
+    th = threading.Thread(target=crasher, daemon=True)
+    th.start()
+    for i in range(1, 4):
+        state.add_client_worker(client(i))
+    time.sleep(length_secs)
+    stop.set()
+    th.join(5)
+    state.stop()
+    assert_ok(state)
+    for w in state.client_workers().values():
+        mw = w.max_wait(state.stop_time)
+        assert mw is not None and mw[0] < 5.0, f"max wait {mw}"
+
+
+@lab_test("2", 14, "Repeated crashes", points=15, part=2, categories=(RUN_TESTS,))
+def test14_repeated_crashes():
+    _repeated_crashes()
+
+
+@lab_test("2", 15, "Repeated crashes", points=20, part=2, categories=(RUN_TESTS, UNRELIABLE_TESTS,))
+def test15_repeated_crashes_unreliable():
+    _repeated_crashes(deliver_rate=0.8)
+
+
+@lab_test("2", 17, "Single client, multi-server", points=15, part=2, categories=(SEARCH_TESTS,))
+def test17_single_client_multi_server_search():
+    """test17SingleClientMultiServerSearch: from the synced two-server
+    view, the client can finish, and the done-pruned subspace stays clean
+    (third server gated off, as the reference does)."""
+    workload = kv_workload(["PUT:foo:bar", "GET:foo"], ["PutOk", "bar"])
+    state = make_search_state(workload)
+    for i in (1, 2, 3):
+        state.add_server(server(i))
+    state.add_client_worker(client(1))
+
+    def view2_synced(s):
+        s1, s2 = s.node(server(1)), s.node(server(2))
+        return (s1.view is not None and s1.view.view_num == 2
+                and s1.view.primary == server(1)
+                and s1.view.backup == server(2)
+                and s1.synced and s2.view is not None
+                and s2.view.view_num == 2 and s2.synced)
+
+    from dslabs_tpu.testing.predicates import StatePredicate
+
+    init_settings = SearchSettings().max_time(60)
+    init_settings.node_active(client(1), False)
+    init_settings.node_active(server(3), False)
+    init_settings.deliver_timers(client(1), False)
+    init_settings.deliver_timers(server(3), False)
+    init_settings.add_goal(StatePredicate("view 2 synced", view2_synced))
+    results = bfs(state, init_settings)
+    assert results.end_condition == EndCondition.GOAL_FOUND, results
+    view_ready = results.goal_matching_state
+
+    settings = SearchSettings().max_time(120)
+    settings.add_invariant(RESULTS_OK).add_goal(CLIENTS_DONE)
+    settings.node_active(server(3), False)
+    settings.deliver_timers(server(3), False)
+    # Freeze the ping machinery so the search explores the replication
+    # protocol, not the view-change interleavings (the reference prunes
+    # later views the same way, PrimaryBackupTest.java:688-696).
+    settings.deliver_timers(VSA, False)
+    settings.deliver_timers(server(1), False)
+    settings.deliver_timers(server(2), False)
+    results = bfs(view_ready, settings)
+    assert results.end_condition == EndCondition.GOAL_FOUND, results
+
+    settings.clear_goals().add_prune(CLIENTS_DONE)
+    settings.set_max_depth(view_ready.depth + 6)
+    results = bfs(view_ready, settings)
+    assert results.end_condition in (EndCondition.SPACE_EXHAUSTED,
+                                     EndCondition.TIME_EXHAUSTED), results
+
+
+@lab_test("2", 19, "Multi-client, multi-server; multiple failures to backup", points=20, part=2, categories=(SEARCH_TESTS,))
+def test19_multiple_failures_search():
+    """test19MultipleFailuresSearch (simplified): from the synced view, an
+    acknowledged write must remain visible after the primary fails and the
+    backup serves alone — searched over the narrowed failover space."""
+    from dslabs_tpu.testing.predicates import StatePredicate
+
+    workload = kv_workload(["PUT:foo:bar", "GET:foo"], ["PutOk", "bar"])
+    state = make_search_state(workload)
+    state.add_server(server(1))
+    state.add_server(server(2))
+    state.add_client_worker(client(1))
+
+    def view2_synced(s):
+        s1, s2 = s.node(server(1)), s.node(server(2))
+        return (s1.view is not None and s1.view.view_num == 2
+                and s1.view.primary == server(1)
+                and s1.view.backup == server(2)
+                and s1.synced and s2.view is not None
+                and s2.view.view_num == 2 and s2.synced
+                # the ViewServer must have the view ACKED, or it can
+                # never change views again (viewserver.py:125-126)
+                and s.node(VSA).acked)
+
+    init_settings = SearchSettings().max_time(60)
+    init_settings.node_active(client(1), False)
+    init_settings.deliver_timers(client(1), False)
+    init_settings.add_goal(StatePredicate("view 2 synced", view2_synced))
+    results = bfs(state, init_settings)
+    assert results.end_condition == EndCondition.GOAL_FOUND, results
+    view_ready = results.goal_matching_state
+
+    # Find a state where the first write is acknowledged.
+    def put_acked(s):
+        w = s.client_workers()[client(1)]
+        return len(w.results) >= 1
+
+    s2 = SearchSettings().max_time(120)
+    s2.add_invariant(RESULTS_OK)
+    s2.deliver_timers(VSA, False)
+    s2.deliver_timers(server(1), False).deliver_timers(server(2), False)
+    s2.add_goal(StatePredicate("first write acked", put_acked))
+    results = bfs(view_ready, s2)
+    assert results.end_condition == EndCondition.GOAL_FOUND, results
+    acked = results.goal_matching_state
+
+    # Primary partitioned away.  Stage the failover the way the
+    # reference's initView does (PrimaryBackupTest.java:124-187): first
+    # reach the promoted view with the client gated off, then let the
+    # client finish with the ping machinery frozen.
+    acked.drop_pending_messages()
+
+    def promoted(s):
+        s2n = s.node(server(2))
+        return (s2n.view is not None and s2n.view.primary == server(2)
+                and s2n.view.backup is None and s2n.synced)
+
+    s3 = SearchSettings().max_time(180)
+    s3.add_invariant(RESULTS_OK)
+    s3.partition(VSA, server(2), client(1))
+    s3.node_active(client(1), False).deliver_timers(client(1), False)
+    s3.deliver_timers(server(1), False)   # dead primary's timers are noise
+    s3.set_max_depth(acked.depth + 10)    # promotion takes ~8 events
+    s3.add_goal(StatePredicate("backup promoted", promoted))
+    results = bfs(acked, s3)
+    assert results.end_condition == EndCondition.GOAL_FOUND, results
+    failed_over = results.goal_matching_state
+
+    s4 = SearchSettings().max_time(120)
+    s4.add_invariant(RESULTS_OK).add_goal(CLIENTS_DONE)
+    s4.partition(VSA, server(2), client(1))
+    s4.deliver_timers(VSA, False).deliver_timers(server(2), False)
+    results = bfs(failed_over, s4)
+    assert results.end_condition == EndCondition.GOAL_FOUND, results
+
+
+@lab_test("2", 20, "Multi-client, multi-server random depth-first search", points=20, part=2, categories=(SEARCH_TESTS,))
+def test20_random_search():
+    state = make_search_state(append_same_key_workload(1))
+    state.add_server(server(1))
+    state.add_server(server(2))
+    state.add_client_worker(client(1))
+    state.add_client_worker(client(2))
+
+    settings = SearchSettings()
+    settings.set_max_depth(1000).max_time(8)
+    settings.add_invariant(APPENDS_LINEARIZABLE)
+    settings.add_prune(CLIENTS_DONE)
+    results = dfs(state, settings)
+    assert not results.terminal_found()

@@ -6,7 +6,8 @@ import time
 
 import pytest
 
-from dslabs_tpu.harness import RUN_TESTS, lab_test
+from dslabs_tpu.harness import (RUN_TESTS, SEARCH_TESTS,
+                                UNRELIABLE_TESTS, lab_test)
 from dslabs_tpu.core.address import LocalAddress
 from dslabs_tpu.labs.clientserver.kv_workload import get, get_result, put, put_ok
 from dslabs_tpu.labs.clientserver.kvstore import KeyNotFound
@@ -25,6 +26,7 @@ from dslabs_tpu.labs.shardedstore.txkvstore import (MultiGet, MultiGetResult,
 from dslabs_tpu.runner.run_settings import RunSettings
 from dslabs_tpu.runner.run_state import RunState
 from dslabs_tpu.testing.generator import NodeGenerator
+from dslabs_tpu.testing.predicates import CLIENTS_DONE, RESULTS_OK
 
 CCA = LocalAddress("configController")
 NUM_SHARDS = 10
@@ -74,8 +76,7 @@ def test_key_to_shard():
 
 # ------------------------------------------------------------- run fixtures
 
-def make_state(num_groups, servers_per_group=3, num_shard_masters=3,
-               num_shards=NUM_SHARDS):
+def _make_generator(servers_per_group, num_shard_masters, num_shards):
     masters = tuple(shard_master(i) for i in range(1, num_shard_masters + 1))
 
     def server_supplier(a):
@@ -91,9 +92,15 @@ def make_state(num_groups, servers_per_group=3, num_shard_masters=3,
             return PaxosClient(a, masters)
         return ShardStoreClient(a, masters, num_shards)
 
-    gen = NodeGenerator(server_supplier=server_supplier,
-                        client_supplier=client_supplier,
-                        workload_supplier=lambda a: None)
+    return masters, NodeGenerator(server_supplier=server_supplier,
+                                  client_supplier=client_supplier,
+                                  workload_supplier=lambda a: None)
+
+
+def make_state(num_groups, servers_per_group=3, num_shard_masters=3,
+               num_shards=NUM_SHARDS):
+    masters, gen = _make_generator(servers_per_group, num_shard_masters,
+                                   num_shards)
     state = RunState(gen)
     for m in masters:
         state.add_server(m)
@@ -221,7 +228,7 @@ def test_cross_group_transactions():
     state.stop()
 
 
-@lab_test("4", 5, "Repeated MultiPuts and MultiGets, concurrent swaps", points=20, part=3, categories=(RUN_TESTS,))
+@lab_test("4", 13, "Concurrent cross-group swaps (extended)", points=0, part=3, categories=(RUN_TESTS,))
 def test_concurrent_cross_group_swaps():
     """Concurrent conflicting 2PC transactions stay atomic: swaps permute
     values, so the value multiset is preserved (TransactionalKVStoreWorkload
@@ -266,3 +273,587 @@ def test_concurrent_cross_group_swaps():
     # Swaps only permute: the multiset of values is invariant.
     assert sorted(result.as_dict().values()) == sorted(keys)
     state.stop()
+
+
+# ------------------------------------------- additional reference ports (p2)
+
+def _join_leave_body(state, n_keys=30):
+    """test02JoinLeave body (ShardStorePart1Test.java:75-121, scaled
+    100 -> 30 keys): keys survive joins, rewrites, and leaves."""
+    cc = state.add_client(CCA)
+    send_check(cc, Join(1, group(1)), Ok())
+    c = state.add_client(LocalAddress("client1"))
+    kv = {}
+    for i in range(1, n_keys + 1):
+        kv[f"key-{i}"] = f"v{i}"
+        send_check(c, put(f"key-{i}", f"v{i}"), put_ok())
+
+    send_check(cc, Join(2, group(2)), Ok())
+    send_check(cc, Join(3, group(3)), Ok())
+    time.sleep(2)
+    for k, v in kv.items():
+        send_check(c, get(k), get_result(v))
+
+    for i in range(1, n_keys + 1):
+        kv[f"key-{i}"] = f"w{i}"
+        send_check(c, put(f"key-{i}", f"w{i}"), put_ok())
+
+    send_check(cc, Leave(1), Ok())
+    send_check(cc, Leave(2), Ok())
+    time.sleep(2)
+    for k, v in kv.items():
+        send_check(c, get(k), get_result(v))
+    state.stop()
+
+
+@lab_test("4", 2, "Multi-group join/leave", points=15, part=2, categories=(RUN_TESTS,))
+def test02_join_leave():
+    state = make_state(3)
+    state.start(RunSettings().max_time(120))
+    _join_leave_body(state)
+
+
+@lab_test("4", 5, "Progress with majorities in each group", points=15, part=2, categories=(RUN_TESTS,))
+def test05_progress_with_majorities():
+    """test05ProgressWithMajorities: one server per group (and one shard
+    master) cut off; join/leave still completes."""
+    state = make_state(3)
+    settings = RunSettings().max_time(120)
+    for g in range(1, 4):
+        settings.receiver_active(server(g, 3), False)
+        settings.sender_active(server(g, 3), False)
+    settings.receiver_active(shard_master(3), False)
+    settings.sender_active(shard_master(3), False)
+    state.start(settings)
+    _join_leave_body(state, n_keys=15)
+
+
+@lab_test("4", 8, "Multi-group join/leave", points=20, part=2, categories=(RUN_TESTS, UNRELIABLE_TESTS,))
+def test08_join_leave_unreliable():
+    state = make_state(3)
+    settings = RunSettings().max_time(180)
+    settings.network_deliver_rate(0.8)
+    state.start(settings)
+    _join_leave_body(state, n_keys=10)
+
+
+def _run_with_background(state, settings, background, length_secs,
+                         n_clients=3, max_wait=4.0):
+    """Shared body of test06/test07/test09: infinite-workload clients run
+    while a background thread perturbs the system."""
+    import threading
+
+    from dslabs_tpu.labs.clientserver.kv_workload import \
+        different_keys_infinite_workload
+
+    cc = state.add_client(CCA)
+    for g in range(1, 4):
+        send_check(cc, Join(g, group(g)), Ok(), timeout=20)
+    for i in range(1, n_clients + 1):
+        state.add_client_worker(LocalAddress(f"client{i}"),
+                                different_keys_infinite_workload(10))
+    stop = threading.Event()
+    th = threading.Thread(target=background, args=(stop,), daemon=True)
+    th.start()
+    time.sleep(length_secs)
+    stop.set()
+    th.join(10)
+    state.stop()
+    r = RESULTS_OK.check(state)
+    assert r.value, r.error_message()
+    for w in state.client_workers().values():
+        mw = w.max_wait(state.stop_time)
+        assert mw is not None and mw[0] < max_wait, f"max wait {mw}"
+
+
+@lab_test("4", 6, "Repeated partitioning of each group", points=20, part=2, categories=(RUN_TESTS,))
+def test06_repeated_partitioning():
+    """test06RepeatedPartitioning (scaled 50s -> 8s): a minority of each
+    group keeps dropping out."""
+    import random as _random
+
+    state = make_state(3)
+    settings = RunSettings().max_time(60)
+    state.start(settings)
+
+    def partitioner(stop):
+        rng = _random.Random(3)
+        while not stop.is_set():
+            settings.reconnect()
+            for g in range(1, 4):
+                srvs = [server(g, i) for i in range(1, 4)]
+                rng.shuffle(srvs)
+                settings.node_active(srvs[0], False)
+            if stop.wait(1.5):
+                break
+            settings.reconnect()
+            if stop.wait(1.5):
+                break
+        settings.reconnect()
+
+    _run_with_background(state, settings, partitioner, length_secs=8,
+                         max_wait=2.5)
+
+
+def _constant_movement(deliver_rate=None, length_secs=8):
+    """test07ConstantMovement: shards keep moving between groups while
+    clients run."""
+    import random as _random
+
+    state = make_state(3)
+    settings = RunSettings().max_time(90)
+    if deliver_rate is not None:
+        settings.network_deliver_rate(deliver_rate)
+    state.start(settings)
+    mover_client = [None]
+
+    def mover(stop):
+        rng = _random.Random(9)
+        mc = state.add_client(LocalAddress("mover"))
+        mover_client[0] = mc
+        while not stop.is_set():
+            g = rng.randrange(1, 4)
+            s = rng.randrange(1, NUM_SHARDS + 1)
+            try:
+                mc.send_command(Move(g, s))
+                mc.get_result(timeout=5)
+            except TimeoutError:
+                pass
+            if stop.wait(0.3):
+                break
+
+    _run_with_background(state, settings, mover, length_secs=length_secs)
+
+
+@lab_test("4", 7, "Repeated shard movement", points=20, part=2, categories=(RUN_TESTS,))
+def test07_constant_movement():
+    _constant_movement()
+
+
+@lab_test("4", 9, "Repeated shard movement", points=30, part=2, categories=(RUN_TESTS, UNRELIABLE_TESTS,))
+def test09_constant_movement_unreliable():
+    _constant_movement(deliver_rate=0.8)
+
+
+# ----------------------------------------------------------- search fixtures
+
+def make_search(num_groups, servers_per_group=1, num_shard_masters=1,
+                num_shards=NUM_SHARDS):
+    from dslabs_tpu.search.search_state import SearchState
+
+    masters, gen = _make_generator(servers_per_group, num_shard_masters,
+                                   num_shards)
+    state = SearchState(gen)
+    for m in masters:
+        state.add_server(m)
+    for g in range(1, num_groups + 1):
+        for i in range(1, servers_per_group + 1):
+            state.add_server(server(g, i))
+    return state
+
+
+def _joined_state(state, n_groups, servers_per_group=1,
+                  num_shard_masters=1):
+    """Drive the Join commands to completion through the config
+    controller, narrowed to the {CCA, shard masters} partition exactly as
+    the reference does (ShardStoreBaseTest.java:209-220) — the groups
+    learn the config during the NEXT search phase, not here."""
+    from dslabs_tpu.search.search import bfs
+    from dslabs_tpu.search.results import EndCondition
+    from dslabs_tpu.search.settings import SearchSettings
+    from dslabs_tpu.testing.predicates import client_done
+    from dslabs_tpu.testing.workload import Workload
+
+    cmds = [Join(g, group(g, servers_per_group))
+            for g in range(1, n_groups + 1)]
+    state.add_client_worker(CCA, Workload(commands=cmds,
+                                          results=[Ok()] * len(cmds)))
+
+    masters = [shard_master(i) for i in range(1, num_shard_masters + 1)]
+    settings = SearchSettings().max_time(120)
+    settings.add_invariant(RESULTS_OK)
+    settings.partition(CCA, *masters)
+    # Store servers are cut off anyway; their timers only add noise.
+    for a in list(state.servers):
+        if "server" in str(a):
+            settings.deliver_timers(a, False)
+    settings.add_goal(client_done(CCA))
+    results = bfs(state, settings)
+    assert results.end_condition == EndCondition.GOAL_FOUND, results
+    return results.goal_matching_state
+
+
+@lab_test("4", 10, "Single client, single group", points=20, part=2, categories=(SEARCH_TESTS,))
+def test10_single_client_single_group_search():
+    """ShardStorePart1Test.test10: put/get completes and the done-pruned
+    space stays clean with one single-server group."""
+    from dslabs_tpu.search.search import bfs
+    from dslabs_tpu.search.results import EndCondition
+    from dslabs_tpu.search.settings import SearchSettings
+    from dslabs_tpu.labs.clientserver.kv_workload import kv_workload
+
+    state = make_search(1, 1, 1, 10)
+    joined = _joined_state(state, 1)
+    joined.add_client_worker(
+        LocalAddress("client1"),
+        kv_workload(["PUT:foo:bar", "GET:foo"], ["PutOk", "bar"]))
+
+    settings = SearchSettings().max_time(240)
+    settings.add_invariant(RESULTS_OK).add_goal(CLIENTS_DONE)
+    settings.node_active(CCA, False)
+    settings.deliver_timers(CCA, False)
+    # The singleton shard master is already the decided leader; its
+    # election/heartbeat timers only multiply interleavings.
+    settings.deliver_timers(shard_master(1), False)
+    results = bfs(joined, settings)
+    assert results.end_condition == EndCondition.GOAL_FOUND, results
+
+    settings.clear_goals().add_prune(CLIENTS_DONE)
+    settings.set_max_depth(joined.depth + 6)
+    results = bfs(joined, settings)
+    assert results.end_condition in (EndCondition.SPACE_EXHAUSTED,
+                                     EndCondition.TIME_EXHAUSTED), results
+
+
+@lab_test("4", 11, "Single client, multi-group", points=20, part=2, categories=(SEARCH_TESTS,))
+def test11_single_client_multi_group_search():
+    """ShardStorePart1Test.test11: the workload spans both groups' shards."""
+    from dslabs_tpu.search.search import bfs
+    from dslabs_tpu.search.results import EndCondition
+    from dslabs_tpu.search.settings import SearchSettings
+    from dslabs_tpu.labs.clientserver.kv_workload import kv_workload
+
+    state = make_search(2, 1, 1, 10)
+    joined = _joined_state(state, 2)
+    joined.add_client_worker(
+        LocalAddress("client1"),
+        kv_workload(["PUT:key-1:v1", "PUT:key-6:v6", "GET:key-1"],
+                    ["PutOk", "PutOk", "v1"]))
+
+    # Full goal-finding over two groups is beyond the Python oracle's
+    # budget (the tensor backend is the scaling path); ungated CI checks
+    # bounded-depth safety of the same space, goal-finding runs under
+    # DSLABS_SLOW_TESTS with a long budget.
+    import os as _os
+
+    settings = SearchSettings()
+    settings.add_invariant(RESULTS_OK)
+    settings.node_active(CCA, False)
+    settings.deliver_timers(CCA, False)
+    settings.deliver_timers(shard_master(1), False)
+    if _os.environ.get("DSLABS_SLOW_TESTS"):
+        settings.max_time(900).add_goal(CLIENTS_DONE)
+        results = bfs(joined, settings)
+        assert results.end_condition == EndCondition.GOAL_FOUND, results
+    else:
+        settings.max_time(120).set_max_depth(joined.depth + 6)
+        results = bfs(joined, settings)
+        assert results.end_condition in (EndCondition.SPACE_EXHAUSTED,
+                                         EndCondition.TIME_EXHAUSTED), results
+
+
+@lab_test("4", 12, "Multi-client, multi-group", points=20, part=2, categories=(SEARCH_TESTS,))
+def test12_multi_client_multi_group_search():
+    """ShardStorePart1Test.test12: two clients appending to keys in
+    different groups; both orders linearize."""
+    from dslabs_tpu.search.search import bfs
+    from dslabs_tpu.search.results import EndCondition
+    from dslabs_tpu.search.settings import SearchSettings
+    from dslabs_tpu.labs.clientserver.kv_workload import kv_workload
+
+    state = make_search(2, 1, 1, 2)
+    joined = _joined_state(state, 2)
+    joined.add_client_worker(LocalAddress("client1"),
+                             kv_workload(["APPEND:foo-1:X1"], ["X1"]))
+    joined.add_client_worker(
+        LocalAddress("client2"),
+        kv_workload(["APPEND:foo-2:Y2"], ["Y2"]))
+
+    import os as _os
+
+    settings = SearchSettings()
+    settings.add_invariant(RESULTS_OK)
+    settings.node_active(CCA, False)
+    settings.deliver_timers(CCA, False)
+    settings.deliver_timers(shard_master(1), False)
+    if _os.environ.get("DSLABS_SLOW_TESTS"):
+        settings.max_time(900).add_goal(CLIENTS_DONE)
+        results = bfs(joined, settings)
+        assert results.end_condition == EndCondition.GOAL_FOUND, results
+    else:
+        settings.max_time(120).set_max_depth(joined.depth + 6)
+        results = bfs(joined, settings)
+        assert results.end_condition in (EndCondition.SPACE_EXHAUSTED,
+                                         EndCondition.TIME_EXHAUSTED), results
+
+
+def _random_search(servers_per_group):
+    from dslabs_tpu.search.search import dfs
+    from dslabs_tpu.search.settings import SearchSettings
+    from dslabs_tpu.labs.clientserver.kv_workload import kv_workload
+
+    state = make_search(2, servers_per_group, 1, 2)
+    joined = _joined_state(state, 2, servers_per_group)
+    joined.add_client_worker(LocalAddress("client1"),
+                             kv_workload(["APPEND:foo-1:x"]))
+    joined.add_client_worker(LocalAddress("client2"),
+                             kv_workload(["APPEND:foo-2:y"]))
+
+    settings = SearchSettings()
+    settings.set_max_depth(1000).max_time(8)
+    settings.add_invariant(RESULTS_OK)
+    settings.add_prune(CLIENTS_DONE)
+    results = dfs(joined, settings)
+    assert not results.terminal_found()
+
+
+@lab_test("4", 13, "One server per group random search", points=20, part=2, categories=(SEARCH_TESTS,))
+def test13_single_server_random_search():
+    _random_search(1)
+
+
+@lab_test("4", 14, "Multiple servers per group random search", points=20, part=2, categories=(SEARCH_TESTS,))
+def test14_multi_server_random_search():
+    _random_search(2)
+
+
+# ------------------------------------------- additional reference ports (p3)
+
+@lab_test("4", 3, "No progress when groups can't communicate", points=10, part=3, categories=(RUN_TESTS,))
+def test03_no_progress():
+    """ShardStorePart2Test.test03NoProgress: with the groups partitioned
+    from each other (client still sees both), single-group transactions
+    commit but a cross-group 2PC transaction must block."""
+    state = make_state(2, num_shards=2)
+    settings = RunSettings().max_time(30)
+    state.start(settings)
+    cc = state.add_client(CCA)
+    send_check(cc, Join(1, group(1)), Ok())
+    send_check(cc, Join(2, group(2)), Ok())
+    c = state.add_client(LocalAddress("client1"))
+    send_check(c, MultiPut({"key1-1": "foo1", "key1-2": "foo2"}),
+               MultiPutOk(), timeout=15)
+    time.sleep(1)
+
+    g1 = [server(1, i) for i in range(1, 4)]
+    g2 = [server(2, i) for i in range(1, 4)]
+    # Groups in separate partitions; the client keeps links to every server.
+    settings.partition(*g1)
+    for s in g2:
+        for s2 in g2:
+            settings.link_active(s, s2, True)
+    for s in g1 + g2:
+        settings.link_active(LocalAddress("client1"), s, True)
+        settings.link_active(s, LocalAddress("client1"), True)
+
+    send_check(c, MultiPut({"key2-1": "foo1", "key3-1": "foo2"}),
+               MultiPutOk(), timeout=15)
+    send_check(c, MultiPut({"key2-2": "foo1", "key3-2": "foo2"}),
+               MultiPutOk(), timeout=15)
+
+    c.send_command(MultiPut({"key4-1": "foo1", "key4-2": "foo2"}))
+    time.sleep(4)
+    assert not c.has_result(), "cross-group 2PC committed without comms"
+    state.stop()
+
+
+def _multi_gets_match(state):
+    for w in state.client_workers().values():
+        for r in w.results:
+            if isinstance(r, MultiGetResult):
+                vals = set(r.as_dict().values())
+                if len(vals) > 1:
+                    return False
+    return True
+
+
+@lab_test("4", 4, "Isolation between MultiPuts and MultiGets", points=10, part=3, categories=(RUN_TESTS,))
+def test04_put_get_isolation():
+    """ShardStorePart2Test.test04 (scaled 100 -> 25 rounds): a MultiGet
+    concurrent with atomic MultiPuts over the same two cross-group keys
+    must never observe a torn write."""
+    from dslabs_tpu.testing.predicates import StatePredicate
+    from dslabs_tpu.testing.workload import Workload
+
+    n_rounds = 25
+    state = make_state(2, num_shards=2)
+    settings = RunSettings().max_time(90)
+    state.start(settings)
+    cc = state.add_client(CCA)
+    send_check(cc, Join(1, group(1)), Ok())
+    send_check(cc, Join(2, group(2)), Ok())
+
+    put_cmds = [MultiPut({f"key{i}-1": f"foo{i}", f"key{i}-2": f"foo{i}"})
+                for i in range(n_rounds)]
+    get_cmds = [MultiGet({f"key{i}-1", f"key{i}-2"}) for i in range(n_rounds)]
+    state.add_client_worker(LocalAddress("client1"),
+                            Workload(commands=put_cmds,
+                                     results=[MultiPutOk()] * n_rounds))
+    state.add_client_worker(LocalAddress("client2"),
+                            Workload(commands=get_cmds))
+    state.wait_for()
+    state.stop()
+    assert _multi_gets_match(state), "torn MultiGet observed"
+    r = RESULTS_OK.check(state)
+    assert r.value, r.error_message()
+
+
+def _repeated_puts_gets(deliver_rate=None, with_movement=False,
+                        n_rounds=12):
+    """test05/06/07 (scaled): repeated cross-group MultiPut/MultiGet with
+    matching expectations; optionally unreliable and/or under movement."""
+    import random as _random
+    import threading
+
+    from dslabs_tpu.testing.workload import Workload
+
+    state = make_state(2, num_shards=2)
+    settings = RunSettings().max_time(150)
+    if deliver_rate is not None:
+        settings.network_deliver_rate(deliver_rate)
+    state.start(settings)
+    cc = state.add_client(CCA)
+    send_check(cc, Join(1, group(1)), Ok(), timeout=20)
+    send_check(cc, Join(2, group(2)), Ok(), timeout=20)
+
+    put_cmds, put_res, get_cmds, get_res = [], [], [], []
+    for i in range(n_rounds):
+        put_cmds.append(MultiPut({f"key{i}-1": f"v{i}", f"key{i}-2": f"v{i}"}))
+        put_res.append(MultiPutOk())
+    state.add_client_worker(LocalAddress("client1"),
+                            Workload(commands=put_cmds, results=put_res))
+
+    stop = threading.Event()
+    th = None
+    if with_movement:
+        def mover():
+            rng = _random.Random(13)
+            mc = state.add_client(LocalAddress("mover"))
+            while not stop.is_set():
+                try:
+                    mc.send_command(Move(rng.randrange(1, 3),
+                                         rng.randrange(1, 3)))
+                    mc.get_result(timeout=5)
+                except TimeoutError:
+                    pass
+                if stop.wait(0.4):
+                    break
+
+        th = threading.Thread(target=mover, daemon=True)
+        th.start()
+
+    state.wait_for()
+    # Now read everything back atomically.
+    for i in range(n_rounds):
+        get_cmds.append(MultiGet({f"key{i}-1", f"key{i}-2"}))
+        get_res.append(MultiGetResult({f"key{i}-1": f"v{i}",
+                                       f"key{i}-2": f"v{i}"}))
+    state.add_client_worker(LocalAddress("client2"),
+                            Workload(commands=get_cmds, results=get_res))
+    state.wait_for()
+    stop.set()
+    if th is not None:
+        th.join(8)
+    state.stop()
+    r = RESULTS_OK.check(state)
+    assert r.value, r.error_message()
+    assert _multi_gets_match(state)
+
+
+@lab_test("4", 5, "Repeated MultiPuts and MultiGets, different keys", points=20, part=3, categories=(RUN_TESTS,))
+def test05_repeated_puts_gets():
+    _repeated_puts_gets()
+
+
+@lab_test("4", 6, "Repeated MultiPuts and MultiGets, different keys", points=20, part=3, categories=(RUN_TESTS, UNRELIABLE_TESTS,))
+def test06_repeated_puts_gets_unreliable():
+    _repeated_puts_gets(deliver_rate=0.8, n_rounds=8)
+
+
+@lab_test("4", 7, "Repeated MultiPuts and MultiGets; constant movement", points=20, part=3, categories=(RUN_TESTS, UNRELIABLE_TESTS,))
+def test07_constant_movement_tx():
+    _repeated_puts_gets(deliver_rate=0.8, with_movement=True, n_rounds=8)
+
+
+@lab_test("4", 8, "Single client, single group; MultiPut, MultiGet", points=20, part=3, categories=(SEARCH_TESTS,))
+def test08_single_client_single_group_tx_search():
+    """ShardStorePart2Test.test08: transactional workload search in one
+    single-server group."""
+    from dslabs_tpu.search.search import bfs
+    from dslabs_tpu.search.results import EndCondition
+    from dslabs_tpu.search.settings import SearchSettings
+    from dslabs_tpu.testing.workload import Workload
+
+    state = make_search(1, 1, 1, 2)
+    joined = _joined_state(state, 1)
+    joined.add_client_worker(
+        LocalAddress("client1"),
+        Workload(commands=[MultiPut({"key-1": "x", "key-2": "y"}),
+                           MultiGet({"key-1", "key-2"})],
+                 results=[MultiPutOk(),
+                          MultiGetResult({"key-1": "x", "key-2": "y"})]))
+
+    settings = SearchSettings().max_time(240)
+    settings.add_invariant(RESULTS_OK).add_goal(CLIENTS_DONE)
+    settings.node_active(CCA, False)
+    settings.deliver_timers(CCA, False)
+    settings.deliver_timers(shard_master(1), False)
+    results = bfs(joined, settings)
+    assert results.end_condition == EndCondition.GOAL_FOUND, results
+
+    settings.clear_goals().add_prune(CLIENTS_DONE)
+    settings.set_max_depth(joined.depth + 6)
+    results = bfs(joined, settings)
+    assert results.end_condition in (EndCondition.SPACE_EXHAUSTED,
+                                     EndCondition.TIME_EXHAUSTED), results
+
+
+@lab_test("4", 9, "Single client, multi-group; MultiPut, MultiGet", points=20, part=3, categories=(SEARCH_TESTS,))
+def test09_single_client_multi_group_tx_search():
+    """ShardStorePart2Test.test09: the transaction spans both groups
+    (cross-group 2PC searched to completion)."""
+    from dslabs_tpu.search.search import bfs
+    from dslabs_tpu.search.results import EndCondition
+    from dslabs_tpu.search.settings import SearchSettings
+    from dslabs_tpu.testing.workload import Workload
+
+    state = make_search(2, 1, 1, 2)
+    joined = _joined_state(state, 2)
+    joined.add_client_worker(
+        LocalAddress("client1"),
+        Workload(commands=[MultiPut({"key-1": "x", "key-2": "y"})],
+                 results=[MultiPutOk()]))
+
+    settings = SearchSettings().max_time(300)
+    settings.add_invariant(RESULTS_OK).add_goal(CLIENTS_DONE)
+    settings.node_active(CCA, False)
+    settings.deliver_timers(CCA, False)
+    settings.deliver_timers(shard_master(1), False)
+    results = bfs(joined, settings)
+    assert results.end_condition == EndCondition.GOAL_FOUND, results
+
+
+@lab_test("4", 11, "One server per group random search", points=20, part=3, categories=(SEARCH_TESTS,))
+def test11_tx_random_search():
+    """ShardStorePart2Test.test11: random probes over transactional
+    workloads (MultiPut, Swap, MultiGet)."""
+    from dslabs_tpu.search.search import dfs
+    from dslabs_tpu.search.settings import SearchSettings
+    from dslabs_tpu.testing.workload import Workload
+
+    state = make_search(2, 1, 1, 2)
+    joined = _joined_state(state, 2)
+    joined.add_client_worker(
+        LocalAddress("client1"),
+        Workload(commands=[MultiPut({"key-1": "x", "key-2": "y"}),
+                           Swap("key-1", "key-2")]))
+    joined.add_client_worker(
+        LocalAddress("client2"),
+        Workload(commands=[MultiGet({"key-1", "key-2"})]))
+
+    settings = SearchSettings()
+    settings.set_max_depth(1000).max_time(8)
+    settings.add_invariant(RESULTS_OK)
+    settings.add_prune(CLIENTS_DONE)
+    results = dfs(joined, settings)
+    assert not results.terminal_found()

@@ -1,0 +1,79 @@
+"""Trace-viewer tests (visualization subsystem: DebuggerWindow/JTrees/
+VizConfig analogs — SURVEY §2.6)."""
+
+import json
+import re
+
+from dslabs_tpu.core.address import LocalAddress
+from dslabs_tpu.search.trace import SerializableTrace, save_trace
+from dslabs_tpu.testing.predicates import NONE_DECIDED
+from dslabs_tpu.viz import render_trace_html, serve_trace, viz_configs
+from dslabs_tpu.viz.server import state_dump, viz_ignore
+
+from tests.test_traces import violating_state
+
+
+def test_render_trace_html(tmp_path):
+    end = violating_state()
+    path = save_trace(end, [NONE_DECIDED], "0", None, "PingTest", "viz",
+                      directory=str(tmp_path))
+    trace = SerializableTrace.load(path)
+    page = render_trace_html(trace)
+    # The embedded step data covers every event plus the initial state.
+    m = re.search(r"const STEPS = (\[.*?\]);\n", page, re.S)
+    assert m, "steps JSON missing from the page"
+    steps = json.loads(m.group(1))
+    assert len(steps) == len(trace.history) + 1
+    assert steps[0]["event"] == "(initial state)"
+    assert "pingserver" in steps[0]["state"]["nodes"]
+    assert "client1" in steps[0]["state"]["nodes"]
+    # Delivered events and diffs are renderable.
+    assert any("Message(" in s["event"] or "Timer(" in s["event"]
+               for s in steps[1:])
+
+
+def test_serve_trace_writes_html(tmp_path):
+    end = violating_state()
+    path = save_trace(end, [NONE_DECIDED], "0", None, "PingTest", "viz2",
+                      directory=str(tmp_path))
+    out = str(tmp_path / "trace.html")
+    assert serve_trace(path, out_path=out) == 0
+    content = open(out).read()
+    assert "dslabs trace viewer" in content
+    assert serve_trace(str(tmp_path / "missing.trace")) == 1
+
+
+def test_viz_ignore_hides_fields():
+    @viz_ignore("secret")
+    class FakeNode:
+        def __init__(self):
+            self.visible = 1
+            self.secret = 2
+            self._internal = 3
+
+    class FakeState:
+        def addresses(self):
+            return [LocalAddress("n1")]
+
+        def node(self, a):
+            return FakeNode()
+
+        def network(self):
+            return []
+
+        def timers(self, a):
+            return None
+
+    d = state_dump(FakeState())
+    assert d["nodes"]["n1"] == {"visible": "1"}
+
+
+def test_viz_configs_build_initial_states():
+    configs = viz_configs()
+    assert {"0", "1", "3"} <= set(configs)
+    s0 = configs["0"](["1", "2", "a,b"])
+    assert len(list(s0.addresses())) == 3   # server + 2 clients
+    s3 = configs["3"](["3", "1"])
+    assert len(list(s3.addresses())) == 4   # 3 paxos servers + client
+    # The built states are searchable (events enumerable).
+    assert s3.events(None)
