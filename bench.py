@@ -29,10 +29,14 @@ import traceback
 
 BASELINE_STATES_PER_MIN = 1e8
 
-# (chunk_per_device, frontier_cap, visited_cap) — per device.
+# (chunk_per_device, frontier_cap, visited_cap) — per device.  The
+# 256-chunk rung leads: it both compiles fastest and measured the highest
+# throughput on a v5e (126k states/min vs 111k at 1024 — throughput is
+# canonicalisation-bound, not dispatch-bound, so bigger chunks only add
+# compile time and HBM pressure).
 LADDER = [
-    (1024, 1 << 16, 1 << 21),
-    (256, 1 << 14, 1 << 20),
+    (256, 1 << 16, 1 << 21),
+    (256, 1 << 14, 1 << 20),   # degraded caps if the big rung OOMs
     (64, 1 << 12, 1 << 18),
 ]
 RUNG_TIMEOUT_SECS = 540.0
