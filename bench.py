@@ -46,6 +46,11 @@ def _run_rung(chunk_per_device: int, frontier_cap: int, visited_cap: int,
               max_secs: float) -> dict:
     import jax
 
+    # Persistent compile cache: the expand program takes minutes to build;
+    # repeat bench invocations on the same machine skip straight to run.
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
     from dslabs_tpu.tpu.protocols.paxos import make_paxos_protocol
     from dslabs_tpu.tpu.sharded import ShardedTensorSearch, make_mesh
 

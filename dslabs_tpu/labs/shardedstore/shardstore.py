@@ -59,12 +59,21 @@ def _java_string_hash(s: str) -> int:
 def key_to_shard(key: str, num_shards: int) -> int:
     """Shard of ``key`` in 1..num_shards: trailing digits (mod num_shards)
     when present, else a deterministic string hash
-    (ShardStoreNode.java:40-66; Python's salted hash() is unusable here)."""
+    (ShardStoreNode.java:40-66; Python's salted hash() is unusable here).
+    The digit accumulation wraps at 32 bits like Java int arithmetic, so
+    keys with 10+ trailing digits map exactly as the reference does."""
     i = len(key)
     while i > 0 and key[i - 1].isdigit():
         i -= 1
     digits = key[i:]
-    h = int(digits) if digits else _java_string_hash(key)
+    if digits:
+        h = 0
+        for d in digits:
+            h = (h * 10 + int(d)) & 0xFFFFFFFF
+        if h >= 2 ** 31:
+            h -= 2 ** 32
+    else:
+        h = _java_string_hash(key)
     mod = h % num_shards
     if mod <= 0:
         mod += num_shards

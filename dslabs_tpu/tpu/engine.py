@@ -159,11 +159,21 @@ class SearchOutcome:
 # ----------------------------------------------------------------- hashing
 
 def _mix32(x: jnp.ndarray, seed: jnp.ndarray) -> jnp.ndarray:
-    """xorshift-multiply mixer over int32 lanes (vectorised, uint32 only)."""
-    x = x.astype(jnp.uint32) ^ (seed.astype(jnp.uint32) * jnp.uint32(0x9E3779B9))
-    x = (x ^ (x >> 16)) * jnp.uint32(0x85EBCA6B)
-    x = (x ^ (x >> 13)) * jnp.uint32(0xC2B2AE35)
-    return x ^ (x >> 16)
+    """Add-shift-xor mixer over int32 lanes (vectorised, uint32 only).
+
+    Jenkins one-at-a-time-style avalanche: NO per-element integer
+    multiplies — uint32 multiplies at (pairs x lanes) scale measured ~6x
+    slower than shift/add/xor lanes on the TPU VPU (round-2 profile).
+    The only multiply is on the [1, L] positional seed row."""
+    x = x.astype(jnp.uint32) ^ (seed.astype(jnp.uint32)
+                                * jnp.uint32(0x9E3779B9))
+    x = x + (x << 10)
+    x = x ^ (x >> 6)
+    x = x + (x << 3)
+    x = x ^ (x >> 11)
+    x = x + (x << 15)
+    x = x ^ (x >> 7)
+    return x
 
 
 def _fingerprint32(flat: jnp.ndarray, seed: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -242,23 +252,37 @@ def sorted_member(vh1: np.ndarray, vh2: np.ndarray,
 
 # ------------------------------------------------------------ net/timer ops
 
-def canonicalize_net(net: jnp.ndarray) -> jnp.ndarray:
-    """Sort the message set into canonical order and collapse duplicates.
+def _row_less(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Lexicographic ``a < b`` over the trailing lane axis (broadcasts).
+    Pure compare/select lanes — no integer multiplies: uint32-multiply
+    hashing at (state x event x row) scale measured ~6x slower than these
+    raw-lane compares on TPU (round-2 bisection)."""
+    eq = a == b
+    # first_diff[l] = lanes 0..l-1 all equal and lane l differs
+    prefix_eq = jnp.cumprod(eq, axis=-1, dtype=jnp.int32).astype(bool)
+    prefix_excl = jnp.concatenate([
+        jnp.ones_like(prefix_eq[..., :1]), prefix_eq[..., :-1]], axis=-1)
+    return jnp.any(~eq & prefix_excl & (a < b), axis=-1)
 
-    [CAP, MW] -> [CAP, MW]; empty rows are all-SENTINEL and sort last.
-    Records are ordered by their packed 128-bit fingerprint (any total
-    order works for canonicalisation as long as it is content-determined).
-    One sort + one scatter-compaction — duplicates (adjacent after the
-    sort) are dropped by scattering the kept rows to their rank."""
+
+def canonicalize_net(net: jnp.ndarray) -> jnp.ndarray:
+    """Sort the message set into canonical (raw-lane lexicographic) order
+    and collapse duplicates.
+
+    [CAP, MW] -> [CAP, MW]; empty rows are all-SENTINEL and sort last
+    (SENTINEL is int32 max and occupied rows always have lane 0 !=
+    SENTINEL).  Cold path: used for batch-1 initial states only — the hot
+    loop's set-insertion (:func:`insert_messages`) is a sort-free merge
+    that preserves this order."""
     cap = net.shape[0]
     empty = net[:, 0] == SENTINEL
-    k = row_fingerprints(net)
     # lexsort: LAST key is primary — empty rows always sort to the back.
-    order = jnp.lexsort((k[:, 3], k[:, 2], k[:, 1], k[:, 0], empty))
+    keys = tuple(net[:, lane] for lane in range(net.shape[1] - 1, -1, -1))
+    order = jnp.lexsort(keys + (empty,))
     net_s = net[order]
-    k_s, empty_s = k[order], empty[order]
+    empty_s = empty[order]
     dup = jnp.zeros(cap, dtype=bool).at[1:].set(
-        jnp.all(k_s[1:] == k_s[:-1], axis=1) & ~empty_s[1:])
+        jnp.all(net_s[1:] == net_s[:-1], axis=1) & ~empty_s[1:])
     keep = ~dup & ~empty_s
     pos = jnp.cumsum(keep) - 1
     out = jnp.full((cap + 1, net.shape[1]), SENTINEL, net.dtype)
@@ -270,13 +294,66 @@ def insert_messages(net: jnp.ndarray,
                     sends: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Set-insert up to MAX_SENDS records into the canonical network.
 
+    Sort-free merge: ``net`` is always in canonical form (occupied rows
+    first, raw-lane ascending — every state enters the engine through
+    :func:`canonicalize_net` or this function), so inserting S small
+    ``sends`` needs only O(S x CAP) lexicographic comparisons to compute
+    each row's merged rank, then one gather to materialise the result.
+    The round-2 profile showed the previous sort-per-(state,event)
+    version was 82% of the whole expand program; a fingerprint-keyed
+    variant of the compare was 6x slower than raw-lane compares (uint32
+    multiplies dominate on the VPU).
+
     Returns ``(net', overflow)`` where overflow counts distinct occupied
     records that did not fit back into capacity — the caller surfaces any
     nonzero count as a CapacityOverflow (never a silent truncation)."""
     cap = net.shape[0]
-    combined = canonicalize_net(jnp.concatenate([net, sends], axis=0))
-    overflow = jnp.sum(combined[cap:, 0] != SENTINEL).astype(jnp.int32)
-    return combined[:cap], overflow
+    s = sends.shape[0]
+    net_occ = net[:, 0] != SENTINEL                       # [cap]
+    send_occ = sends[:, 0] != SENTINEL                    # [s]
+    sn_less = _row_less(sends[:, None, :], net[None, :, :])  # send_i < net_j
+    sn_eq = jnp.all(sends[:, None, :] == net[None, :, :], axis=-1)
+    dup_net = jnp.any(sn_eq & net_occ[None, :], axis=1)   # [s]
+    ss_eq = jnp.all(sends[:, None, :] == sends[None, :, :], axis=-1)
+    earlier = jnp.tril(jnp.ones((s, s), bool), k=-1)      # j < i
+    earlier_dup = jnp.any(ss_eq & earlier & send_occ[None, :], axis=1)
+    valid = send_occ & ~dup_net & ~earlier_dup            # [s]
+
+    # Merged rank of each valid send: occupied net rows strictly below it
+    # plus valid sends strictly below it (ties impossible after dedup —
+    # tie-break among equal-key sends never fires, but keep the j<i term
+    # for full determinism anyway).
+    net_below = jnp.sum((~sn_less & ~sn_eq) & net_occ[None, :], axis=1)
+    ss_less = _row_less(sends[:, None, :], sends[None, :, :])  # [s,s] i<j?
+    sends_below = jnp.sum(
+        (ss_less.T | (ss_eq & earlier)) & valid[None, :], axis=1)
+    dst_send = net_below + sends_below                    # [s]
+
+    # Each occupied net row j sits at rank j already; valid sends below it
+    # push it right.
+    dst_net = (jnp.arange(cap) +
+               jnp.sum(sn_less & valid[:, None], axis=0))  # [cap]
+
+    # One-hot inversion: for each output slot, select the source row via a
+    # 0/1 matmul — STATIC indexing only.  (An argmax+gather formulation
+    # here lowered to per-pair dynamic gathers; materialising those under
+    # the engine's flat vmap ran at ~1 GB/s on TPU — the round-2
+    # bottleneck.  Each output slot has at most one hit, so the int32
+    # products sum exactly.)
+    k = jnp.arange(cap)
+    hit_net = net_occ[None, :] & (dst_net[None, :] == k[:, None])  # [cap,cap]
+    hit_send = valid[None, :] & (dst_send[None, :] == k[:, None])  # [cap,s]
+    # Masked select-reduce, not an int32 einsum: integer-multiply
+    # dot_general lowers to slow VPU loops, while where+sum fuses.
+    out = (jnp.sum(jnp.where(hit_net[:, :, None], net[None, :, :], 0),
+                   axis=1)
+           + jnp.sum(jnp.where(hit_send[:, :, None], sends[None, :, :], 0),
+                     axis=1))
+    any_hit = jnp.any(hit_net, axis=1) | jnp.any(hit_send, axis=1)
+    out = jnp.where(any_hit[:, None], out, SENTINEL)
+    total = (jnp.sum(net_occ) + jnp.sum(valid)).astype(jnp.int32)
+    overflow = jnp.maximum(total - cap, 0).astype(jnp.int32)
+    return out, overflow
 
 
 def timer_deliverable_mask(queue: jnp.ndarray) -> jnp.ndarray:
@@ -294,12 +371,13 @@ def timer_deliverable_mask(queue: jnp.ndarray) -> jnp.ndarray:
 
 def remove_timer(queue: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     """Remove the timer at position idx, shifting later entries left
-    (insertion order is semantic — it drives the partial order)."""
+    (insertion order is semantic — it drives the partial order).
+    Static shift-select: the shifted copy is a constant-offset slice, the
+    blend a positional mask — no dynamic gather."""
     cap = queue.shape[0]
     pos = jnp.arange(cap)
-    src = jnp.where(pos >= idx, pos + 1, pos).clip(0, cap - 1)
-    shifted = queue[src]
-    shifted = shifted.at[cap - 1].set(SENTINEL)
+    shifted = jnp.concatenate([
+        queue[1:], jnp.full((1, queue.shape[1]), SENTINEL, queue.dtype)])
     return jnp.where((pos >= idx)[:, None], shifted, queue)
 
 
@@ -309,29 +387,38 @@ def append_timers(timers: jnp.ndarray,
     queues [NN, T_CAP, TW], preserving insertion order.  Returns
     ``(timers', dropped)`` — a full queue drops the append (insertion order
     is semantic, clobbering would corrupt the partial order) and the drop
-    count is surfaced loudly by the engine."""
-    _, cap, _ = timers.shape
+    count is surfaced loudly by the engine.
 
-    def one_append(carry, rec):
-        tmrs, dropped = carry
-        node = rec[0]
-
-        def body(carry):
-            t, d = carry
-            q = t[node]
-            count = jnp.sum(q[:, 0] != SENTINEL)
-            has_room = count < cap
-            q = q.at[count.clip(0, cap - 1)].set(
-                jnp.where(has_room, rec[1:], q[count.clip(0, cap - 1)]))
-            return (t.at[node].set(q),
-                    d + jnp.where(has_room, 0, 1).astype(jnp.int32))
-
-        return jax.lax.cond(rec[0] != SENTINEL, body,
-                            lambda c: c, (tmrs, dropped)), None
-
-    (timers, dropped), _ = jax.lax.scan(
-        one_append, (timers, jnp.int32(0)), new_timers)
-    return timers, dropped
+    Occupied rows form a prefix of each queue (appends land at the count,
+    removals shift left), so every append's slot is computable up front:
+    queue occupancy + number of earlier appends to the same node.  The
+    writes land via a one-hot 0/1 einsum over the (node, slot) grid —
+    static indexing only (dynamic scatters under the engine's flat vmap
+    lowered to ~1 GB/s code on TPU, the round-2 bottleneck; distinct
+    records land on distinct slots, so the products sum exactly)."""
+    nn, cap, tw = timers.shape
+    s = new_timers.shape[0]
+    node = new_timers[:, 0]
+    valid = node != SENTINEL
+    node_c = jnp.where(valid, node, 0).astype(jnp.int32).clip(0, nn - 1)
+    counts = jnp.sum(timers[:, :, 0] != SENTINEL, axis=1)   # [NN]
+    earlier_same = (jnp.tril(jnp.ones((s, s), bool), k=-1)
+                    & (node[None, :] == node[:, None]) & valid[None, :])
+    offset = jnp.sum(earlier_same, axis=1)
+    # counts[node_c] as a one-hot sum (static): [s, nn] @ [nn]
+    node_oh = jnp.arange(nn)[None, :] == node_c[:, None]    # [s, nn]
+    slot = jnp.sum(node_oh * counts[None, :], axis=1) + offset
+    ok = valid & (slot < cap)
+    dropped = jnp.sum(valid & ~ok).astype(jnp.int32)
+    slot_oh = jnp.arange(cap)[None, :] == slot[:, None]     # [s, cap]
+    write = (node_oh[:, :, None] & slot_oh[:, None, :]
+             & ok[:, None, None])                           # [s, nn, cap]
+    # Masked select-reduce, not an int32 einsum (see insert_messages).
+    contrib = jnp.sum(
+        jnp.where(write[:, :, :, None], new_timers[:, None, None, 1:], 0),
+        axis=0)                                             # [nn, cap, tw]
+    hit = jnp.any(write, axis=0)                            # [nn, cap]
+    return jnp.where(hit[:, :, None], contrib, timers), dropped
 
 
 def _normalize_step(out):
@@ -404,9 +491,14 @@ class TensorSearch:
         nodes, net, timers = (state_slice["nodes"], state_slice["net"],
                               state_slice["timers"])
         is_msg = event_idx < p.net_cap
+        # All event picks are one-hot 0/1 sums — static indexing only
+        # (per-pair dynamic gathers materialise at ~1 GB/s under the flat
+        # vmap on TPU, the round-2 bottleneck).
 
         def deliver_message():
-            msg = net[event_idx.clip(0, p.net_cap - 1)]
+            moh = (jnp.arange(p.net_cap)
+                   == event_idx.clip(0, p.net_cap - 1))      # [net_cap]
+            msg = jnp.sum(moh[:, None] * net, axis=0)
             occupied = msg[0] != SENTINEL
             ok = occupied
             if p.deliver_message is not None:
@@ -415,21 +507,24 @@ class TensorSearch:
                 p.step_message(nodes, msg))
             return nodes2, sends, new_timers, exc, None, ok
 
+        t_idx = event_idx - p.net_cap
+        t_node = t_idx // p.timer_cap
+        t_slot = t_idx % p.timer_cap
+        n_oh = jnp.arange(p.n_nodes) == t_node               # [NN]
+        s_oh = jnp.arange(p.timer_cap) == t_slot             # [T_CAP]
+
         def deliver_timer():
-            t_idx = event_idx - p.net_cap
-            node = t_idx // p.timer_cap
-            slot = t_idx % p.timer_cap
-            queue = timers[node]
-            ok = timer_deliverable_mask(queue)[slot]
+            queue = jnp.sum(n_oh[:, None, None] * timers, axis=0)
+            ok = jnp.sum(timer_deliverable_mask(queue) * s_oh) > 0
             if p.deliver_timer is not None:
-                ok = ok & p.deliver_timer(node)
-            timer = queue[slot]
+                ok = ok & p.deliver_timer(t_node)
+            timer = jnp.sum(s_oh[:, None] * queue, axis=0)
             nodes2, sends, new_timers, exc = _normalize_step(
-                p.step_timer(nodes, node, timer))
-            return nodes2, sends, new_timers, exc, (node, slot), ok
+                p.step_timer(nodes, t_node, timer))
+            return nodes2, sends, new_timers, exc, queue, ok
 
         m_nodes, m_sends, m_set, m_exc, _, m_ok = deliver_message()
-        t_nodes, t_sends, t_set, t_exc, (t_node, t_slot), t_ok = deliver_timer()
+        t_nodes, t_sends, t_set, t_exc, t_queue, t_ok = deliver_timer()
 
         nodes2 = jnp.where(is_msg, m_nodes, t_nodes)
         sends = jnp.where(is_msg, m_sends, t_sends)
@@ -442,11 +537,11 @@ class TensorSearch:
         # SearchState.java:218-222), but the state is terminal (run() ends).
 
         net2, net_over = insert_messages(net, sends)
-        timers2 = timers
-        # Firing consumes the timer (SearchState.java:357).
-        fired_q = remove_timer(timers[t_node], t_slot)
-        timers2 = jnp.where(is_msg, timers2,
-                            timers2.at[t_node].set(fired_q))
+        # Firing consumes the timer (SearchState.java:357); the updated
+        # queue lands via the node one-hot, never a dynamic scatter.
+        fired_q = remove_timer(t_queue, t_slot)
+        timers2 = jnp.where((~is_msg & n_oh)[:, None, None],
+                            fired_q[None], timers)
         timers2, t_over = append_timers(timers2, new_t)
         over = (net_over + t_over) * valid.astype(jnp.int32)
         succ = {"nodes": nodes2, "net": net2, "timers": timers2,
@@ -476,16 +571,24 @@ class TensorSearch:
         overflow = jnp.sum(overs * valids.astype(jnp.int32))
         fp = state_fingerprints(flat)
 
-        # In-chunk sort-unique on device: first occurrence of each 128-bit
-        # key among valid rows (invalid rows sort last and are never
-        # unique).  Cuts host dedup work before any readback.
-        inv = ~valids
-        order = jnp.lexsort((fp[:, 3], fp[:, 2], fp[:, 1], fp[:, 0], inv))
-        fps = fp[order]
-        vs = valids[order]
-        first = jnp.ones(fps.shape[0], bool).at[1:].set(
-            jnp.any(fps[1:] != fps[:-1], axis=1))
-        unique = jnp.zeros_like(vs).at[order].set(first & vs)
+        if getattr(self, "_in_chunk_dedup", True):
+            # In-chunk sort-unique on device: first occurrence of each
+            # 128-bit key among valid rows (invalid rows sort last and are
+            # never unique).  Cuts host dedup work before any readback.
+            inv = ~valids
+            order = jnp.lexsort((fp[:, 3], fp[:, 2], fp[:, 1], fp[:, 0],
+                                 inv))
+            fps = fp[order]
+            vs = valids[order]
+            first = jnp.ones(fps.shape[0], bool).at[1:].set(
+                jnp.any(fps[1:] != fps[:-1], axis=1))
+            unique = jnp.zeros_like(vs).at[order].set(first & vs)
+        else:
+            # Sharded path: the owner-side hash table (and its in-batch
+            # key sort) is the dedup authority — the prefilter sort here
+            # is redundant work; routing buckets are sized for the full
+            # successor count.
+            unique = valids
 
         flags = {}
         for kind, preds in (("inv", p.invariants), ("goal", p.goals),

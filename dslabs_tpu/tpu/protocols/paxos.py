@@ -92,8 +92,9 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
     # Exact static send/set row budgets (finalize() asserts the count at
     # trace time; a miscount fails loudly, never truncates).  Server rows:
     # req 2 + req-p2a (n-1) + p1a 1 + p1b [S(n-1) + S + (n-1)] + p2a 1 +
-    # p2b S + hb 2 + creq 1 + crep S.  Keeping this tight matters: every
-    # blank pad row rides through canonicalize_net's sort in the hot loop.
+    # p2b S + hb 2 + creq 1 + crep S.  Keeping this tight matters: the
+    # engine's set-insert merge is O(MAX_SENDS x NET_CAP) compares per
+    # (state, event) pair, so every blank pad row widens the hot loop.
     SRV_SENDS = 7 + 2 * (n - 1) + S * (n - 1) + 3 * S
     SRV_SETS = 2
     CLI_SENDS, CLI_SETS = n, 1
@@ -202,17 +203,37 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
     def _set(st, key, i, val):
         st[key] = st[key].at[i].set(jnp.asarray(val, jnp.int32))
 
+    # One-hot row access: every traced-index read/write below goes through
+    # these (select/sum over a static axis).  `.at[i, traced].set` /
+    # `row[traced]` lowered to per-pair dynamic gathers/scatters, which
+    # materialise at ~1 GB/s under the engine's flat vmap on TPU — the
+    # round-2 chunk-step bottleneck.  The leading index `i` is always a
+    # Python int (the per-node unroll), so `.at[i].set(row)` remains a
+    # static update.
+
+    def oh_get(row, idx, size):
+        """row [size, ...] or [size]; traced idx -> row[idx], 0 if out of
+        range."""
+        m = (jnp.arange(size) == idx)
+        return jnp.sum(m.reshape((size,) + (1,) * (row.ndim - 1)) * row,
+                       axis=0)
+
+    def oh_put(row, idx, size, val, cond):
+        """row with row[idx] = val where cond (no-op when idx out of
+        range)."""
+        m = (jnp.arange(size) == idx) & cond
+        mb = m.reshape((size,) + (1,) * (row.ndim - 1))
+        return jnp.where(mb, jnp.asarray(val, row.dtype), row)
+
     def log_get(st, i, slot):
         """slot is 1-based traced int; returns [4] = (exists, ballot, cmd,
-        chosen) with slot clamped into range (callers mask)."""
-        return st["log"][i][(slot - 1).clip(0, S - 1)]
+        chosen); all-zeros when out of range (callers mask)."""
+        return oh_get(st["log"][i], slot - 1, S)
 
     def log_set(st, i, slot, entry, cond):
-        idx = (slot - 1).clip(0, S - 1)
-        in_range = (slot >= 1) & (slot <= S) & cond
-        cur = st["log"][i][idx]
-        new = jnp.where(in_range, jnp.asarray(entry, jnp.int32), cur)
-        st["log"] = st["log"].at[i, idx].set(new)
+        row = oh_put(st["log"][i], slot - 1, S,
+                     jnp.asarray(entry, jnp.int32), cond)
+        st["log"] = st["log"].at[i].set(row)
 
     def exec_chain(st, i, sends: Sends, cond):
         """Execute contiguous chosen slots (paxos.py _execute_chosen),
@@ -226,10 +247,11 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
             has_cmd = can & (cmd != 0)
             cl = cmd_client(cmd).clip(0, NC - 1)
             sq = cmd_seq(cmd)
-            last = st["amo"][i][cl]
+            last = oh_get(st["amo"][i], cl, NC)
             reply = has_cmd & (sq >= last)
             newlast = jnp.where(has_cmd & (sq > last), sq, last)
-            st["amo"] = st["amo"].at[i, cl].set(newlast.astype(jnp.int32))
+            st["amo"] = st["amo"].at[i].set(
+                oh_put(st["amo"][i], cl, NC, newlast, has_cmd))
             sends.add(reply, REPLY, i, n + cl, [cl, sq])
         # Leader bookkeeping + GC (object: peer_executed[self]=exec; gc)
         is_leader = cond & (st["ld"][i] == 1) & (st["b"][i] % n == i)
@@ -276,10 +298,9 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
         e = log_get(st, i, slot)
         ok = (cond & (st["b"][i] == ballot)
               & (e[0] == 1) & (e[3] == 0) & (e[1] == ballot))
-        idx = (slot - 1).clip(0, S - 1)
-        cur = st["p2bv"][i][idx]
-        st["p2bv"] = st["p2bv"].at[i, idx].set(
-            jnp.where(ok, cur | (1 << i), cur).astype(jnp.int32))
+        row = st["p2bv"][i]
+        st["p2bv"] = st["p2bv"].at[i].set(jnp.where(
+            (jnp.arange(S) == slot - 1) & ok, row | (1 << i), row))
 
     def send_p2a(st, i, slot, sends: Sends, cond):
         """Broadcast P2a for log[slot] + inline self-accept/self-vote."""
@@ -335,7 +356,7 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
         is_req = here & (tag == REQ)
         client, seq = p[0], p[1]
         ci = client.clip(0, NC - 1)
-        amo_last = st["amo"][i][ci]
+        amo_last = oh_get(st["amo"][i], ci, NC)
         already = seq <= amo_last
         sends.add(is_req & already & (seq == amo_last), REPLY, i,
                   n + client, [client, seq])
@@ -344,13 +365,13 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
         fwd = (is_req & ~already & ~is_leader
                & ((frm == i) | (frm >= n)) & (believed != i))
         sends.add(fwd, REQ, i, believed, [client, seq])
-        prop = st["prop"][i][ci]
+        prop = oh_get(st["prop"][i], ci, NC)
         do_prop = is_req & ~already & is_leader & (seq > prop)
         slot = st["si"][i]
         in_range = slot <= S
         do_prop = do_prop & in_range
-        st["prop"] = st["prop"].at[i, ci].set(
-            jnp.where(do_prop, seq, prop).astype(jnp.int32))
+        st["prop"] = st["prop"].at[i].set(
+            oh_put(st["prop"][i], ci, NC, seq, do_prop))
         _set(st, "si", i, jnp.where(do_prop, slot + 1, slot))
         log_set(st, i, slot, [1, ballot, cmd_id(client, seq), 0], do_prop)
         send_p2a(st, i, slot, sends, do_prop)
@@ -372,12 +393,10 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
         accept_vote = (is_p1b & (vb == st["b"][i])
                        & (st["b"][i] % n == i)
                        & (st["ld"][i] == 0))
-        fi = frm.clip(0, n - 1)
         vrec = jnp.concatenate([jnp.ones((1,), jnp.int32),
                                 p[1:1 + 4 * S].astype(jnp.int32)])
-        cur_v = st["votes"][i][fi]
-        st["votes"] = st["votes"].at[i, fi].set(
-            jnp.where(accept_vote, vrec, cur_v))
+        st["votes"] = st["votes"].at[i].set(
+            oh_put(st["votes"][i], frm, n, vrec, accept_vote))
         nvotes = jnp.sum(st["votes"][i][:, 0])
         win = accept_vote & (nvotes >= maj)
         _p1b_win(st, i, win, sends, sets)
@@ -400,13 +419,13 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
                    & (st["ld"][i] == 1) & (st["b"][i] % n == i))
         e = log_get(st, i, bslot)
         count_ok = lead_ok & (e[0] == 1) & (e[3] == 0) & (e[1] == bb)
-        bidx = (bslot - 1).clip(0, S - 1)
-        vmask = st["p2bv"][i][bidx]
+        vmask = oh_get(st["p2bv"][i], bslot - 1, S)
         vmask2 = jnp.where(count_ok, vmask | (1 << frm.clip(0, n - 1)),
                            vmask)
         chosen_now = count_ok & (_popcount(vmask2) >= maj)
-        st["p2bv"] = st["p2bv"].at[i, bidx].set(
-            jnp.where(chosen_now, 0, vmask2).astype(jnp.int32))
+        st["p2bv"] = st["p2bv"].at[i].set(oh_put(
+            st["p2bv"][i], bslot - 1, S,
+            jnp.where(chosen_now, 0, vmask2), count_ok))
         log_set(st, i, bslot, [1, e[1], e[2], 1], chosen_now)
         exec_chain(st, i, sends, chosen_now)
 
@@ -428,11 +447,9 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
         rb, rexec = p[0], p[1]
         hbr_ok = (is_hbr & (rb == st["b"][i])
                   & (st["ld"][i] == 1) & (st["b"][i] % n == i))
-        pfi = frm.clip(0, n - 1)
-        pcur = st["peer"][i][pfi]
-        st["peer"] = st["peer"].at[i, pfi].set(
-            jnp.where(hbr_ok, jnp.maximum(pcur, rexec),
-                      pcur).astype(jnp.int32))
+        pcur = oh_get(st["peer"][i], frm, n)
+        st["peer"] = st["peer"].at[i].set(oh_put(
+            st["peer"][i], frm, n, jnp.maximum(pcur, rexec), hbr_ok))
         mask = st["pm"][i]
         _set(st, "pm", i,
              jnp.where(hbr_ok, mask | (1 << frm.clip(0, n - 1)), mask))

@@ -31,6 +31,7 @@ through the all_to_all, not a reserved fingerprint value).
 
 from __future__ import annotations
 
+import os
 import time
 from typing import List, Optional
 
@@ -48,6 +49,8 @@ __all__ = ["ShardedTensorSearch", "make_mesh"]
 
 OVERFLOW_FACTOR = 2
 MAXU32 = np.uint32(0xFFFFFFFF)
+# Dev: print per-level wall time / chunk rate from run().
+_LEVEL_TIMING = bool(os.environ.get("DSLABS_LEVEL_TIMING"))
 
 
 def make_mesh(n_devices: int = None, axis: str = "search") -> Mesh:
@@ -109,6 +112,14 @@ class ShardedTensorSearch(TensorSearch):
         self.f_cap = frontier_cap          # per device
         self.v_cap = visited_cap           # per device
         self.cpd = chunk_per_device
+        # The owner-side hash table is the dedup authority, so the
+        # engine's in-chunk sort-unique prefilter is redundant work — but
+        # without it, duplicate successors (all sharing one fingerprint,
+        # hence one owner) can pile onto a single fixed-size routing
+        # bucket.  strict mode must never abort a search the dedup'd
+        # path would complete, so it keeps the prefilter; bench runs
+        # (strict=False, drops tolerated) skip it for throughput.
+        self._in_chunk_dedup = strict
         super().__init__(protocol, frontier_cap=frontier_cap,
                          chunk=chunk_per_device, max_depth=max_depth,
                          max_secs=max_secs)
@@ -121,6 +132,27 @@ class ShardedTensorSearch(TensorSearch):
         self._chunk_step = jax.jit(self._build_chunk_step(),
                                    donate_argnums=0)
         self._finish_level = jax.jit(self._build_finish(), donate_argnums=0)
+
+        # ONE fused scalar vector per host sync: each device->host readback
+        # over the runtime tunnel costs ~25 ms, and the naive sync did six
+        # (round-2 profile: 152 ms/level of pure readback latency).
+        nf = len(self._flag_names)
+
+        def stats(carry):
+            return jnp.concatenate([
+                jnp.asarray([
+                    jnp.sum(carry["overflow"]),
+                    jnp.sum(carry["drops"]),
+                    jnp.sum(carry["explored"]),
+                    jnp.max(carry["vis_n"]),
+                    jnp.sum(carry["vis_n"]),
+                    jnp.max(carry["nxt_n"]),
+                ], jnp.int32),
+                jnp.sum(carry["flag_cnt"].reshape(self.n_devices, nf),
+                        axis=0).astype(jnp.int32),
+            ])
+
+        self._stats = jax.jit(stats)
 
     # ------------------------------------------------------------- helpers
 
@@ -183,41 +215,39 @@ class ShardedTensorSearch(TensorSearch):
             for n in p.prunes:
                 pruned = pruned | flags[f"prune:{n}"]
 
-            # ---- ownership routing (explicit validity mask, no sentinel
-            # fingerprint overloading)
+            # ---- ownership routing: exchange FINGERPRINTS ONLY, never
+            # state rows.  Successor rows stay on the device that produced
+            # them; owners deduplicate the 16-byte keys and return a fresh
+            # flag via a second (reverse) all_to_all.  Any cross-row
+            # permutation of the [B, lanes] successor matrix — gather or
+            # scatter — measured ~2 GB/s effective (137 ms per chunk, 80%
+            # of the level step) in the round-2 bisection, and the key
+            # exchange also cuts ICI traffic by the full lane width
+            # (1354 lanes -> 4).  Successors sorted by owner form
+            # contiguous segments, so the [D, bucket] key buckets are
+            # narrow gathers at segment offsets.
             owner = (fp[:, 0] % jnp.uint32(D)).astype(jnp.int32)
             owner = jnp.where(unique, owner, D)     # non-unique -> nowhere
             order = jnp.argsort(owner, stable=True)
             owner_s = owner[order]
-            idx_in_bucket = jnp.arange(owner_s.shape[0]) - jnp.searchsorted(
-                owner_s, owner_s, side="left")
-            fits = (owner_s < D) & (idx_in_bucket < bucket)
-            route_drop = jnp.sum((owner_s < D) & ~fits).astype(jnp.int32)
-            dst = jnp.where(fits, owner_s, 0)
-            slot = jnp.where(fits, idx_in_bucket, bucket)
-            send_rows = jnp.zeros((D, bucket + 1, lanes), rows.dtype)
-            send_keys = jnp.zeros((D, bucket + 1, 4), jnp.uint32)
-            send_valid = jnp.zeros((D, bucket + 1), bool)
-            send_pruned = jnp.zeros((D, bucket + 1), bool)
-            send_rows = send_rows.at[dst, slot].set(rows[order])
-            send_keys = send_keys.at[dst, slot].set(fp[order])
-            send_valid = send_valid.at[dst, slot].set(fits)
-            send_pruned = send_pruned.at[dst, slot].set(pruned[order])
-            send_rows, send_keys = send_rows[:, :bucket], send_keys[:, :bucket]
-            send_valid, send_pruned = (send_valid[:, :bucket],
-                                       send_pruned[:, :bucket])
+            dev = jnp.arange(D)
+            starts = jnp.searchsorted(owner_s, dev, side="left")
+            ends = jnp.searchsorted(owner_s, dev, side="right")
+            src = starts[:, None] + jnp.arange(bucket)[None, :]  # [D, bkt]
+            send_valid = src < ends[:, None]
+            gidx = order[src.clip(0, owner.shape[0] - 1)]  # [D, bkt] row idx
+            send_keys = fp[gidx.reshape(-1)].reshape(D, bucket, 4)
+            counts = ends - starts
+            route_drop = jnp.sum(jnp.maximum(counts - bucket, 0)).astype(
+                jnp.int32)
 
-            # ---- the exchange: every device receives the bucket destined
-            # to it from every other device (ICI all_to_all)
-            recv_rows = jax.lax.all_to_all(send_rows, ax, 0, 0)
+            # ---- the exchange: every device receives the key bucket
+            # destined to it from every other device (ICI all_to_all)
             recv_keys = jax.lax.all_to_all(send_keys, ax, 0, 0)
             recv_valid = jax.lax.all_to_all(send_valid, ax, 0, 0)
-            recv_pruned = jax.lax.all_to_all(send_pruned, ax, 0, 0)
             rb = D * bucket
-            recv_rows = recv_rows.reshape(rb, lanes)
             recv_keys = jnp.where(recv_valid.reshape(rb, 1),
                                   recv_keys.reshape(rb, 4), MAXU32)
-            recv_pruned = recv_pruned.reshape(rb)
             recv_valid = recv_valid.reshape(rb)
 
             # ---- owner-side dedup via an open-addressing hash table in
@@ -283,14 +313,26 @@ class ShardedTensorSearch(TensorSearch):
             vis_drop = jnp.sum(~resolved).astype(jnp.int32)
             n_fresh = jnp.sum(fresh_s).astype(jnp.int32)
 
-            # ---- append fresh, un-pruned successors to the next frontier
-            # (undo the in-batch sort permutation to realign with rows)
+            # ---- return each key's fresh flag to its producer (undo the
+            # in-batch sort, reverse all_to_all — an involution on the
+            # leading axis) and map it back onto the producer's local
+            # successor rows.  Narrow bool scatters only; `.max` (boolean
+            # or) so the clipped dump writes of invalid slots can never
+            # clobber a true flag.
             fresh = jnp.zeros(rb, bool).at[bo].set(fresh_s)
-            sel = fresh & ~recv_pruned
+            fresh_back = jax.lax.all_to_all(
+                fresh.reshape(D, bucket), ax, 0, 0)
+            fresh_rows = jnp.zeros(owner.shape[0], bool).at[
+                gidx.reshape(-1)].max(
+                fresh_back.reshape(-1) & send_valid.reshape(-1))
+
+            # ---- append fresh, un-pruned successors (still in producer
+            # order, no row permutation) to the local next frontier
+            sel = fresh_rows & ~pruned
             spos = jnp.cumsum(sel) - 1
             nxt, nxt_n = carry["nxt"], carry["nxt_n"][0]
             sdst = jnp.where(sel & (nxt_n + spos < F), nxt_n + spos, F)
-            nxt = nxt.at[sdst].set(recv_rows)
+            nxt = nxt.at[sdst].set(rows)
             n_sel = jnp.sum(sel).astype(jnp.int32)
             frontier_drop = jnp.maximum(nxt_n + n_sel - F, 0)
             # Occupancy counts only rows that actually landed (<= F), else
@@ -432,6 +474,7 @@ class ShardedTensorSearch(TensorSearch):
                     return self._limit_outcome("TIME_EXHAUSTED", carry,
                                                depth, t0)
                 depth += 1
+                t_lvl = time.time()
                 n_chunks = -(-max_n // self.cpd)
                 for j in range(n_chunks):
                     carry = self._chunk_step(carry, jnp.int32(j))
@@ -442,18 +485,23 @@ class ShardedTensorSearch(TensorSearch):
                     # processed is never masked by TIME_EXHAUSTED.
                     if (self.max_secs is not None and j + 1 < n_chunks
                             and time.time() - t0 > self.max_secs):
-                        out, _, _, drops = self._sync_checks(carry, depth,
-                                                             t0)
+                        out, _, _, drops, _ = self._sync_checks(carry,
+                                                                depth, t0)
                         if out is not None:
                             return out
                         return self._limit_outcome("TIME_EXHAUSTED", carry,
                                                    depth, t0)
                 # ---- the one host sync per level
-                out, explored, vis_total, drops = self._sync_checks(
+                out, explored, vis_total, drops, max_n = self._sync_checks(
                     carry, depth, t0)
                 if out is not None:
                     return out
-                max_n = int(np.asarray(carry["nxt_n"]).max())
+                if _LEVEL_TIMING:
+                    dt = time.time() - t_lvl
+                    print(f"[level {depth}] chunks={n_chunks} "
+                          f"dt={dt:.2f}s chunk={dt/max(n_chunks,1)*1e3:.1f}ms "
+                          f"explored={explored} unique={vis_total} "
+                          f"next={max_n}", flush=True)
                 carry = self._finish_level(carry)
 
             return SearchOutcome(
@@ -463,37 +511,39 @@ class ShardedTensorSearch(TensorSearch):
     def _sync_checks(self, carry, depth, t0):
         """The per-sync check pipeline: semantic overflow (raise) ->
         strict-mode drops (raise) -> terminal flags (checkState order) ->
-        visited load factor (raise).  Returns
-        (outcome_or_none, explored, vis_total, drops)."""
-        overflow = int(np.asarray(carry["overflow"]).sum())
+        visited load factor (raise).  ONE device->host readback (the fused
+        ``_stats`` vector); the expensive flag-row readback happens only
+        when a terminal flag actually fired.  Returns
+        (outcome_or_none, explored, vis_total, drops, nxt_max)."""
+        s = np.asarray(self._stats(carry))
+        overflow, drops, explored, vis_max, vis_total, nxt_max = (
+            int(x) for x in s[:6])
+        flag_counts = s[6:]
         if overflow:
             raise CapacityOverflow(
                 f"{self.p.name}: {overflow} semantic drops at depth "
                 f"{depth} (net_cap/timer_cap or visited cap "
                 f"{self.v_cap}/device overflowed; raise the caps)")
-        drops = int(np.asarray(carry["drops"]).sum())
         if drops and self.strict:
             raise CapacityOverflow(
                 f"{self.p.name}: {drops} capacity drops at depth "
                 f"{depth} (routing bucket or frontier cap "
                 f"{self.f_cap}/device; raise caps or run "
                 f"strict=False for beam-style truncation)")
-        vis_counts = np.asarray(carry["vis_n"])
-        explored = int(np.asarray(carry["explored"]).sum())
-        vis_total = int(vis_counts.sum())
         # Terminal flags before the load-factor guard: a violation/goal
         # found this level is a valid verdict even if the table is full.
-        out = self._terminal_from_flags(carry, explored, vis_total,
-                                        depth, t0)
-        if out is not None:
-            out.dropped = drops
-            return out, explored, vis_total, drops
-        if vis_counts.max() > 3 * self.v_cap // 4:
+        if flag_counts.any():
+            out = self._terminal_from_flags(carry, explored, vis_total,
+                                            depth, t0)
+            if out is not None:
+                out.dropped = drops
+                return out, explored, vis_total, drops, nxt_max
+        if vis_max > 3 * self.v_cap // 4:
             raise CapacityOverflow(
                 f"{self.p.name}: visited hash table > 75% full "
-                f"({int(vis_counts.max())}/{self.v_cap} per device) "
+                f"({vis_max}/{self.v_cap} per device) "
                 f"at depth {depth}; raise visited_cap")
-        return None, explored, vis_total, drops
+        return None, explored, vis_total, drops, nxt_max
 
     def _limit_outcome(self, cond, carry, depth, t0):
         return SearchOutcome(

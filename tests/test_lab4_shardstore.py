@@ -72,6 +72,12 @@ def test_key_to_shard():
     s = key_to_shard("foo", 10)
     assert 1 <= s <= 10
     assert key_to_shard("foo", 10) == s  # deterministic
+    # 10+ trailing digits overflow Java's 32-bit int accumulation
+    # (ShardStoreNode.java keyToShard: hash = hash*10 + digit in int
+    # arithmetic); 12345678901 wraps to -539222987, mod 10 -> 3.
+    assert key_to_shard("x12345678901", 10) == 3
+    # 4294967296 == 2^32 wraps to exactly 0 -> mod adjusts to numShards.
+    assert key_to_shard("k4294967296", 10) == 10
 
 
 # ------------------------------------------------------------- run fixtures

@@ -1,0 +1,41 @@
+"""Sharded multi-chip BFS: verdict + unique-state parity vs the
+single-device engine on the 8-device virtual CPU mesh (conftest.py).
+
+Both configurations run to exhaustion (pruned space / depth limit), so
+unique-state counts are exploration-order independent and must match the
+single-device engine exactly — any routing/dedup-return regression in the
+fingerprint-exchange path (sharded.py) shows up as a count mismatch.
+"""
+
+import dataclasses
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from dslabs_tpu.tpu.engine import TensorSearch
+from dslabs_tpu.tpu.protocols.pingpong import make_pingpong_protocol
+from dslabs_tpu.tpu.sharded import ShardedTensorSearch, make_mesh
+
+
+def _pruned_pingpong():
+    pp = make_pingpong_protocol(workload_size=2)
+    return dataclasses.replace(
+        pp, goals={}, prunes={"CLIENTS_DONE": pp.goals["CLIENTS_DONE"]})
+
+
+@pytest.mark.parametrize("strict", [True, False])
+def test_sharded_exhaustive_parity(strict):
+    """SPACE_EXHAUSTED verdict and exact unique counts, both with the
+    in-chunk dedup prefilter (strict) and with owner-side-only dedup
+    (bench mode, strict=False)."""
+    proto = _pruned_pingpong()
+    mesh = make_mesh(8)
+    single = TensorSearch(proto, chunk=64).run()
+    sharded = ShardedTensorSearch(
+        proto, mesh, chunk_per_device=16, frontier_cap=1 << 8,
+        visited_cap=1 << 10, strict=strict).run()
+    assert sharded.end_condition == single.end_condition == "SPACE_EXHAUSTED"
+    assert sharded.unique_states == single.unique_states
+    assert sharded.states_explored == single.states_explored
+    assert sharded.dropped == 0

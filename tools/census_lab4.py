@@ -1,0 +1,76 @@
+"""Dev tool: enumerate the object-checker event space for the lab4
+test10 config (1 group, 1 server, 1 master, joined, CCA+master frozen) to
+ground the tensor twin's message/timer schema."""
+
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+from collections import Counter
+
+from dslabs_tpu.core.address import LocalAddress
+from dslabs_tpu.search.search_state import SearchState
+from dslabs_tpu.search.settings import SearchSettings
+from dslabs_tpu.testing.predicates import RESULTS_OK, CLIENTS_DONE
+
+import tests.test_lab4_shardstore as t
+
+
+def main():
+    state = t.make_search(1, 1, 1, 10)
+    joined = t._joined_state(state, 1)
+    from dslabs_tpu.labs.clientserver.kv_workload import kv_workload
+    joined.add_client_worker(
+        LocalAddress("client1"),
+        kv_workload(["PUT:foo:bar", "GET:foo"], ["PutOk", "bar"]))
+
+    settings = SearchSettings().max_time(240)
+    settings.add_invariant(RESULTS_OK)
+    settings.node_active(t.CCA, False)
+    settings.deliver_timers(t.CCA, False)
+    settings.deliver_timers(t.shard_master(1), False)
+
+    print("=== nodes:", sorted(str(a) for a in joined.addresses()))
+    # BFS by hand, collecting event signatures
+    frontier = [joined]
+    seen = {joined.search_equivalence_key()}
+    msg_types = Counter()
+    timer_types = Counter()
+    examples = {}
+    for depth in range(5):
+        nxt = []
+        for s in frontier:
+            for ev in s.events(settings):
+                if hasattr(ev, "message"):
+                    k = (type(ev.message).__name__, str(ev.frm),
+                         str(ev.to))
+                    inner = getattr(ev.message, "command", None) or getattr(
+                        ev.message, "result", None)
+                    k = k + (type(inner).__name__ if inner else "",)
+                    msg_types[k] += 1
+                    examples.setdefault(k, ev.message)
+                else:
+                    k = (type(ev.timer).__name__, str(ev.to))
+                    timer_types[k] += 1
+                    examples.setdefault(k, ev.timer)
+                s2 = s.step_event(ev, settings)
+                if s2 is None:
+                    continue
+                key = s2.search_equivalence_key()
+                if key not in seen:
+                    seen.add(key)
+                    nxt.append(s2)
+        frontier = nxt
+        print(f"depth {depth+1}: frontier={len(frontier)} seen={len(seen)}")
+
+    print("\n=== message event signatures (type, from, to, payload type):")
+    for k, c in sorted(msg_types.items()):
+        print(f"  {c:5d}  {k}")
+        print(f"         e.g. {examples[k]}")
+    print("\n=== timer event signatures:")
+    for k, c in sorted(timer_types.items()):
+        print(f"  {c:5d}  {k}")
+        print(f"         e.g. {examples[k]}")
+
+
+if __name__ == "__main__":
+    main()

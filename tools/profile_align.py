@@ -1,0 +1,54 @@
+"""Self-feeding (dependency-chained) microbenchmark: wide row scatter /
+gather cost vs lane alignment. Dev tool."""
+
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+import jax.numpy as jnp
+
+B, F = 24064, 65537
+
+
+def run(lanes):
+    key = jax.random.PRNGKey(0)
+    rows = jax.random.randint(key, (B, lanes), 0, 1000, jnp.int32)
+    nxt = jnp.zeros((F, lanes), jnp.int32)
+    sdst = jax.random.permutation(key, F)[:B]
+    gidx = jax.random.randint(key, (B,), 0, F, jnp.int32)
+
+    @jax.jit
+    def scatter_step(nxt, rows):
+        nxt = nxt.at[sdst].set(rows)
+        # feed back: rows depend on nxt so iterations serialize
+        rows = rows + nxt[0, 0]
+        return nxt, rows
+
+    @jax.jit
+    def gather_step(nxt, rows):
+        g = nxt[gidx]                      # [B, lanes] wide gather
+        rows = rows + g
+        nxt = nxt + rows[0, 0]
+        return nxt, rows
+
+    for name, fn in (("scatter", scatter_step), ("gather", gather_step)):
+        n2, r2 = fn(nxt, rows)
+        jax.block_until_ready(r2)
+        t0 = time.time()
+        n2, r2 = nxt, rows
+        iters = 10
+        for _ in range(iters):
+            n2, r2 = fn(n2, r2)
+        jax.block_until_ready(r2)
+        dt = (time.time() - t0) / iters
+        gb = B * lanes * 4 / 1e9
+        print(f"lanes={lanes:5d} {name:8s} {dt*1e3:9.2f} ms "
+              f"({gb/dt:7.1f} GB/s eff)")
+
+
+if __name__ == "__main__":
+    for lanes in ([int(x) for x in sys.argv[1:]] or [1354, 1408, 1280]):
+        run(lanes)

@@ -1,0 +1,120 @@
+"""Decompose the TPU chunk-step cost: which stage dominates?
+
+Times jitted sub-programs of the bench configuration's expand pipeline on
+whatever accelerator is present. Not part of the test suite — a dev tool.
+"""
+
+import time
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+import jax.numpy as jnp
+
+from dslabs_tpu.tpu.engine import (TensorSearch, canonicalize_net,
+                                   insert_messages, state_fingerprints,
+                                   append_timers, flatten_state)
+from dslabs_tpu.tpu.protocols.paxos import make_paxos_protocol
+
+
+def bench_fn(name, fn, *args, iters=5):
+    fn = jax.jit(fn)
+    t0 = time.time()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    print(f"{name:40s} compile+1st {time.time()-t0:6.1f} s")
+    t0 = time.time()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.time() - t0) / iters
+    print(f"{name:40s} {dt*1e3:9.2f} ms")
+    return dt
+
+
+def main():
+    protocol = make_paxos_protocol(n=3, n_clients=2, w=1, max_slots=3,
+                                   net_cap=64, timer_cap=6)
+    C = 256
+    search = TensorSearch(protocol, chunk=C)
+    state = search.initial_state()
+    chunk_state = jax.tree.map(lambda x: jnp.repeat(x, C, axis=0), state)
+    chunk_valid = jnp.ones(C, bool)
+    ne = search._num_events()
+    n_pairs = C * ne
+    print(f"chunk={C} events/state={ne} pairs={n_pairs} "
+          f"lanes={flatten_state(state).shape[1]}")
+
+    # full expand
+    dt = bench_fn("full _expand_chunk", search._expand_chunk,
+                  chunk_state, chunk_valid)
+    print(f"  -> {n_pairs/dt:,.0f} explored pairs/s")
+
+    # pieces, over the flattened pair batch
+    rep_state = jax.tree.map(lambda x: jnp.repeat(x, ne, axis=0), chunk_state)
+    ev = jnp.tile(jnp.arange(ne), C)
+
+    def step_only(rs, e):
+        return jax.vmap(search._step_one)(rs, e)
+
+    dt = bench_fn("vmapped _step_one (incl. insert/append)", step_only,
+                  rep_state, ev)
+
+    p = protocol
+    sends = jnp.full((n_pairs, p.max_sends, p.msg_width), 2**31 - 1,
+                     jnp.int32)
+
+    def ins_only(net, s):
+        return jax.vmap(insert_messages)(net, s)
+
+    dt = bench_fn("insert_messages alone", ins_only, rep_state["net"], sends)
+
+    def canon_only(net):
+        return jax.vmap(canonicalize_net)(net)
+
+    bench_fn("canonicalize_net alone", canon_only, rep_state["net"])
+
+    new_t = jnp.full((n_pairs, p.max_sets, 1 + p.timer_width), 2**31 - 1,
+                     jnp.int32)
+
+    def app_only(t, nt):
+        return jax.vmap(append_timers)(t, nt)
+
+    bench_fn("append_timers alone", app_only, rep_state["timers"], new_t)
+
+    def fp_only(rs):
+        return state_fingerprints(rs)
+
+    bench_fn("state_fingerprints alone", fp_only, rep_state)
+
+    # the in-chunk lexsort
+    fp = state_fingerprints(rep_state)
+
+    def sort_only(fp, valids):
+        inv = ~valids
+        order = jnp.lexsort((fp[:, 3], fp[:, 2], fp[:, 1], fp[:, 0], inv))
+        fps = fp[order]
+        first = jnp.ones(fps.shape[0], bool).at[1:].set(
+            jnp.any(fps[1:] != fps[:-1], axis=1))
+        return jnp.zeros_like(valids).at[order].set(first & valids)
+
+    bench_fn("in-chunk lexsort+unique", sort_only, fp,
+             jnp.ones(n_pairs, bool))
+
+    # predicate flags
+    flat_all = jax.vmap(search._step_one)(rep_state, ev)[0]
+
+    def flags_only(flat):
+        out = {}
+        for kind, preds in (("inv", p.invariants), ("goal", p.goals),
+                            ("prune", p.prunes)):
+            for name, fn in preds.items():
+                out[f"{kind}:{name}"] = jax.vmap(fn)(flat)
+        return out
+
+    bench_fn("predicate flags alone", flags_only, flat_all)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+"""Time the sharded chunk step end-to-end and in pieces on the current
+accelerator. Dev tool, not part of the test suite."""
+
+import time
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+import jax.numpy as jnp
+import numpy as np
+
+from dslabs_tpu.tpu.protocols.paxos import make_paxos_protocol
+from dslabs_tpu.tpu.sharded import ShardedTensorSearch, make_mesh
+
+
+def main():
+    protocol = make_paxos_protocol(n=3, n_clients=2, w=1, max_slots=3,
+                                   net_cap=64, timer_cap=6)
+    mesh = make_mesh(len(jax.devices()))
+    search = ShardedTensorSearch(
+        protocol, mesh, chunk_per_device=256,
+        frontier_cap=1 << 16, visited_cap=1 << 21, max_depth=1,
+        strict=False)
+    state = search.initial_state()
+    with mesh:
+        carry = search._init_carry(state)
+        t0 = time.time()
+        carry = search._chunk_step(carry, jnp.int32(0))
+        jax.block_until_ready(carry["nxt_n"])
+        print(f"chunk_step compile+1st {time.time()-t0:6.1f}s")
+
+        # steady state: run 20 chunk steps back to back (j=0 each time; the
+        # work is shape-identical regardless of occupancy)
+        iters = 20
+        t0 = time.time()
+        for _ in range(iters):
+            carry = search._chunk_step(carry, jnp.int32(0))
+        jax.block_until_ready(carry["nxt_n"])
+        dt = (time.time() - t0) / iters
+        print(f"chunk_step steady {dt*1e3:9.2f} ms")
+
+        t0 = time.time()
+        carry = search._finish_level(carry)
+        jax.block_until_ready(carry["nxt_n"])
+        print(f"finish_level compile+1st {time.time()-t0:6.1f}s")
+        t0 = time.time()
+        for _ in range(5):
+            carry = search._finish_level(carry)
+        jax.block_until_ready(carry["nxt_n"])
+        print(f"finish_level steady {(time.time()-t0)/5*1e3:9.2f} ms")
+
+        # host-sync cost per level
+        t0 = time.time()
+        for _ in range(5):
+            _ = int(np.asarray(carry["overflow"]).sum())
+            _ = int(np.asarray(carry["drops"]).sum())
+            _ = np.asarray(carry["vis_n"])
+            _ = int(np.asarray(carry["explored"]).sum())
+            _ = np.asarray(carry["flag_cnt"])
+            _ = int(np.asarray(carry["nxt_n"]).max())
+        print(f"host sync steady {(time.time()-t0)/5*1e3:9.2f} ms")
+
+
+if __name__ == "__main__":
+    main()
