@@ -30,16 +30,25 @@ import traceback
 BASELINE_STATES_PER_MIN = 1e8
 
 # (chunk_per_device, frontier_cap, visited_cap) — per device.  The
-# 256-chunk rung leads: it both compiles fastest and measured the highest
-# throughput on a v5e (126k states/min vs 111k at 1024 — throughput is
-# canonicalisation-bound, not dispatch-bound, so bigger chunks only add
-# compile time and HBM pressure).
+# 256-chunk rung leads because it reliably fits the rung timeout
+# (compile ~140 s cold); the 512 rung measured ~13% higher throughput
+# (647k vs 574k states/min on a v5e) but compiles ~470 s cold, so it
+# runs as an UPGRADE attempt after a success rather than as the lead —
+# the bench reports the best successful rung.
 LADDER = [
-    (256, 1 << 16, 1 << 21),
-    (256, 1 << 14, 1 << 20),   # degraded caps if the big rung OOMs
+    (256, 1 << 16, 1 << 22),   # visited 4M keys/device (64 MB): the rate
+                               # saturated a 2M table before the 120 s
+                               # budget once the goal-exit was removed
+    (256, 1 << 14, 1 << 21),   # degraded caps if the big rung OOMs
     (64, 1 << 12, 1 << 18),
 ]
+UPGRADE_LADDER = [
+    (512, 1 << 17, 1 << 22),
+]
 RUNG_TIMEOUT_SECS = 540.0
+# The 512 program compiles ~470 s cold; 540 s could never fit compile +
+# 120 s measurement, so the upgrade attempt gets its own budget.
+UPGRADE_TIMEOUT_SECS = 780.0
 
 
 def _run_rung(chunk_per_device: int, frontier_cap: int, visited_cap: int,
@@ -54,9 +63,15 @@ def _run_rung(chunk_per_device: int, frontier_cap: int, visited_cap: int,
     from dslabs_tpu.tpu.protocols.paxos import make_paxos_protocol
     from dslabs_tpu.tpu.sharded import ShardedTensorSearch, make_mesh
 
+    import dataclasses
+
     # Two clients widen the space enough to sustain large frontiers.
+    # Goals are stripped: the bench measures sustained exploration
+    # throughput, and a lucky beam hitting CLIENTS_DONE mid-run would end
+    # it early with a run-dependent rate.
     protocol = make_paxos_protocol(n=3, n_clients=2, w=1, max_slots=3,
                                    net_cap=64, timer_cap=6)
+    protocol = dataclasses.replace(protocol, goals={})
     mesh = make_mesh(len(jax.devices()))
     search = ShardedTensorSearch(
         protocol, mesh, chunk_per_device=chunk_per_device,
@@ -93,28 +108,46 @@ def _probe_platform() -> tuple:
         return ("unknown", 0)
 
 
+def _try_rung(chunk, f_cap, v_cap, max_secs, timeout=RUNG_TIMEOUT_SECS):
+    """Run one ladder rung in a subprocess; (result dict, None) on
+    success, (None, error string) otherwise."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--rung",
+             str(chunk), str(f_cap), str(v_cap), str(max_secs)],
+            capture_output=True, text=True, timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        if proc.returncode == 0:
+            return json.loads(proc.stdout.strip().splitlines()[-1]), None
+        tail = (proc.stderr or proc.stdout).strip().splitlines()
+        return None, (tail[-1][:300] if tail
+                      else f"rung chunk={chunk} exited rc={proc.returncode} "
+                           "with no output")
+    except subprocess.TimeoutExpired:
+        return None, f"rung chunk={chunk} timed out after {timeout}s"
+    except Exception:
+        return None, traceback.format_exc(
+            limit=2).strip().splitlines()[-1][:300]
+
+
 def main() -> None:
     platform, n_dev = _probe_platform()
     max_secs = 120.0 if platform != "cpu" else 45.0
     best, err = None, None
     for chunk, f_cap, v_cap in LADDER:
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--rung",
-                 str(chunk), str(f_cap), str(v_cap), str(max_secs)],
-                capture_output=True, text=True, timeout=RUNG_TIMEOUT_SECS,
-                cwd=os.path.dirname(os.path.abspath(__file__)))
-            if proc.returncode == 0:
-                best = json.loads(proc.stdout.strip().splitlines()[-1])
-                break
-            tail = (proc.stderr or proc.stdout).strip().splitlines()
-            err = (tail[-1][:300] if tail
-                   else f"rung chunk={chunk} exited rc={proc.returncode} "
-                        "with no output")
-        except subprocess.TimeoutExpired:
-            err = f"rung chunk={chunk} timed out after {RUNG_TIMEOUT_SECS}s"
-        except Exception:
-            err = traceback.format_exc(limit=2).strip().splitlines()[-1][:300]
+        best, err = _try_rung(chunk, f_cap, v_cap, max_secs)
+        if best is not None:
+            break
+    if best is not None and platform != "cpu":
+        # A safe number is in hand — attempt the bigger-chunk upgrade and
+        # keep whichever measured higher.  (The upgrade's economics — a
+        # ~470 s compile buying ~13% throughput — only make sense on a
+        # real accelerator; CPU runs are a smoke test.)
+        for chunk, f_cap, v_cap in UPGRADE_LADDER:
+            up, _ = _try_rung(chunk, f_cap, v_cap, max_secs,
+                              timeout=UPGRADE_TIMEOUT_SECS)
+            if up is not None and up["value"] > best["value"]:
+                best = up
     value = best["value"] if best else 0.0
     result = {
         "metric": ("lab3-paxos BFS unique states/min "

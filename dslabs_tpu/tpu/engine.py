@@ -444,13 +444,19 @@ class TensorSearch:
                  chunk: int = 1 << 12,
                  max_depth: Optional[int] = None,
                  max_secs: Optional[float] = None,
-                 record_trace: bool = False):
+                 record_trace: bool = False,
+                 in_chunk_dedup: bool = True):
         self.p = protocol
         self.frontier_cap = frontier_cap
         self.chunk = chunk
         self.max_depth = max_depth
         self.max_secs = max_secs
         self.record_trace = record_trace
+        # When False, _expand_chunk marks every valid successor unique and
+        # dedup is entirely the caller's job — only meaningful for drivers
+        # with their own dedup authority (the sharded engine's owner-side
+        # hash table); the base run() loop REQUIRES the prefilter.
+        self._in_chunk_dedup = in_chunk_dedup
         # Per-level (parent row, event id) spill for trace reconstruction
         # (SURVEY §8.1; SearchState.java:361-474). Populated by run() when
         # record_trace is set; consumed by tpu/trace.py.
@@ -571,7 +577,7 @@ class TensorSearch:
         overflow = jnp.sum(overs * valids.astype(jnp.int32))
         fp = state_fingerprints(flat)
 
-        if getattr(self, "_in_chunk_dedup", True):
+        if self._in_chunk_dedup:
             # In-chunk sort-unique on device: first occurrence of each
             # 128-bit key among valid rows (invalid rows sort last and are
             # never unique).  Cuts host dedup work before any readback.

@@ -1,0 +1,317 @@
+"""Tensorised twin of lab 4's sharded KV store for the search-test
+configurations (ShardStorePart1Test.java:test10-12 shape): G groups of ONE
+server each, one shard master, one client, a static post-Join config, the
+config controller and master timers frozen (tests/test_lab4_shardstore.py
+test10-12 mirror these settings from ShardStoreBaseTest.java:209-220).
+
+Why the state collapses so far (all against the object implementations in
+dslabs_tpu/labs/shardedstore/shardstore.py and labs/paxos/paxos.py):
+
+* A one-server Paxos group decides synchronously: ``_send_to_all`` delivers
+  the leader's own P1a/P2a/P2b locally (paxos.py:238-247), majority = 1, so
+  a proposal is chosen, executed, AND garbage-collected inside the original
+  handler call (exec -> _leader_exec_update -> maybe_gc clears through the
+  executed prefix when n == 1).  The replicated log is therefore always
+  empty in every reachable state — no log lanes at all; what remains is the
+  decided-slot COUNT (cleared_through/slot_in/executed_through, all equal),
+  the heard_from_leader flag (set by the self-delivered P2a, cleared by
+  ElectionTimer), and the constant ballot (1, server) from the immediate
+  self-election at init (paxos.py:201-205).
+
+* The shard master (PaxosServer with the ShardMaster app, timers frozen)
+  logs every FRESH Query — handle_PaxosRequest AMO-wraps read-only
+  commands like any other (paxos.py:326-360) — and answers every query
+  with the one existing config (shardmaster.py Query: out-of-range or -1
+  -> latest).  Its state is (decided count, max executed query seq per
+  source); replies are content-constant except the AMO sequence number.
+
+* Client/server query sequence numbers increase on every ``_query_config``
+  / QueryTimer (shardstore.py:593-631), so the network's distinct query
+  messages are keyed by (source, seq, queried config-num) alone.
+
+Node lanes (node order: 0 = master, 1..G = group servers, G+1 = client):
+  master  [mc, mamo_c, mamo_s1..mamo_sG]   decided count + AMO per source
+  server g [scfg, samo, scount, sh, sq]    config installed, last executed
+                                           client seq, decided count,
+                                           heard flag, query seq counter
+  client  [k, cfg, cq]                     workload index (W+1 = done),
+                                           config known, query seq counter
+
+Message lanes [tag, a, b, c]:
+  QRY  [src, seq, cfg_arg]   PaxosRequest(AMOCommand(Query(cfg_arg), src, seq))
+                             src: 0 = client, g = server g
+  QREP [dst, seq, 0]         PaxosReply(AMOResult(cfg0, seq))
+  SSREQ [k, 0, 0]            ShardStoreRequest(AMOCommand(cmd_k, client, k))
+  SSREP [k, 0, 0]            ShardStoreReply(AMOResult(result_k, k))
+Timer lanes [tag, min, max, p0]: CLIENT(seq) / QUERY / ELECTION / HEARTBEAT.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from dslabs_tpu.tpu.engine import SENTINEL, TensorProtocol
+
+__all__ = ["make_shardstore_protocol"]
+
+QRY, QREP, SSREQ, SSREP = 0, 1, 2, 3
+T_CLIENT, T_QUERY, T_ELECTION, T_HEARTBEAT = 1, 2, 3, 4
+
+CLIENT_MS = 100     # shardstore.py CLIENT_RETRY_MILLIS
+QUERY_MS = 50       # shardstore.py QUERY_MILLIS
+ELECTION_MIN, ELECTION_MAX = 150, 300   # paxos.py
+HEARTBEAT_MS = 50
+
+
+def make_shardstore_protocol(groups_of: Sequence[int],
+                             net_cap: int = 48,
+                             timer_cap: int = 6) -> TensorProtocol:
+    """``groups_of[k-1]`` = the group (1-based) owning workload command
+    k's key under the static post-Join config — precomputed on the host
+    with the same key_to_shard the object servers use."""
+    W = len(groups_of)
+    G = max(groups_of)
+    assert min(groups_of) >= 1
+    # Multi-group configs are built by SUCCESSIVE Joins, so the shard
+    # master serves configs 0..G-1 and each group walks them with shard
+    # handoffs (ShardMove/InstallShards/MoveDone) before reaching the
+    # final assignment — that config-walk state machine is not modelled
+    # yet; this twin covers the single-group search shape
+    # (ShardStorePart1Test.test10).
+    assert G == 1, "multi-group twin requires the config-walk model"
+    MW, TW = 4, 4
+    NW = (2 + G) + 5 * G + 3
+    N_NODES = 1 + G + 1
+    CLIENT = G + 1
+
+    # lane offsets
+    M_MC, M_AMOC, M_AMOS = 0, 1, 2            # master (M_AMOS + g-1)
+    SRV = 2 + G                               # server g base: SRV + 5*(g-1)
+    C_K, C_CFG, C_CQ = SRV + 5 * G, SRV + 5 * G + 1, SRV + 5 * G + 2
+
+    def srv(g, off):
+        return SRV + 5 * (g - 1) + off
+
+    def grp_of(k):
+        """Traced workload index -> owning group, via a static where-chain."""
+        out = jnp.asarray(groups_of[0], jnp.int32)
+        for kk in range(2, W + 1):
+            out = jnp.where(k == kk, groups_of[kk - 1], out)
+        return out
+
+    def msg_row(cond, tag, a, b=0, c=0):
+        rec = jnp.stack([jnp.asarray(x, jnp.int32) for x in (tag, a, b, c)])
+        return jnp.where(cond, rec, jnp.full((MW,), SENTINEL, jnp.int32))[None]
+
+    def timer_row(cond, node, tag, mn, mx, p0):
+        rec = jnp.stack([jnp.asarray(x, jnp.int32)
+                         for x in (node, tag, mn, mx, p0)])
+        return jnp.where(cond, rec,
+                         jnp.full((1 + TW,), SENTINEL, jnp.int32))[None]
+
+    blank_msg = jnp.full((1, MW), SENTINEL, jnp.int32)
+    blank_set = jnp.full((1, 1 + TW), SENTINEL, jnp.int32)
+
+    # ------------------------------------------------------------- handlers
+
+    def step_message(nodes, msg):
+        tag, a, b, c = msg[0], msg[1], msg[2], msg[3]
+        sends = []
+        tsets = []
+
+        # ---- QRY -> master (paxos.py handle_PaxosRequest with the
+        # ShardMaster app; n=1: fresh commands decide+execute+GC inline)
+        is_qry = tag == QRY
+        src, seq = a, b
+        # per-source AMO lane (master): client = 0, server g = g
+        for sidx in range(0, G + 1):
+            lane = M_AMOC if sidx == 0 else M_AMOS + sidx - 1
+            here = is_qry & (src == sidx)
+            last = nodes[lane]
+            fresh = here & (seq > last)
+            nodes = nodes.at[lane].set(
+                jnp.where(fresh, seq, last).astype(jnp.int32))
+            nodes = nodes.at[M_MC].set(
+                jnp.where(fresh, nodes[M_MC] + 1,
+                          nodes[M_MC]).astype(jnp.int32))
+            # reply for fresh or exactly-cached seq (AMO execute: older
+            # seqs return None -> no reply)
+            sends.append(msg_row(here & (seq >= last), QREP, src, seq))
+
+        # ---- QREP -> client (shardstore.py handle_PaxosReply, client):
+        # adopt the config if none, then send the pending command
+        is_qrep_c = (tag == QREP) & (a == 0)
+        k = nodes[C_K]
+        adopt = is_qrep_c & (nodes[C_CFG] == 0)
+        nodes = nodes.at[C_CFG].set(
+            jnp.where(adopt, 1, nodes[C_CFG]).astype(jnp.int32))
+        sends.append(msg_row(adopt & (k <= W), SSREQ, k))
+
+        # ---- QREP -> server g (shardstore.py handle_PaxosReply, server):
+        # propose NewConfig iff cfg.config_num == _next_config_num() — the
+        # master only ever serves config 0, so only a config-less server
+        # matches; deciding it bumps the count and sets heard (self-P2a).
+        for g in range(1, G + 1):
+            here = (tag == QREP) & (a == g)
+            install = here & (nodes[srv(g, 0)] == 0)
+            nodes = nodes.at[srv(g, 0)].set(
+                jnp.where(install, 1, nodes[srv(g, 0)]).astype(jnp.int32))
+            nodes = nodes.at[srv(g, 2)].set(
+                jnp.where(install, nodes[srv(g, 2)] + 1,
+                          nodes[srv(g, 2)]).astype(jnp.int32))
+            nodes = nodes.at[srv(g, 3)].set(
+                jnp.where(install, 1, nodes[srv(g, 3)]).astype(jnp.int32))
+
+        # ---- SSREQ -> server grp_of(k) (handle_ShardStoreRequest):
+        # ALWAYS proposes (relay-mode chosen entries are not deduped,
+        # paxos.py:349-355) -> count+1, heard; executes only with a config
+        # (shardstore.py _execute_client_command), AMO-gated.
+        is_ss = tag == SSREQ
+        kk = a
+        kg = grp_of(kk)
+        for g in range(1, G + 1):
+            here = is_ss & (kg == g)
+            nodes = nodes.at[srv(g, 2)].set(
+                jnp.where(here, nodes[srv(g, 2)] + 1,
+                          nodes[srv(g, 2)]).astype(jnp.int32))
+            nodes = nodes.at[srv(g, 3)].set(
+                jnp.where(here, 1, nodes[srv(g, 3)]).astype(jnp.int32))
+            has_cfg = nodes[srv(g, 0)] == 1
+            samo = nodes[srv(g, 1)]
+            execd = here & has_cfg & (kk > samo)
+            nodes = nodes.at[srv(g, 1)].set(
+                jnp.where(execd, kk, samo).astype(jnp.int32))
+            sends.append(msg_row(here & has_cfg & (kk >= samo), SSREP, kk))
+
+        # ---- SSREP -> client (ClientWorker pumps the next command inside
+        # the reply handler; _send_pending needs the config we must have)
+        is_rep = tag == SSREP
+        match = is_rep & (a == k) & (k <= W)
+        k2 = jnp.where(match, k + 1, k)
+        nodes = nodes.at[C_K].set(k2.astype(jnp.int32))
+        has_next = match & (k2 <= W)
+        sends.append(msg_row(has_next, SSREQ, k2))
+        tsets.append(timer_row(has_next, CLIENT, T_CLIENT,
+                               CLIENT_MS, CLIENT_MS, k2))
+
+        sends = jnp.concatenate(sends + [blank_msg] * (MAX_SENDS - len(sends)))
+        tsets = jnp.concatenate(tsets + [blank_set] * (MAX_SETS - len(tsets)))
+        return nodes, sends[:MAX_SENDS], tsets[:MAX_SETS]
+
+    def step_timer(nodes, node_idx, timer):
+        tag, p0 = timer[0], timer[3]
+        sends = []
+        tsets = []
+
+        # ---- ClientTimer (shardstore.py on_ClientTimer): re-query (+1
+        # more query when there is no config yet — _send_pending falls back
+        # to _query_config) and re-send the pending command.
+        k = nodes[C_K]
+        live = ((node_idx == CLIENT) & (tag == T_CLIENT) & (p0 == k)
+                & (k <= W))
+        cq = nodes[C_CQ]
+        has_cfg = nodes[C_CFG] == 1
+        cq2 = jnp.where(live, jnp.where(has_cfg, cq + 1, cq + 2), cq)
+        nodes = nodes.at[C_CQ].set(cq2.astype(jnp.int32))
+        sends.append(msg_row(live, QRY, 0, cq + 1, -1))
+        sends.append(jnp.where(has_cfg,
+                               msg_row(live, SSREQ, k)[0],
+                               msg_row(live, QRY, 0, cq + 2, -1)[0])[None])
+        tsets.append(timer_row(live, CLIENT, T_CLIENT,
+                               CLIENT_MS, CLIENT_MS, k))
+
+        for g in range(1, G + 1):
+            here = node_idx == g
+            # ---- QueryTimer (shardstore.py on_QueryTimer): leader always,
+            # reconfig always done -> fresh query for the next config num.
+            is_q = here & (tag == T_QUERY)
+            sq = nodes[srv(g, 4)]
+            nodes = nodes.at[srv(g, 4)].set(
+                jnp.where(is_q, sq + 1, sq).astype(jnp.int32))
+            sends.append(msg_row(is_q, QRY, g, sq + 1, nodes[srv(g, 0)]))
+            tsets.append(timer_row(is_q, g, T_QUERY, QUERY_MS, QUERY_MS, 0))
+
+            # ---- ElectionTimer (paxos.py on_ElectionTimer): the lone
+            # server is its own decided leader; only heard resets.
+            is_el = here & (tag == T_ELECTION)
+            nodes = nodes.at[srv(g, 3)].set(
+                jnp.where(is_el, 0, nodes[srv(g, 3)]).astype(jnp.int32))
+            tsets.append(timer_row(is_el, g, T_ELECTION,
+                                   ELECTION_MIN, ELECTION_MAX, 0))
+
+            # ---- HeartbeatTimer: no peers, nothing in flight — pure
+            # re-arm (state unchanged).
+            is_hb = here & (tag == T_HEARTBEAT)
+            tsets.append(timer_row(is_hb, g, T_HEARTBEAT,
+                                   HEARTBEAT_MS, HEARTBEAT_MS, 0))
+
+        sends = jnp.concatenate(sends + [blank_msg] * (MAX_SENDS - len(sends)))
+        tsets = jnp.concatenate(tsets + [blank_set] * (MAX_SETS - len(tsets)))
+        return nodes, sends[:MAX_SENDS], tsets[:MAX_SETS]
+
+    # Row budgets = the TOTAL rows each step function appends (rows are
+    # individually condition-masked; the pad/slice below must never
+    # truncate a real row).  step_message: (G+1) QREP + 1 client SSREQ +
+    # G SSREP + 1 pumped SSREQ; step_timer: 2 client + G query sends.
+    MAX_SENDS = 2 * G + 3
+    MAX_SETS = 1 + 3 * G        # client CT + per-server query/election/hb
+
+    # ------------------------------------------------------------- initials
+
+    def init_nodes():
+        nodes = np.zeros((NW,), np.int32)
+        nodes[M_MC] = 1          # the staged Join is decided slot 1
+        nodes[C_K] = 1           # PUT(1) pending
+        # init() queries once; send_command -> _send_pending with no
+        # config falls back to _query_config and queries AGAIN
+        # (shardstore.py:624-650), so two queries are already in flight.
+        nodes[C_CQ] = 2
+        return nodes
+
+    def init_messages():
+        return np.array([[QRY, 0, 1, -1], [QRY, 0, 2, -1]], np.int32)
+
+    def init_timers():
+        rows = []
+        for g in range(1, G + 1):
+            # ShardStoreServer.init: paxos.init (Election, then the
+            # immediate self-election arms Heartbeat), then QueryTimer.
+            rows.append([g, T_ELECTION, ELECTION_MIN, ELECTION_MAX, 0])
+            rows.append([g, T_HEARTBEAT, HEARTBEAT_MS, HEARTBEAT_MS, 0])
+            rows.append([g, T_QUERY, QUERY_MS, QUERY_MS, 0])
+        rows.append([CLIENT, T_CLIENT, CLIENT_MS, CLIENT_MS, 1])
+        return np.array(rows, np.int32)
+
+    def msg_dest(msg):
+        tag, a = msg[0], msg[1]
+        dest = jnp.asarray(0, jnp.int32)                      # QRY -> master
+        dest = jnp.where(tag == QREP,
+                         jnp.where(a == 0, CLIENT, a), dest)
+        dest = jnp.where(tag == SSREQ, grp_of(msg[1]), dest)
+        dest = jnp.where(tag == SSREP, CLIENT, dest)
+        return dest
+
+    def clients_done(state):
+        return state["nodes"][C_K] == W + 1
+
+    return TensorProtocol(
+        name=f"shardstore-g{G}-w{W}",
+        n_nodes=N_NODES,
+        node_width=NW,
+        msg_width=MW,
+        timer_width=TW,
+        net_cap=net_cap,
+        timer_cap=timer_cap,
+        max_sends=MAX_SENDS,
+        max_sets=MAX_SETS,
+        init_nodes=init_nodes,
+        init_messages=init_messages,
+        init_timers=init_timers,
+        step_message=step_message,
+        step_timer=step_timer,
+        msg_dest=msg_dest,
+        goals={"CLIENTS_DONE": clients_done},
+    )

@@ -31,6 +31,7 @@ through the all_to_all, not a reserved fingerprint value).
 
 from __future__ import annotations
 
+import math
 import os
 import time
 from typing import List, Optional
@@ -104,8 +105,12 @@ class ShardedTensorSearch(TensorSearch):
         # are reported via SearchOutcome.dropped; semantic overflow
         # (net/timer caps, visited shard) stays fatal either way.
         self.strict = strict
-        if frontier_cap % chunk_per_device:
-            frontier_cap += chunk_per_device - frontier_cap % chunk_per_device
+        # F must divide evenly by the chunk (chunk-loop slicing) AND the
+        # device count (level-rebalance shares); pad to the lcm so neither
+        # pad breaks the other's invariant.
+        quantum = math.lcm(chunk_per_device, self.n_devices)
+        if frontier_cap % quantum:
+            frontier_cap += quantum - frontier_cap % quantum
         if visited_cap & (visited_cap - 1):
             raise ValueError("visited_cap must be a power of two "
                              "(hash-table slot arithmetic)")
@@ -119,10 +124,9 @@ class ShardedTensorSearch(TensorSearch):
         # bucket.  strict mode must never abort a search the dedup'd
         # path would complete, so it keeps the prefilter; bench runs
         # (strict=False, drops tolerated) skip it for throughput.
-        self._in_chunk_dedup = strict
         super().__init__(protocol, frontier_cap=frontier_cap,
                          chunk=chunk_per_device, max_depth=max_depth,
-                         max_secs=max_secs)
+                         max_secs=max_secs, in_chunk_dedup=strict)
         p = protocol
         self.lanes = (p.node_width + p.net_cap * p.msg_width
                       + p.n_nodes * p.timer_cap * p.timer_width + 1)
@@ -363,13 +367,45 @@ class ShardedTensorSearch(TensorSearch):
                          check_rep=False)
 
     def _build_finish(self):
+        """Promote nxt -> cur between levels, REBALANCING the frontier
+        across the mesh: successors accumulate on the device that produced
+        them (the chunk step exchanges only fingerprints, never rows —
+        see _build_chunk_step), so without this every reachable state
+        would descend through the initial state's device alone and D-1
+        devices would expand empty chunks.  Each device splits its
+        occupied prefix into D equal contiguous shares (dynamic slices at
+        traced offsets — no computed-index row permutation), one
+        all_to_all moves the shares, and a single compaction scatter per
+        LEVEL re-densifies — wide row movement at level granularity is
+        ~1% of the level's chunk work."""
+        D = self.n_devices
         F, lanes = self.f_cap, self.lanes
         ax = self.axis
+        share = F // D
 
         def local(carry):
             carry = dict(carry)
-            carry["cur"] = carry["nxt"][:F]
-            carry["cur_n"] = carry["nxt_n"]
+            nxt, nxt_n = carry["nxt"], carry["nxt_n"][0]
+            if D == 1:
+                carry["cur"] = nxt[:F]
+                carry["cur_n"] = carry["nxt_n"]
+            else:
+                per = (nxt_n + D - 1) // D          # rows per share
+                send = jnp.stack([
+                    jax.lax.dynamic_slice(nxt, (s * per, 0), (share, lanes))
+                    for s in range(D)])             # [D, share, lanes]
+                r = jnp.arange(share)
+                send_valid = jnp.stack([
+                    (r < per) & (s * per + r < nxt_n) for s in range(D)])
+                recv = jax.lax.all_to_all(send, ax, 0, 0)
+                recv_valid = jax.lax.all_to_all(send_valid, ax, 0, 0)
+                rows = recv.reshape(D * share, lanes)
+                v = recv_valid.reshape(-1)
+                pos = jnp.cumsum(v) - 1
+                dst = jnp.where(v, pos, F)
+                carry["cur"] = jnp.zeros(
+                    (F + 1, lanes), jnp.int32).at[dst].set(rows)[:F]
+                carry["cur_n"] = jnp.sum(v).astype(jnp.int32)[None]
             carry["nxt"] = jnp.zeros((F + 1, lanes), jnp.int32)
             carry["nxt_n"] = jnp.zeros((1,), jnp.int32)
             return carry
@@ -475,7 +511,11 @@ class ShardedTensorSearch(TensorSearch):
                                                depth, t0)
                 depth += 1
                 t_lvl = time.time()
-                n_chunks = -(-max_n // self.cpd)
+                # max_n was read BEFORE the rebalance: a device can end up
+                # with ceil(total/D) <= max_n + D - 1 rows afterwards, so
+                # widen the chunk grid by that bound (at most one extra,
+                # mostly-invalid chunk; never silently skips rows).
+                n_chunks = -(-(max_n + self.n_devices - 1) // self.cpd)
                 for j in range(n_chunks):
                     carry = self._chunk_step(carry, jnp.int32(j))
                     # Respect the time budget inside long levels too.  The

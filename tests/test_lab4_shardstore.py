@@ -714,7 +714,10 @@ def _repeated_puts_gets(deliver_rate=None, with_movement=False,
     from dslabs_tpu.testing.workload import Workload
 
     state = make_state(2, num_shards=2)
-    settings = RunSettings().max_time(150)
+    # Generous budget: wait_for returns as soon as the workers finish
+    # (seconds when healthy); the margin only matters when the host is
+    # heavily loaded and the real-time emulation is starved for cycles.
+    settings = RunSettings().max_time(300)
     if deliver_rate is not None:
         settings.network_deliver_rate(deliver_rate)
     state.start(settings)

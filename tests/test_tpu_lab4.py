@@ -1,0 +1,66 @@
+"""Lab 4 tensor-twin parity: the sharded-store search configuration
+(ShardStorePart1Test.test10 shape — one single-server group, one shard
+master, static post-Join config, CCA/master timers frozen) must produce
+the object checker's exact unique-state counts depth by depth.
+"""
+
+import os
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from dslabs_tpu.core.address import LocalAddress
+from dslabs_tpu.labs.clientserver.kv_workload import kv_workload
+from dslabs_tpu.search.search import BFS, bfs
+from dslabs_tpu.search.results import EndCondition
+from dslabs_tpu.search.settings import SearchSettings
+from dslabs_tpu.testing.predicates import CLIENTS_DONE, RESULTS_OK
+
+from dslabs_tpu.tpu.engine import TensorSearch
+from dslabs_tpu.tpu.protocols.shardstore import make_shardstore_protocol
+
+import tests.test_lab4_shardstore as lab4
+
+SLOW = pytest.mark.skipif(
+    not os.environ.get("DSLABS_SLOW_TESTS"),
+    reason="long object-oracle search (set DSLABS_SLOW_TESTS=1)")
+
+
+def _object_joined(max_levels=None, goal=False):
+    state = lab4.make_search(1, 1, 1, 10)
+    joined = lab4._joined_state(state, 1)
+    joined.add_client_worker(
+        LocalAddress("client1"),
+        kv_workload(["PUT:foo:bar", "GET:foo"], ["PutOk", "bar"]))
+    settings = SearchSettings().max_time(600)
+    settings.add_invariant(RESULTS_OK)
+    settings.node_active(lab4.CCA, False)
+    settings.deliver_timers(lab4.CCA, False)
+    settings.deliver_timers(lab4.shard_master(1), False)
+    if goal:
+        settings.add_goal(CLIENTS_DONE)
+        return bfs(joined, settings)
+    # max_depth is absolute: the staged join already sits at joined.depth.
+    settings.set_max_depth(joined.depth + max_levels)
+    return BFS(settings).run(joined)
+
+
+def test_lab4_depth_parity():
+    """Depth-limited unique-state parity (verified by hand for depths 1-5:
+    6/23/74/219/606); CI checks depth 3 unconditionally."""
+    obj = _object_joined(max_levels=3)
+    ten = TensorSearch(make_shardstore_protocol([1, 1]), chunk=256,
+                       max_depth=3).run()
+    assert ten.unique_states == obj.discovered_count == 74
+
+
+@SLOW
+def test_lab4_goal_parity():
+    """The twin reaches CLIENTS_DONE (put/get complete through config
+    discovery, the group's replicated log, and AMO dedup).  The object
+    side of this verdict is test_lab4_shardstore.test10 — the oracle's
+    goal search there takes minutes, so it is not repeated here."""
+    ten = TensorSearch(make_shardstore_protocol([1, 1]), chunk=1024,
+                       frontier_cap=1 << 18, max_depth=11).run()
+    assert ten.end_condition == "GOAL_FOUND"   # depth 10, ~22k unique

@@ -1,0 +1,52 @@
+"""Dev: depth-by-depth unique-state parity of the lab4 twin vs the object
+checker on the test10 config."""
+
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dslabs_tpu.core.address import LocalAddress
+from dslabs_tpu.labs.clientserver.kv_workload import kv_workload
+from dslabs_tpu.search.search import BFS
+from dslabs_tpu.search.settings import SearchSettings
+from dslabs_tpu.testing.predicates import RESULTS_OK
+
+import tests.test_lab4_shardstore as t
+
+from dslabs_tpu.tpu.engine import TensorSearch
+from dslabs_tpu.tpu.protocols.shardstore import make_shardstore_protocol
+
+
+def object_counts(max_depth):
+    state = t.make_search(1, 1, 1, 10)
+    joined = t._joined_state(state, 1)
+    joined.add_client_worker(
+        LocalAddress("client1"),
+        kv_workload(["PUT:foo:bar", "GET:foo"], ["PutOk", "bar"]))
+    settings = SearchSettings().max_time(600)
+    settings.add_invariant(RESULTS_OK)
+    settings.node_active(t.CCA, False)
+    settings.deliver_timers(t.CCA, False)
+    settings.deliver_timers(t.shard_master(1), False)
+    # max_depth is absolute: the staged join already sits at joined.depth.
+    settings.set_max_depth(joined.depth + max_depth)
+    res = BFS(settings).run(joined)
+    return res.discovered_count, res.end_condition
+
+
+def main():
+    from dslabs_tpu.labs.shardedstore.shardstore import key_to_shard
+    # PUT:foo:bar, GET:foo both key "foo" -> one group anyway
+    proto = make_shardstore_protocol([1, 1])
+    for depth in range(1, 6):
+        oc, oe = object_counts(depth)
+        ten = TensorSearch(proto, chunk=256, max_depth=depth).run()
+        flag = "OK " if ten.unique_states == oc else "MISMATCH"
+        print(f"depth {depth}: object={oc} tensor={ten.unique_states} "
+              f"{flag} (obj {oe}, ten {ten.end_condition})", flush=True)
+
+
+if __name__ == "__main__":
+    main()
