@@ -50,6 +50,10 @@ __all__ = ["ShardedTensorSearch", "make_mesh"]
 
 OVERFLOW_FACTOR = 2
 MAXU32 = np.uint32(0xFFFFFFFF)
+# Slots per visited-table bucket: the probe loop reads whole buckets (one
+# aligned 128-byte line) and _init_carry must place the root key with the
+# same addressing.
+BKT = 8
 # Dev: print per-level wall time / chunk rate from run().
 _LEVEL_TIMING = bool(os.environ.get("DSLABS_LEVEL_TIMING"))
 
@@ -187,11 +191,22 @@ class ShardedTensorSearch(TensorSearch):
         ne = self._num_events()
         ax = self.axis
         lanes = self.lanes
-        bucket = (C * ne // D + 1) * OVERFLOW_FACTOR
+        # On one device every successor routes to the sole owner, so the
+        # bucket can hold the whole batch exactly (no overflow headroom
+        # needed) — halving the rows the probe loop and flag exchange
+        # touch.  Multi-device buckets keep 2x-mean headroom for skew.
+        bucket = (C * ne if D == 1
+                  else (C * ne // D + 1) * OVERFLOW_FACTOR)
         nf = len(self._flag_names)
 
-        def local(carry, j):
+        def local(carry):
+            # The chunk index lives IN the carry (device-resident,
+            # self-incrementing): passing it as a per-call jnp scalar cost
+            # a fresh host->device transfer per chunk step, which on the
+            # tunnelled runtime is the same ~25 ms latency class as a
+            # readback.
             cur, cur_n = carry["cur"], carry["cur_n"][0]
+            j = carry["j"][0]
             start = j * C
             rows_chunk = jax.lax.dynamic_slice(cur, (start, 0), (C, lanes))
             valid = (start + jnp.arange(C)) < cur_n
@@ -255,58 +270,65 @@ class ShardedTensorSearch(TensorSearch):
             recv_valid = recv_valid.reshape(rb)
 
             # ---- owner-side dedup via an open-addressing hash table in
-            # HBM ([V+1, 4] uint32, double hashing, last row = scatter
-            # dump).  Membership AND insert happen in one bounded probe
-            # loop: per iteration a handful of [rb]-row gathers/scatters —
-            # no O(V log V) sort-merge per chunk (the round-1 → round-2
-            # bottleneck: sorting the whole visited shard for every chunk).
+            # HBM ([V+1, 4] uint32, viewed as [V/8, 8]-slot buckets, last
+            # row = scatter dump).  Membership AND insert happen in one
+            # bounded probe loop; each iteration reads a key's WHOLE
+            # bucket (one aligned 128-byte line), checks membership across
+            # its 8 slots, and claims the first empty slot.
             #
-            # The recv batch may hold the same key from different source
-            # devices; a small in-batch sort dedups it first so the
-            # empty-slot claim race below is only ever between DISTINCT
-            # keys — whoever's scatter lands, a re-gather tells each
-            # candidate whether its own key is now stored (won) or a
-            # different key beat it (advance to next probe slot).
+            # The recv batch may hold the same key several times (from
+            # different producers, or in-chunk duplicates when the
+            # prefilter is off).  Claim conflicts — equal keys AND
+            # distinct keys hashing to one bucket — are serialised by a
+            # per-bucket RESERVATION: each iteration, only the
+            # minimum-index contender of a bucket writes (.at[].min
+            # scatter + re-gather), so exactly one copy of a key ever
+            # inserts and no lexsort of the batch is needed (the previous
+            # sort-based in-batch dedup was ~60% of a loaded chunk step).
             visited = carry["visited"]
             # Real keys never equal the EMPTY marker (all four lanes MAX):
             # remap the 2^-128-probability collider.
             all_max = jnp.all(recv_keys == MAXU32, axis=1)
-            ckeys = recv_keys.at[:, 3].set(
+            skeys = recv_keys.at[:, 3].set(
                 jnp.where(all_max & recv_valid, MAXU32 - 1, recv_keys[:, 3]))
-            bo = jnp.lexsort((ckeys[:, 3], ckeys[:, 2], ckeys[:, 1],
-                              ckeys[:, 0], ~recv_valid))
-            skeys = ckeys[bo]
-            svalid = recv_valid[bo]
-            batch_first = jnp.ones(rb, bool).at[1:].set(
-                jnp.any(skeys[1:] != skeys[:-1], axis=1))
-            cand = svalid & batch_first
+            cand = recv_valid
 
-            # Probe slot from lane 2 (b_hi), NOT lane 0: ownership routing
-            # already fixed lane0 ≡ device (mod D), so a lane0-derived home
-            # slot would cluster every owned key into 1/D of the table.
-            slot0 = (skeys[:, 2] & jnp.uint32(V - 1)).astype(jnp.int32)
+            # Bucket index from lane 2 (b_hi), NOT lane 0: ownership
+            # routing already fixed lane0 ≡ device (mod D), so a
+            # lane0-derived home bucket would cluster every owned key
+            # into 1/D of the table.
+            VB = V // BKT
+            slot0 = (skeys[:, 2] & jnp.uint32(VB - 1)).astype(jnp.int32)
             pstep = (skeys[:, 1] | jnp.uint32(1)).astype(jnp.uint32)
+            ridx = jnp.arange(rb, dtype=jnp.int32)
 
             def probe_cond(st):
                 _, _, resolved, _, it = st
                 return (it < 64) & jnp.any(~resolved)
 
             def probe_body(st):
-                table, slot, resolved, fresh, it = st
-                cur = table[slot]                        # [rb, 4]
-                eq = jnp.all(cur == skeys, axis=1)
-                empty = jnp.all(cur == MAXU32, axis=1)
+                table, bkt_i, resolved, fresh, it = st
+                bkt = table[:V].reshape(VB, BKT, 4)[bkt_i]   # [rb, BKT, 4]
+                eq = jnp.any(
+                    jnp.all(bkt == skeys[:, None, :], axis=2), axis=1)
+                empty = jnp.all(bkt == MAXU32, axis=2)       # [rb, BKT]
+                has_empty = jnp.any(empty, axis=1)
+                first_empty = jnp.argmax(empty, axis=1)
                 unres = ~resolved
-                tryi = unres & empty
-                dst = jnp.where(tryi, slot, V)
+                want = unres & ~eq & has_empty
+                res = jnp.full((VB + 1,), rb, jnp.int32).at[
+                    jnp.where(want, bkt_i, VB)].min(ridx)
+                winner = want & (res[bkt_i] == ridx)
+                dst = jnp.where(winner, bkt_i * BKT + first_empty, V)
                 table = table.at[dst].set(skeys)
-                back = table[slot]
-                won = tryi & jnp.all(back == skeys, axis=1)
-                resolved = resolved | eq | won
-                nslot = (slot.astype(jnp.uint32) + pstep).astype(
-                    jnp.int32) & (V - 1)
-                slot = jnp.where(~resolved, nslot, slot)
-                return table, slot, resolved, fresh | won, it + 1
+                resolved = resolved | eq | winner
+                # Losers re-read the SAME bucket next iteration (their
+                # key may now be present, or another empty slot remains);
+                # a FULL bucket advances by the double-hash step.
+                nb = (bkt_i.astype(jnp.uint32) + pstep).astype(
+                    jnp.int32) & (VB - 1)
+                bkt_i = jnp.where(~resolved & ~has_empty, nb, bkt_i)
+                return table, bkt_i, resolved, fresh | winner, it + 1
 
             table, _, resolved, fresh_s, _ = jax.lax.while_loop(
                 probe_cond, probe_body,
@@ -317,15 +339,14 @@ class ShardedTensorSearch(TensorSearch):
             vis_drop = jnp.sum(~resolved).astype(jnp.int32)
             n_fresh = jnp.sum(fresh_s).astype(jnp.int32)
 
-            # ---- return each key's fresh flag to its producer (undo the
-            # in-batch sort, reverse all_to_all — an involution on the
-            # leading axis) and map it back onto the producer's local
-            # successor rows.  Narrow bool scatters only; `.max` (boolean
-            # or) so the clipped dump writes of invalid slots can never
-            # clobber a true flag.
-            fresh = jnp.zeros(rb, bool).at[bo].set(fresh_s)
+            # ---- return each key's fresh flag to its producer (reverse
+            # all_to_all — an involution on the leading axis; recv order
+            # was never permuted) and map it back onto the producer's
+            # local successor rows.  Narrow bool scatters only; `.max`
+            # (boolean or) so the clipped dump writes of invalid slots
+            # can never clobber a true flag.
             fresh_back = jax.lax.all_to_all(
-                fresh.reshape(D, bucket), ax, 0, 0)
+                fresh_s.reshape(D, bucket), ax, 0, 0)
             fresh_rows = jnp.zeros(owner.shape[0], bool).at[
                 gidx.reshape(-1)].max(
                 fresh_back.reshape(-1) & send_valid.reshape(-1))
@@ -345,6 +366,7 @@ class ShardedTensorSearch(TensorSearch):
 
             return {
                 "cur": cur, "cur_n": carry["cur_n"],
+                "j": carry["j"] + 1,
                 "nxt": nxt, "nxt_n": carry["nxt_n"].at[0].add(n_sel),
                 "visited": new_visited,
                 "vis_n": carry["vis_n"].at[0].add(n_fresh),
@@ -363,7 +385,7 @@ class ShardedTensorSearch(TensorSearch):
 
         spec = self._carry_specs()
         return shard_map(local, mesh=self.mesh,
-                         in_specs=(spec, P()), out_specs=spec,
+                         in_specs=(spec,), out_specs=spec,
                          check_rep=False)
 
     def _build_finish(self):
@@ -408,6 +430,7 @@ class ShardedTensorSearch(TensorSearch):
                 carry["cur_n"] = jnp.sum(v).astype(jnp.int32)[None]
             carry["nxt"] = jnp.zeros((F + 1, lanes), jnp.int32)
             carry["nxt_n"] = jnp.zeros((1,), jnp.int32)
+            carry["j"] = jnp.zeros((1,), jnp.int32)
             return carry
 
         spec = self._carry_specs()
@@ -418,45 +441,55 @@ class ShardedTensorSearch(TensorSearch):
     def _carry_specs(self):
         ax = self.axis
         return {k: P(ax) for k in
-                ("cur", "cur_n", "nxt", "nxt_n", "visited", "vis_n",
+                ("cur", "cur_n", "j", "nxt", "nxt_n", "visited", "vis_n",
                  "explored", "overflow", "drops", "flag_cnt", "flag_rows")}
 
     # ----------------------------------------------------------------- run
 
     def _init_carry(self, state) -> dict:
+        """Build the sharded carry ON DEVICE: the big buffers (frontier,
+        next-frontier, visited table — hundreds of MB) are jnp
+        allocations inside a jitted initializer, with only the root row
+        and its key crossing the host boundary.  A host-numpy build +
+        device_put shipped ~750 MB through the runtime tunnel and cost
+        15-50 s per run() — charged to the bench's measured window."""
         D, F, V, lanes = self.n_devices, self.f_cap, self.v_cap, self.lanes
-        rows0 = np.asarray(flatten_state(state), np.int32)     # [1, lanes]
+        rows0 = flatten_state(state)                     # [1, lanes] device
         fp0 = np.asarray(state_fingerprints(state), np.uint32)  # [1, 4]
         owner = int(fp0[0, 0]) % D
-
-        cur = np.zeros((D * F, lanes), np.int32)
-        cur[owner * F] = rows0[0]
-        cur_n = np.zeros((D,), np.int32)
-        cur_n[owner] = 1
-        # Hash-table visited shard: the root key sits at its PROBE slot.
         key0 = fp0[0].copy()
         if (key0 == np.uint32(MAXU32)).all():   # EMPTY-marker collider
             key0[3] = np.uint32(MAXU32 - 1)
-        visited = np.full((D * (V + 1), 4), MAXU32, np.uint32)
-        visited[owner * (V + 1) + (int(key0[2]) & (V - 1))] = key0
-        vis_n = np.zeros((D,), np.int32)
-        vis_n[owner] = 1
+        # The root key sits in slot 0 of its home BUCKET (the bucketised
+        # probe reads whole BKT-slot buckets keyed by lane 2 — must
+        # mirror _build_chunk_step's addressing).
+        home = (int(key0[2]) & (V // BKT - 1)) * BKT
         nf = len(self._flag_names)
-        host = {
-            "cur": cur, "cur_n": cur_n,
-            "nxt": np.zeros((D * (F + 1), lanes), np.int32),
-            "nxt_n": np.zeros((D,), np.int32),
-            "visited": visited, "vis_n": vis_n,
-            "explored": np.zeros((D,), np.int32),
-            "overflow": np.zeros((D,), np.int32),
-            "drops": np.zeros((D,), np.int32),
-            "flag_cnt": np.zeros((D * nf,), np.int32).reshape(D * nf),
-            "flag_rows": np.zeros((D * nf, lanes), np.int32),
-        }
-        return {
-            k: jax.device_put(v, NamedSharding(self.mesh, P(self.axis)))
-            for k, v in host.items()
-        }
+        shard = NamedSharding(self.mesh, P(self.axis))
+
+        def build(row0, k0):
+            onehot_d = jnp.arange(D) == owner
+            return {
+                "cur": jnp.zeros((D * F, lanes), jnp.int32).at[
+                    owner * F].set(row0),
+                "cur_n": onehot_d.astype(jnp.int32),
+                "j": jnp.zeros((D,), jnp.int32),
+                "nxt": jnp.zeros((D * (F + 1), lanes), jnp.int32),
+                "nxt_n": jnp.zeros((D,), jnp.int32),
+                "visited": jnp.full((D * (V + 1), 4), MAXU32,
+                                    jnp.uint32).at[
+                    owner * (V + 1) + home].set(k0),
+                "vis_n": onehot_d.astype(jnp.int32),
+                "explored": jnp.zeros((D,), jnp.int32),
+                "overflow": jnp.zeros((D,), jnp.int32),
+                "drops": jnp.zeros((D,), jnp.int32),
+                "flag_cnt": jnp.zeros((D * nf,), jnp.int32),
+                "flag_rows": jnp.zeros((D * nf, lanes), jnp.int32),
+            }
+
+        init = jax.jit(build, out_shardings={
+            k: shard for k in self._carry_specs()})
+        return init(rows0[0], jnp.asarray(key0))
 
     def _terminal_from_flags(self, carry, explored, vis_total, depth, t0):
         """Resolve the first terminal flag (checkState order) from the
@@ -516,8 +549,9 @@ class ShardedTensorSearch(TensorSearch):
                 # widen the chunk grid by that bound (at most one extra,
                 # mostly-invalid chunk; never silently skips rows).
                 n_chunks = -(-(max_n + self.n_devices - 1) // self.cpd)
+                t_disp = time.time()
                 for j in range(n_chunks):
-                    carry = self._chunk_step(carry, jnp.int32(j))
+                    carry = self._chunk_step(carry)
                     # Respect the time budget inside long levels too.  The
                     # partial level runs the same overflow/terminal-flag
                     # checks as a full level before reporting, so a
@@ -531,6 +565,7 @@ class ShardedTensorSearch(TensorSearch):
                             return out
                         return self._limit_outcome("TIME_EXHAUSTED", carry,
                                                    depth, t0)
+                t_disp = time.time() - t_disp
                 # ---- the one host sync per level
                 out, explored, vis_total, drops, max_n = self._sync_checks(
                     carry, depth, t0)
@@ -540,6 +575,7 @@ class ShardedTensorSearch(TensorSearch):
                     dt = time.time() - t_lvl
                     print(f"[level {depth}] chunks={n_chunks} "
                           f"dt={dt:.2f}s chunk={dt/max(n_chunks,1)*1e3:.1f}ms "
+                          f"dispatch={t_disp:.2f}s "
                           f"explored={explored} unique={vis_total} "
                           f"next={max_n}", flush=True)
                 carry = self._finish_level(carry)

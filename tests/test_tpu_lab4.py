@@ -12,10 +12,9 @@ jax = pytest.importorskip("jax")
 
 from dslabs_tpu.core.address import LocalAddress
 from dslabs_tpu.labs.clientserver.kv_workload import kv_workload
-from dslabs_tpu.search.search import BFS, bfs
-from dslabs_tpu.search.results import EndCondition
+from dslabs_tpu.search.search import BFS
 from dslabs_tpu.search.settings import SearchSettings
-from dslabs_tpu.testing.predicates import CLIENTS_DONE, RESULTS_OK
+from dslabs_tpu.testing.predicates import RESULTS_OK
 
 from dslabs_tpu.tpu.engine import TensorSearch
 from dslabs_tpu.tpu.protocols.shardstore import make_shardstore_protocol
@@ -27,7 +26,7 @@ SLOW = pytest.mark.skipif(
     reason="long object-oracle search (set DSLABS_SLOW_TESTS=1)")
 
 
-def _object_joined(max_levels=None, goal=False):
+def _object_joined(max_levels):
     state = lab4.make_search(1, 1, 1, 10)
     joined = lab4._joined_state(state, 1)
     joined.add_client_worker(
@@ -38,9 +37,6 @@ def _object_joined(max_levels=None, goal=False):
     settings.node_active(lab4.CCA, False)
     settings.deliver_timers(lab4.CCA, False)
     settings.deliver_timers(lab4.shard_master(1), False)
-    if goal:
-        settings.add_goal(CLIENTS_DONE)
-        return bfs(joined, settings)
     # max_depth is absolute: the staged join already sits at joined.depth.
     settings.set_max_depth(joined.depth + max_levels)
     return BFS(settings).run(joined)
@@ -49,7 +45,7 @@ def _object_joined(max_levels=None, goal=False):
 def test_lab4_depth_parity():
     """Depth-limited unique-state parity (verified by hand for depths 1-5:
     6/23/74/219/606); CI checks depth 3 unconditionally."""
-    obj = _object_joined(max_levels=3)
+    obj = _object_joined(3)
     ten = TensorSearch(make_shardstore_protocol([1, 1]), chunk=256,
                        max_depth=3).run()
     assert ten.unique_states == obj.discovered_count == 74

@@ -37,7 +37,6 @@ def object_counts(max_depth):
 
 
 def main():
-    from dslabs_tpu.labs.shardedstore.shardstore import key_to_shard
     # PUT:foo:bar, GET:foo both key "foo" -> one group anyway
     proto = make_shardstore_protocol([1, 1])
     for depth in range(1, 6):

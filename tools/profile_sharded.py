@@ -7,7 +7,6 @@ import jax
 
 jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-import jax.numpy as jnp
 import numpy as np
 
 from dslabs_tpu.tpu.protocols.paxos import make_paxos_protocol
@@ -26,16 +25,16 @@ def main():
     with mesh:
         carry = search._init_carry(state)
         t0 = time.time()
-        carry = search._chunk_step(carry, jnp.int32(0))
+        carry = search._chunk_step(carry)
         jax.block_until_ready(carry["nxt_n"])
         print(f"chunk_step compile+1st {time.time()-t0:6.1f}s")
 
-        # steady state: run 20 chunk steps back to back (j=0 each time; the
-        # work is shape-identical regardless of occupancy)
+        # steady state: run 20 chunk steps back to back (the carry-resident
+        # chunk index self-increments; work is shape-identical regardless of occupancy)
         iters = 20
         t0 = time.time()
         for _ in range(iters):
-            carry = search._chunk_step(carry, jnp.int32(0))
+            carry = search._chunk_step(carry)
         jax.block_until_ready(carry["nxt_n"])
         dt = (time.time() - t0) / iters
         print(f"chunk_step steady {dt*1e3:9.2f} ms")
