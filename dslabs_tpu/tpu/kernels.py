@@ -1,0 +1,91 @@
+"""Pallas TPU kernels for the tensor-search engine.
+
+The one genuinely kernel-shaped op in the BFS pipeline is the full-state
+fingerprint: a [B, L] int32 -> [B, 4] uint32 blocked reduction (L ~ 1300
+lanes for the lab3 bench protocol).  The Pallas version tiles rows into
+VMEM blocks and reuses the ENGINE's own mixing math on each block, so its
+output is bit-identical to the jnp reference path by construction
+(SURVEY §2.10 "state fingerprinting as a Pallas hash kernel").
+
+Row tiles are processed by a 1-D grid; the full lane width rides in one
+VMEM block (a [128, 1354] int32 block is ~0.7 MB — comfortably inside
+VMEM).  ``mode="interpret"`` runs the kernel through the Pallas
+interpreter for CPU testing.
+
+MEASURED OUTCOME (v5e, round 2): in the engine's expand program the
+Pallas kernel is bit-identical but ~2x SLOWER end-to-end than the jnp
+path — the pallas_call boundary forces the [B, ~1300-lane] flattened
+state to materialise in HBM, where XLA otherwise fuses the hashing into
+the successor computation and never writes the preimage out.  The engine
+therefore defaults to the fused jnp path; the kernel remains available
+(``mode="pallas"`` / env DSLABS_PALLAS_FP=1) for workloads whose
+fingerprint input is already materialised.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["fingerprint_rows"]
+
+TILE = 128
+
+
+def _kernel(in_ref, out_ref):
+    # The engine's mixing math (single source of truth: _fingerprint32),
+    # with one Mosaic accommodation: reductions over unsigned ints are
+    # unsupported, so the lane sums run on an int32 bitcast view —
+    # two's-complement wrapping addition is bit-identical to uint32
+    # wrapping addition, so the output matches engine.row_fingerprints
+    # exactly.
+    from dslabs_tpu.tpu.engine import _fingerprint32
+
+    flat = in_ref[:]
+
+    def u32sum(x):
+        s = jnp.sum(jax.lax.bitcast_convert_type(x, jnp.int32), axis=1,
+                    dtype=jnp.int32)
+        return jax.lax.bitcast_convert_type(s, jnp.uint32)
+
+    a_hi, a_lo = _fingerprint32(flat, 1, sum_fn=u32sum)
+    b_hi, b_lo = _fingerprint32(flat, 2, sum_fn=u32sum)
+    out_ref[:] = jnp.stack([a_hi, a_lo, b_hi, b_lo], axis=1)
+
+
+def _pallas_call(flat: jnp.ndarray, interpret: bool) -> jnp.ndarray:
+    b, l = flat.shape
+    return pl.pallas_call(
+        _kernel,
+        grid=(b // TILE,),
+        in_specs=[pl.BlockSpec((TILE, l), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((TILE, 4), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, 4), jnp.uint32),
+        interpret=interpret,
+    )(flat)
+
+
+def fingerprint_rows(flat: jnp.ndarray, mode: str = "auto") -> jnp.ndarray:
+    """[B, L] int32 rows -> [B, 4] uint32 128-bit fingerprints.
+
+    mode: "auto" (fused jnp unless DSLABS_PALLAS_FP=1 on TPU — see the
+    module docstring for the measurement behind the default), "jnp",
+    "pallas", or "interpret" (Pallas interpreter — CPU parity tests)."""
+    import os
+
+    from dslabs_tpu.tpu.engine import row_fingerprints
+
+    b = flat.shape[0]
+    if mode == "auto":
+        on_tpu = jax.default_backend() == "tpu"
+        opt_in = os.environ.get("DSLABS_PALLAS_FP", "").lower() in (
+            "1", "true", "yes")
+        mode = "pallas" if on_tpu and opt_in else "jnp"
+    if mode == "jnp":
+        return row_fingerprints(flat)
+    pad = (-b) % TILE
+    if pad:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((pad, flat.shape[1]), flat.dtype)])
+    return _pallas_call(flat, interpret=(mode == "interpret"))[:b]

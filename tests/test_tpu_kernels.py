@@ -1,0 +1,28 @@
+"""Pallas fingerprint kernel: the interpreter-mode kernel must be
+bit-identical to the jnp reference path (they share the engine's mixing
+math; this pins the BlockSpec/tiling plumbing)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from dslabs_tpu.tpu.engine import row_fingerprints  # noqa: E402
+from dslabs_tpu.tpu.kernels import TILE, fingerprint_rows  # noqa: E402
+
+
+@pytest.mark.parametrize("b,l", [
+    (TILE, 64),          # exactly one tile
+    (3 * TILE, 257),     # multiple tiles, odd lane count
+    (TILE + 7, 33),      # row padding path
+    (5, 4),              # tiny batch, pure padding
+])
+def test_interpret_matches_jnp(b, l):
+    rng = np.random.default_rng(b * 1000 + l)
+    flat = jnp.asarray(
+        rng.integers(-2**31, 2**31, size=(b, l), dtype=np.int64)
+        .astype(np.int32))
+    ref = np.asarray(row_fingerprints(flat))
+    ker = np.asarray(fingerprint_rows(flat, mode="interpret"))
+    np.testing.assert_array_equal(ref, ker)
