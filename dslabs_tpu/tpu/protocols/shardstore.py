@@ -1,48 +1,63 @@
 """Tensorised twin of lab 4's sharded KV store for the search-test
-configurations (ShardStorePart1Test.java:test10-12 shape): G groups of ONE
-server each, one shard master, one client, a static post-Join config, the
-config controller and master timers frozen (tests/test_lab4_shardstore.py
-test10-12 mirror these settings from ShardStoreBaseTest.java:209-220).
+configurations (ShardStorePart1Test.test10-12 shape): G groups of ONE
+server each, one shard master, one client, the config controller and
+master timers frozen (tests/test_lab4_shardstore.py test10-12 mirror
+these settings from ShardStoreBaseTest.java:209-220).
 
-Why the state collapses so far (all against the object implementations in
+Why the state collapses (all against the object implementations in
 dslabs_tpu/labs/shardedstore/shardstore.py and labs/paxos/paxos.py):
 
-* A one-server Paxos group decides synchronously: ``_send_to_all`` delivers
-  the leader's own P1a/P2a/P2b locally (paxos.py:238-247), majority = 1, so
-  a proposal is chosen, executed, AND garbage-collected inside the original
-  handler call (exec -> _leader_exec_update -> maybe_gc clears through the
-  executed prefix when n == 1).  The replicated log is therefore always
-  empty in every reachable state — no log lanes at all; what remains is the
-  decided-slot COUNT (cleared_through/slot_in/executed_through, all equal),
-  the heard_from_leader flag (set by the self-delivered P2a, cleared by
-  ElectionTimer), and the constant ballot (1, server) from the immediate
-  self-election at init (paxos.py:201-205).
+* A one-server Paxos group decides synchronously: ``_send_to_all``
+  delivers the leader's own P1a/P2a/P2b locally (paxos.py:238-247),
+  majority = 1, so a proposal is chosen, executed, AND garbage-collected
+  inside the original handler call (exec -> _leader_exec_update ->
+  maybe_gc clears through the executed prefix when n == 1).  The
+  replicated log is always empty in every reachable state — no log
+  lanes; what remains is the decided-slot COUNT, the heard_from_leader
+  flag (set by the self-delivered P2a, cleared by ElectionTimer), and
+  the constant ballot from the immediate self-election at init.
 
-* The shard master (PaxosServer with the ShardMaster app, timers frozen)
-  logs every FRESH Query — handle_PaxosRequest AMO-wraps read-only
-  commands like any other (paxos.py:326-360) — and answers every query
-  with the one existing config (shardmaster.py Query: out-of-range or -1
-  -> latest).  Its state is (decided count, max executed query seq per
-  source); replies are content-constant except the AMO sequence number.
+* The shard master (PaxosServer + ShardMaster app, timers frozen) logs
+  every FRESH Query — handle_PaxosRequest AMO-wraps read-only commands
+  like any other (paxos.py:326-360).  After the staged Joins its config
+  list is STATIC ([cfg0] for G=1; [cfg0, cfg1] for G=2 — one config per
+  Join), so a reply's payload is f(query arg): arg < 0 or beyond the
+  list -> the latest config, else configs[arg] (shardmaster.py Query).
 
-* Client/server query sequence numbers increase on every ``_query_config``
-  / QueryTimer (shardstore.py:593-631), so the network's distinct query
-  messages are keyed by (source, seq, queried config-num) alone.
+* The config walk (G=2): each group server queries for config
+  _next_config_num() and installs replies in order None -> cfg0 -> cfg1
+  (shardstore.py _apply_new_config).  Installing cfg1 at group 1 stores
+  a SNAPSHOT of the lost shards' kv + the full AMO map in ``outgoing``;
+  every later QueryTimer re-sends the SAME stored ShardMove, so the
+  move's content is one integer: group 1's last-executed client seq at
+  install time.  Group 2 proposes InstallShards on a matching move
+  (owned |= shards, AMO merged as a per-client max), acks, and group 1's
+  MoveDone clears outgoing.  While a handoff is pending,
+  ``_reconfig_done`` gates further queries (on_QueryTimer) and config
+  installs.
+
+* The client always queries with arg -1, so it only ever learns the
+  LATEST config — one has-config bit — and routes commands by that
+  final mapping; a group that does not yet cover a command's shard
+  answers WrongGroup (config current, shard not mine) or stays silent
+  (shard mine but still in flight), both mirrored per scfg/in_flag.
 
 Node lanes (node order: 0 = master, 1..G = group servers, G+1 = client):
   master  [mc, mamo_c, mamo_s1..mamo_sG]   decided count + AMO per source
-  server g [scfg, samo, scount, sh, sq]    config installed, last executed
-                                           client seq, decided count,
-                                           heard flag, query seq counter
+  server g [scfg, samo, scount, sh, sq, out_flag, out_samo, in_flag]
+    scfg: 0 = no config, i+1 = configs[i] installed
   client  [k, cfg, cq]                     workload index (W+1 = done),
-                                           config known, query seq counter
+                                           latest config known, query seq
 
 Message lanes [tag, a, b, c]:
-  QRY  [src, seq, cfg_arg]   PaxosRequest(AMOCommand(Query(cfg_arg), src, seq))
+  QRY   [src, seq, arg]      PaxosRequest(AMOCommand(Query(arg), src, seq))
                              src: 0 = client, g = server g
-  QREP [dst, seq, 0]         PaxosReply(AMOResult(cfg0, seq))
+  QREP  [dst, seq, kind]     PaxosReply(AMOResult(configs[kind], seq))
   SSREQ [k, 0, 0]            ShardStoreRequest(AMOCommand(cmd_k, client, k))
   SSREP [k, 0, 0]            ShardStoreReply(AMOResult(result_k, k))
+  WG    [k, 0, 0]            WrongGroup(k)
+  SM    [to_g, samo, 0]      ShardMove(cfg1, from g1, shards, snapshot)
+  SMACK [to_g, 0, 0]         ShardMoveAck(cfg1, shards)
 Timer lanes [tag, min, max, p0]: CLIENT(seq) / QUERY / ELECTION / HEARTBEAT.
 """
 
@@ -57,7 +72,7 @@ from dslabs_tpu.tpu.engine import SENTINEL, TensorProtocol
 
 __all__ = ["make_shardstore_protocol"]
 
-QRY, QREP, SSREQ, SSREP = 0, 1, 2, 3
+QRY, QREP, SSREQ, SSREP, WG, SM, SMACK = range(7)
 T_CLIENT, T_QUERY, T_ELECTION, T_HEARTBEAT = 1, 2, 3, 4
 
 CLIENT_MS = 100     # shardstore.py CLIENT_RETRY_MILLIS
@@ -70,33 +85,36 @@ def make_shardstore_protocol(groups_of: Sequence[int],
                              net_cap: int = 48,
                              timer_cap: int = 6) -> TensorProtocol:
     """``groups_of[k-1]`` = the group (1-based) owning workload command
-    k's key under the static post-Join config — precomputed on the host
-    with the same key_to_shard the object servers use."""
+    k's key under the FINAL config — precomputed on the host with the
+    same ShardMaster rebalance the object system runs (see
+    tests/test_tpu_lab4.py).  G = max(groups_of); with G = 2 the config
+    walk and the g1 -> g2 handoff are modelled (groups are built by
+    successive Joins, so every shard a 2-group config assigns to g2 was
+    g1's under cfg0)."""
     W = len(groups_of)
     G = max(groups_of)
     assert min(groups_of) >= 1
-    # Multi-group configs are built by SUCCESSIVE Joins, so the shard
-    # master serves configs 0..G-1 and each group walks them with shard
-    # handoffs (ShardMove/InstallShards/MoveDone) before reaching the
-    # final assignment — that config-walk state machine is not modelled
-    # yet; this twin covers the single-group search shape
-    # (ShardStorePart1Test.test10).
-    assert G == 1, "multi-group twin requires the config-walk model"
+    assert G <= 2, "3+-group configs need multi-hop handoff modelling"
+    N_CFG = G                       # one config per staged Join
     MW, TW = 4, 4
-    NW = (2 + G) + 5 * G + 3
+    NW = (2 + G) + 8 * G + 3
     N_NODES = 1 + G + 1
     CLIENT = G + 1
 
     # lane offsets
     M_MC, M_AMOC, M_AMOS = 0, 1, 2            # master (M_AMOS + g-1)
-    SRV = 2 + G                               # server g base: SRV + 5*(g-1)
-    C_K, C_CFG, C_CQ = SRV + 5 * G, SRV + 5 * G + 1, SRV + 5 * G + 2
+    SRV = 2 + G                               # server g base: SRV + 8*(g-1)
+    C_K = SRV + 8 * G
+    C_CFG, C_CQ = C_K + 1, C_K + 2
+    # server lane offsets within a block
+    S_CFG, S_AMO, S_CNT, S_H, S_Q, S_OUT, S_OSAMO, S_IN = range(8)
 
     def srv(g, off):
-        return SRV + 5 * (g - 1) + off
+        return SRV + 8 * (g - 1) + off
 
     def grp_of(k):
-        """Traced workload index -> owning group, via a static where-chain."""
+        """Traced workload index -> owning group under the final config
+        (static where-chain)."""
         out = jnp.asarray(groups_of[0], jnp.int32)
         for kk in range(2, W + 1):
             out = jnp.where(k == kk, groups_of[kk - 1], out)
@@ -115,6 +133,23 @@ def make_shardstore_protocol(groups_of: Sequence[int],
     blank_msg = jnp.full((1, MW), SENTINEL, jnp.int32)
     blank_set = jnp.full((1, 1 + TW), SENTINEL, jnp.int32)
 
+    # Which config index the master serves for a query arg
+    # (shardmaster.py Query: arg < 0 or >= len -> latest).
+    def served_kind(arg):
+        latest = N_CFG - 1
+        kind = jnp.where((arg < 0) | (arg >= N_CFG), latest, arg)
+        return kind.astype(jnp.int32)
+
+    # Does group g own command k's shard under configs[idx] (0-based)?
+    # cfg0 assigns everything to group 1; the final config follows
+    # groups_of.  "mine" = the config's assignment; "owned" additionally
+    # needs the handoff to have completed (S_IN == 0 for gained shards).
+    def cfg_mine(g, cfg_idx, k):
+        under_final = grp_of(k) == g
+        if g == 1:
+            return jnp.where(cfg_idx == 0, True, under_final)
+        return jnp.where(cfg_idx == 0, False, under_final)
+
     # ------------------------------------------------------------- handlers
 
     def step_message(nodes, msg):
@@ -122,11 +157,10 @@ def make_shardstore_protocol(groups_of: Sequence[int],
         sends = []
         tsets = []
 
-        # ---- QRY -> master (paxos.py handle_PaxosRequest with the
-        # ShardMaster app; n=1: fresh commands decide+execute+GC inline)
+        # ---- QRY -> master (paxos.py handle_PaxosRequest; n=1: fresh
+        # commands decide+execute+GC inline)
         is_qry = tag == QRY
-        src, seq = a, b
-        # per-source AMO lane (master): client = 0, server g = g
+        src, seq, arg = a, b, c
         for sidx in range(0, G + 1):
             lane = M_AMOC if sidx == 0 else M_AMOS + sidx - 1
             here = is_qry & (src == sidx)
@@ -137,12 +171,15 @@ def make_shardstore_protocol(groups_of: Sequence[int],
             nodes = nodes.at[M_MC].set(
                 jnp.where(fresh, nodes[M_MC] + 1,
                           nodes[M_MC]).astype(jnp.int32))
-            # reply for fresh or exactly-cached seq (AMO execute: older
-            # seqs return None -> no reply)
-            sends.append(msg_row(here & (seq >= last), QREP, src, seq))
+            # reply for fresh or exactly-cached seq; payload = the served
+            # config (dup deliveries carry the same arg, so recomputing
+            # the kind from the message matches the cached result)
+            sends.append(msg_row(here & (seq >= last), QREP, src, seq,
+                                 served_kind(arg)))
 
-        # ---- QREP -> client (shardstore.py handle_PaxosReply, client):
-        # adopt the config if none, then send the pending command
+        # ---- QREP -> client: adopt the (always latest) config if newer,
+        # then send the pending command (shardstore.py client
+        # handle_PaxosReply + _send_pending)
         is_qrep_c = (tag == QREP) & (a == 0)
         k = nodes[C_K]
         adopt = is_qrep_c & (nodes[C_CFG] == 0)
@@ -150,44 +187,75 @@ def make_shardstore_protocol(groups_of: Sequence[int],
             jnp.where(adopt, 1, nodes[C_CFG]).astype(jnp.int32))
         sends.append(msg_row(adopt & (k <= W), SSREQ, k))
 
-        # ---- QREP -> server g (shardstore.py handle_PaxosReply, server):
-        # propose NewConfig iff cfg.config_num == _next_config_num() — the
-        # master only ever serves config 0, so only a config-less server
-        # matches; deciding it bumps the count and sets heard (self-P2a).
+        # ---- QREP -> server g: propose NewConfig iff the carried config
+        # is exactly _next_config_num() and reconfig is done
+        # (shardstore.py handle_PaxosReply + _apply_new_config)
         for g in range(1, G + 1):
             here = (tag == QREP) & (a == g)
-            install = here & (nodes[srv(g, 0)] == 0)
-            nodes = nodes.at[srv(g, 0)].set(
-                jnp.where(install, 1, nodes[srv(g, 0)]).astype(jnp.int32))
-            nodes = nodes.at[srv(g, 2)].set(
-                jnp.where(install, nodes[srv(g, 2)] + 1,
-                          nodes[srv(g, 2)]).astype(jnp.int32))
-            nodes = nodes.at[srv(g, 3)].set(
-                jnp.where(install, 1, nodes[srv(g, 3)]).astype(jnp.int32))
+            kind = c                                  # configs[kind]
+            scfg = nodes[srv(g, S_CFG)]
+            done = ((nodes[srv(g, S_OUT)] == 0)
+                    & (nodes[srv(g, S_IN)] == 0))
+            install = here & (kind == scfg) & (scfg < N_CFG) & done
+            # installing the FINAL config starts the handoff (only group
+            # transitions that move shards: g1 loses, g2 gains; the first
+            # config never moves anything)
+            is_final = install & (scfg == N_CFG - 1) & (N_CFG > 1)
+            if g == 1 and G > 1:
+                nodes = nodes.at[srv(g, S_OUT)].set(
+                    jnp.where(is_final, 1,
+                              nodes[srv(g, S_OUT)]).astype(jnp.int32))
+                nodes = nodes.at[srv(g, S_OSAMO)].set(
+                    jnp.where(is_final, nodes[srv(g, S_AMO)],
+                              nodes[srv(g, S_OSAMO)]).astype(jnp.int32))
+                # leader installs -> _send_moves inline
+                sends.append(msg_row(is_final, SM, 2,
+                                     nodes[srv(g, S_AMO)]))
+            elif g == 2:
+                nodes = nodes.at[srv(g, S_IN)].set(
+                    jnp.where(is_final, 1,
+                              nodes[srv(g, S_IN)]).astype(jnp.int32))
+            nodes = nodes.at[srv(g, S_CFG)].set(
+                jnp.where(install, scfg + 1,
+                          nodes[srv(g, S_CFG)]).astype(jnp.int32))
+            nodes = nodes.at[srv(g, S_CNT)].set(
+                jnp.where(install, nodes[srv(g, S_CNT)] + 1,
+                          nodes[srv(g, S_CNT)]).astype(jnp.int32))
+            nodes = nodes.at[srv(g, S_H)].set(
+                jnp.where(install, 1, nodes[srv(g, S_H)]).astype(jnp.int32))
 
-        # ---- SSREQ -> server grp_of(k) (handle_ShardStoreRequest):
-        # ALWAYS proposes (relay-mode chosen entries are not deduped,
-        # paxos.py:349-355) -> count+1, heard; executes only with a config
-        # (shardstore.py _execute_client_command), AMO-gated.
+        # ---- SSREQ -> server grp_of(k): ALWAYS proposes (relay-mode
+        # chosen entries are not deduped, paxos.py:349-355) -> count+1,
+        # heard; execution is gated by config coverage and ownership
+        # (shardstore.py _execute_client_command)
         is_ss = tag == SSREQ
         kk = a
         kg = grp_of(kk)
         for g in range(1, G + 1):
             here = is_ss & (kg == g)
-            nodes = nodes.at[srv(g, 2)].set(
-                jnp.where(here, nodes[srv(g, 2)] + 1,
-                          nodes[srv(g, 2)]).astype(jnp.int32))
-            nodes = nodes.at[srv(g, 3)].set(
-                jnp.where(here, 1, nodes[srv(g, 3)]).astype(jnp.int32))
-            has_cfg = nodes[srv(g, 0)] == 1
-            samo = nodes[srv(g, 1)]
-            execd = here & has_cfg & (kk > samo)
-            nodes = nodes.at[srv(g, 1)].set(
+            nodes = nodes.at[srv(g, S_CNT)].set(
+                jnp.where(here, nodes[srv(g, S_CNT)] + 1,
+                          nodes[srv(g, S_CNT)]).astype(jnp.int32))
+            nodes = nodes.at[srv(g, S_H)].set(
+                jnp.where(here, 1, nodes[srv(g, S_H)]).astype(jnp.int32))
+            scfg = nodes[srv(g, S_CFG)]
+            has_cfg = scfg >= 1
+            mine = cfg_mine(g, (scfg - 1).clip(0, N_CFG - 1), kk) & has_cfg
+            # wrong group: current config exists but shard is not mine
+            sends.append(msg_row(here & has_cfg & ~mine, WG, kk))
+            # mine but still incoming -> silent (client retries); only
+            # group 2 ever gains shards, in one block per handoff
+            if g == 2 and G > 1:
+                owned = mine & (nodes[srv(g, S_IN)] == 0)
+            else:
+                owned = mine
+            samo = nodes[srv(g, S_AMO)]
+            execd = here & owned & (kk > samo)        # owned ⊆ mine
+            nodes = nodes.at[srv(g, S_AMO)].set(
                 jnp.where(execd, kk, samo).astype(jnp.int32))
-            sends.append(msg_row(here & has_cfg & (kk >= samo), SSREP, kk))
+            sends.append(msg_row(here & owned & (kk >= samo), SSREP, kk))
 
-        # ---- SSREP -> client (ClientWorker pumps the next command inside
-        # the reply handler; _send_pending needs the config we must have)
+        # ---- SSREP -> client (ClientWorker pumps the next command)
         is_rep = tag == SSREP
         match = is_rep & (a == k) & (k <= W)
         k2 = jnp.where(match, k + 1, k)
@@ -196,6 +264,48 @@ def make_shardstore_protocol(groups_of: Sequence[int],
         sends.append(msg_row(has_next, SSREQ, k2))
         tsets.append(timer_row(has_next, CLIENT, T_CLIENT,
                                CLIENT_MS, CLIENT_MS, k2))
+
+        # ---- WG -> client: re-query (shardstore.py handle_WrongGroup)
+        is_wg = (tag == WG) & (a == k) & (k <= W)
+        cq = nodes[C_CQ]
+        nodes = nodes.at[C_CQ].set(
+            jnp.where(is_wg, cq + 1, cq).astype(jnp.int32))
+        sends.append(msg_row(is_wg, QRY, 0, cq + 1, -1))
+
+        # ---- SM -> group 2: propose InstallShards when at the final
+        # config with the shards still incoming; re-ack when already
+        # installed; ignore when behind (shardstore.py handle_ShardMove)
+        if G > 1:
+            is_sm = (tag == SM) & (a == 2)
+            scfg2 = nodes[srv(2, S_CFG)]
+            at_final = scfg2 == N_CFG
+            inst = is_sm & at_final & (nodes[srv(2, S_IN)] == 1)
+            reack = is_sm & at_final & (nodes[srv(2, S_IN)] == 0)
+            nodes = nodes.at[srv(2, S_CNT)].set(
+                jnp.where(inst, nodes[srv(2, S_CNT)] + 1,
+                          nodes[srv(2, S_CNT)]).astype(jnp.int32))
+            nodes = nodes.at[srv(2, S_H)].set(
+                jnp.where(inst, 1, nodes[srv(2, S_H)]).astype(jnp.int32))
+            # AMO merge: per-client max of own and the snapshot's
+            samo2 = nodes[srv(2, S_AMO)]
+            nodes = nodes.at[srv(2, S_AMO)].set(
+                jnp.where(inst, jnp.maximum(samo2, b),
+                          samo2).astype(jnp.int32))
+            nodes = nodes.at[srv(2, S_IN)].set(
+                jnp.where(inst, 0, nodes[srv(2, S_IN)]).astype(jnp.int32))
+            sends.append(msg_row(inst | reack, SMACK, 1))
+
+            # ---- SMACK -> group 1: propose MoveDone while the handoff
+            # is outstanding (shardstore.py handle_ShardMoveAck)
+            is_ack = (tag == SMACK) & (a == 1)
+            fin = is_ack & (nodes[srv(1, S_OUT)] == 1)
+            nodes = nodes.at[srv(1, S_CNT)].set(
+                jnp.where(fin, nodes[srv(1, S_CNT)] + 1,
+                          nodes[srv(1, S_CNT)]).astype(jnp.int32))
+            nodes = nodes.at[srv(1, S_H)].set(
+                jnp.where(fin, 1, nodes[srv(1, S_H)]).astype(jnp.int32))
+            nodes = nodes.at[srv(1, S_OUT)].set(
+                jnp.where(fin, 0, nodes[srv(1, S_OUT)]).astype(jnp.int32))
 
         sends = jnp.concatenate(sends + [blank_msg] * (MAX_SENDS - len(sends)))
         tsets = jnp.concatenate(tsets + [blank_set] * (MAX_SETS - len(tsets)))
@@ -207,8 +317,8 @@ def make_shardstore_protocol(groups_of: Sequence[int],
         tsets = []
 
         # ---- ClientTimer (shardstore.py on_ClientTimer): re-query (+1
-        # more query when there is no config yet — _send_pending falls back
-        # to _query_config) and re-send the pending command.
+        # more query when there is no config yet — _send_pending falls
+        # back to _query_config) and re-send the pending command.
         k = nodes[C_K]
         live = ((node_idx == CLIENT) & (tag == T_CLIENT) & (p0 == k)
                 & (k <= W))
@@ -225,20 +335,28 @@ def make_shardstore_protocol(groups_of: Sequence[int],
 
         for g in range(1, G + 1):
             here = node_idx == g
-            # ---- QueryTimer (shardstore.py on_QueryTimer): leader always,
-            # reconfig always done -> fresh query for the next config num.
+            # ---- QueryTimer (shardstore.py on_QueryTimer): the query
+            # itself is gated on _reconfig_done; _send_moves always runs
+            # (re-sends the stored ShardMove while a handoff is pending).
             is_q = here & (tag == T_QUERY)
-            sq = nodes[srv(g, 4)]
-            nodes = nodes.at[srv(g, 4)].set(
-                jnp.where(is_q, sq + 1, sq).astype(jnp.int32))
-            sends.append(msg_row(is_q, QRY, g, sq + 1, nodes[srv(g, 0)]))
+            done = ((nodes[srv(g, S_OUT)] == 0)
+                    & (nodes[srv(g, S_IN)] == 0))
+            ask = is_q & done
+            sq = nodes[srv(g, S_Q)]
+            nodes = nodes.at[srv(g, S_Q)].set(
+                jnp.where(ask, sq + 1, sq).astype(jnp.int32))
+            sends.append(msg_row(ask, QRY, g, sq + 1,
+                                 nodes[srv(g, S_CFG)]))
+            if g == 1 and G > 1:
+                sends.append(msg_row(is_q & (nodes[srv(1, S_OUT)] == 1),
+                                     SM, 2, nodes[srv(1, S_OSAMO)]))
             tsets.append(timer_row(is_q, g, T_QUERY, QUERY_MS, QUERY_MS, 0))
 
             # ---- ElectionTimer (paxos.py on_ElectionTimer): the lone
             # server is its own decided leader; only heard resets.
             is_el = here & (tag == T_ELECTION)
-            nodes = nodes.at[srv(g, 3)].set(
-                jnp.where(is_el, 0, nodes[srv(g, 3)]).astype(jnp.int32))
+            nodes = nodes.at[srv(g, S_H)].set(
+                jnp.where(is_el, 0, nodes[srv(g, S_H)]).astype(jnp.int32))
             tsets.append(timer_row(is_el, g, T_ELECTION,
                                    ELECTION_MIN, ELECTION_MAX, 0))
 
@@ -255,16 +373,18 @@ def make_shardstore_protocol(groups_of: Sequence[int],
     # Row budgets = the TOTAL rows each step function appends (rows are
     # individually condition-masked; the pad/slice below must never
     # truncate a real row).  step_message: (G+1) QREP + 1 client SSREQ +
-    # G SSREP + 1 pumped SSREQ; step_timer: 2 client + G query sends.
-    MAX_SENDS = 2 * G + 3
+    # G-block QREP rows (1 SM for g1 when G>1) + 2G SSREQ rows (WG +
+    # SSREP per g) + 1 pumped SSREQ + CT + 1 WG-requery + (SMACK) rows.
+    MAX_SENDS = (G + 1) + 1 + (1 if G > 1 else 0) + 2 * G + 1 + 1 + (
+        1 if G > 1 else 0)
     MAX_SETS = 1 + 3 * G        # client CT + per-server query/election/hb
 
     # ------------------------------------------------------------- initials
 
     def init_nodes():
         nodes = np.zeros((NW,), np.int32)
-        nodes[M_MC] = 1          # the staged Join is decided slot 1
-        nodes[C_K] = 1           # PUT(1) pending
+        nodes[M_MC] = G          # one decided Join per group
+        nodes[C_K] = 1           # first command pending
         # init() queries once; send_command -> _send_pending with no
         # config falls back to _query_config and queries AGAIN
         # (shardstore.py:624-650), so two queries are already in flight.
@@ -291,7 +411,8 @@ def make_shardstore_protocol(groups_of: Sequence[int],
         dest = jnp.where(tag == QREP,
                          jnp.where(a == 0, CLIENT, a), dest)
         dest = jnp.where(tag == SSREQ, grp_of(msg[1]), dest)
-        dest = jnp.where(tag == SSREP, CLIENT, dest)
+        dest = jnp.where((tag == SSREP) | (tag == WG), CLIENT, dest)
+        dest = jnp.where((tag == SM) | (tag == SMACK), a, dest)
         return dest
 
     def clients_done(state):

@@ -1,7 +1,9 @@
-"""Lab 4 tensor-twin parity: the sharded-store search configuration
-(ShardStorePart1Test.test10 shape — one single-server group, one shard
-master, static post-Join config, CCA/master timers frozen) must produce
-the object checker's exact unique-state counts depth by depth.
+"""Lab 4 tensor-twin parity: the sharded-store search configurations
+(ShardStorePart1Test.test10/test11 shapes — single-server groups, one
+shard master, CCA/master timers frozen) must produce the object
+checker's exact unique-state counts depth by depth.  The 2-group config
+exercises the config walk (None -> cfg0 -> cfg1), WrongGroup routing,
+and the g1 -> g2 shard handoff (ShardMove/InstallShards/Ack/MoveDone).
 """
 
 import os
@@ -26,12 +28,22 @@ SLOW = pytest.mark.skipif(
     reason="long object-oracle search (set DSLABS_SLOW_TESTS=1)")
 
 
-def _object_joined(max_levels):
-    state = lab4.make_search(1, 1, 1, 10)
-    joined = lab4._joined_state(state, 1)
-    joined.add_client_worker(
-        LocalAddress("client1"),
-        kv_workload(["PUT:foo:bar", "GET:foo"], ["PutOk", "bar"]))
+# (commands, expected results, per-command owning group under the final
+# config — key-1 -> shard 1 -> g1, key-6 -> shard 6 -> g2 after the
+# staged Join(1), Join(2) rebalance of 10 shards)
+WORKLOADS = {
+    1: (["PUT:foo:bar", "GET:foo"], ["PutOk", "bar"], [1, 1]),
+    2: (["PUT:key-1:v1", "PUT:key-6:v6", "GET:key-1"],
+        ["PutOk", "PutOk", "v1"], [1, 2, 1]),
+}
+
+
+def _object_joined(max_levels, n_groups=1):
+    cmds, results, _ = WORKLOADS[n_groups]
+    state = lab4.make_search(n_groups, 1, 1, 10)
+    joined = lab4._joined_state(state, n_groups)
+    joined.add_client_worker(LocalAddress("client1"),
+                             kv_workload(cmds, results))
     settings = SearchSettings().max_time(600)
     settings.add_invariant(RESULTS_OK)
     settings.node_active(lab4.CCA, False)
@@ -49,6 +61,15 @@ def test_lab4_depth_parity():
     ten = TensorSearch(make_shardstore_protocol([1, 1]), chunk=256,
                        max_depth=3).run()
     assert ten.unique_states == obj.discovered_count == 74
+
+
+def test_lab4_two_group_depth_parity():
+    """2-group config-walk/handoff parity (verified by hand for depths
+    1-5: 8/38/142/467/1411); CI checks depth 3 unconditionally."""
+    obj = _object_joined(3, n_groups=2)
+    ten = TensorSearch(make_shardstore_protocol(WORKLOADS[2][2]),
+                       chunk=256, max_depth=3).run()
+    assert ten.unique_states == obj.discovered_count == 142
 
 
 @SLOW

@@ -1,5 +1,6 @@
 """Dev: depth-by-depth unique-state parity of the lab4 twin vs the object
-checker on the test10 config."""
+checker on the test10 (1 group) and test11 (2 groups, config walk +
+handoff) configs.  Usage: python tools/parity_lab4.py [n_groups] [maxd]"""
 
 import os
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -17,15 +18,16 @@ import tests.test_lab4_shardstore as t
 
 from dslabs_tpu.tpu.engine import TensorSearch
 from dslabs_tpu.tpu.protocols.shardstore import make_shardstore_protocol
+from tests.test_tpu_lab4 import WORKLOADS
 
 
-def object_counts(max_depth):
-    state = t.make_search(1, 1, 1, 10)
-    joined = t._joined_state(state, 1)
-    joined.add_client_worker(
-        LocalAddress("client1"),
-        kv_workload(["PUT:foo:bar", "GET:foo"], ["PutOk", "bar"]))
-    settings = SearchSettings().max_time(600)
+def object_counts(n_groups, max_depth):
+    cmds, results, _ = WORKLOADS[n_groups]
+    state = t.make_search(n_groups, 1, 1, 10)
+    joined = t._joined_state(state, n_groups)
+    joined.add_client_worker(LocalAddress("client1"),
+                             kv_workload(cmds, results))
+    settings = SearchSettings().max_time(1200)
     settings.add_invariant(RESULTS_OK)
     settings.node_active(t.CCA, False)
     settings.deliver_timers(t.CCA, False)
@@ -37,10 +39,11 @@ def object_counts(max_depth):
 
 
 def main():
-    # PUT:foo:bar, GET:foo both key "foo" -> one group anyway
-    proto = make_shardstore_protocol([1, 1])
-    for depth in range(1, 6):
-        oc, oe = object_counts(depth)
+    n_groups = int(sys.argv[1]) if len(sys.argv) > 1 else 1
+    maxd = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+    proto = make_shardstore_protocol(WORKLOADS[n_groups][2])
+    for depth in range(1, maxd + 1):
+        oc, oe = object_counts(n_groups, depth)
         ten = TensorSearch(proto, chunk=256, max_depth=depth).run()
         flag = "OK " if ten.unique_states == oc else "MISMATCH"
         print(f"depth {depth}: object={oc} tensor={ten.unique_states} "
