@@ -4,22 +4,26 @@ Scaling design (SURVEY §2.10, §5): the frontier, the visited set, and the
 next-frontier accumulator all live in device HBM, sharded over the
 ``search`` mesh axis.  Each BFS level is a sequence of chunk steps — every
 device expands a chunk of its frontier shard with the same vmapped
-transition the single-chip engine uses, then successors are exchanged by
-**fingerprint ownership** (device = key_hi mod D) with ``lax.all_to_all``
-over ICI so each device deduplicates exactly the keys it owns against its
-own **open-addressing hash table in HBM** (double hashing, bounded probe
-loop — membership and insert in one pass, a few [batch]-row
-gathers/scatters per probe instead of an O(V log V) sort-merge per
-chunk).  This is the classic hash-partitioned
-distributed BFS, mapped onto XLA collectives instead of the reference's
-shared-memory ConcurrentHashMap (Search.java:405-505); with a 1-device
-mesh it degenerates into the device-resident single-chip engine (the
-all_to_all is an identity), which is how the TPU bench runs.
+transition the single-chip engine uses, then successor FINGERPRINTS
+(16 bytes each — state rows never ride the interconnect per chunk) are
+exchanged by **key ownership** (device = key_hi mod D) with
+``lax.all_to_all`` over ICI.  Each owner deduplicates the keys it owns
+against its **open-addressing hash table in HBM** — 8-slot buckets read
+as one aligned 128-byte line, membership and insert in one bounded probe
+loop, claim conflicts serialised by a per-bucket min-index reservation —
+and returns a fresh flag to each key's producer via a reverse
+all_to_all; producers append their own fresh rows locally.  Between
+levels the frontier is REBALANCED across the mesh (contiguous shares +
+one all_to_all + one compaction — the only wide row movement, at level
+granularity).  This is the classic hash-partitioned distributed BFS,
+mapped onto XLA collectives instead of the reference's shared-memory
+ConcurrentHashMap (Search.java:405-505); with a 1-device mesh the
+collectives are identities, which is how the TPU bench runs.
 
-Host involvement per level: one scalar readback (per-device frontier
-counts + overflow/terminal counters) to decide the next chunk count and
-check termination.  No state rows cross the host boundary until a
-terminal state must be reported.
+Host involvement per level: one fused scalar readback (frontier counts +
+overflow/terminal counters) to decide the next chunk count and check
+termination.  No state rows cross the host boundary until a terminal
+state must be reported; even the initial carry is built on device.
 
 Everything on device is int32/uint32 (TPU-native dtypes; no x64).  All
 fixed-capacity structures (routing buckets, frontier shards, visited
