@@ -1,0 +1,26 @@
+# Convenience targets mirroring the reference's Makefile/run-tests entry
+# points (there is no build step: the framework is pure Python + JAX).
+
+PY ?= python
+
+.PHONY: test test-fast lab0 lab1 lab2 lab3 lab4 bench dryrun clean
+
+test:            ## full acceptance + parity suite
+	$(PY) -m pytest tests/ -q
+
+test-fast:       ## skip the slowest files (TPU-engine parity compiles)
+	$(PY) -m pytest tests/ -q --ignore=tests/test_tpu_engine.py \
+	    --ignore=tests/test_tpu_sharded.py --ignore=tests/test_tpu_lab4.py
+
+lab0 lab1 lab2 lab3 lab4:   ## scored lab runs via the CLI driver
+	$(PY) run_tests.py --lab $(subst lab,,$@)
+
+bench:           ## TPU states/min benchmark (one JSON line)
+	$(PY) bench.py
+
+dryrun:          ## multi-chip sharding dry run on a virtual CPU mesh
+	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+clean:
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true
+	rm -rf .pytest_cache

@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Batch grader: run the lab test suites for each submission and scrape
+one summary line per (submission, lab).
+
+Mirrors the reference's grading/grader.py:44-58 workflow — each
+submission is graded in a scratch overlay (the framework tree with the
+submission's ``dslabs_tpu/labs/`` dropped in), each lab runs
+``TIMES_TO_RUN`` times under a timeout, and the per-test JSON results
+written by run_tests.py are aggregated into a CSV.
+
+Usage:
+    python grading/grader.py --submissions subs/ --labs 1 2 3 --out grades.csv
+
+``subs/`` holds one directory per student, each containing a
+``dslabs_tpu/labs/`` tree (or a ``labs/`` tree at its root).  With no
+--submissions, the framework's own reference labs are graded (a
+self-check that every lab scores full points).
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TIMES_TO_RUN = 2          # best score of N runs (grading/grader.py:44)
+TIMEOUT_SECS = 600        # per lab run (reference: 10-minute timeout)
+
+
+def _overlay(submission: str | None, extra_ignores: tuple = ()) -> str:
+    """Copy the framework into a scratch dir, dropping in the
+    submission's labs tree when given.  ``extra_ignores`` keeps the
+    submissions directory itself out of the overlays when it lives under
+    the repo root (otherwise N overlays each copy all N submissions)."""
+    scratch = tempfile.mkdtemp(prefix="dslabs-grade-")
+    dst = os.path.join(scratch, "repo")
+    shutil.copytree(REPO, dst, ignore=shutil.ignore_patterns(
+        ".git", "__pycache__", ".pytest_cache", "traces", "grading",
+        *extra_ignores))
+    if submission:
+        for rel in ("dslabs_tpu/labs", "labs"):
+            src = os.path.join(submission, rel)
+            if os.path.isdir(src):
+                target = os.path.join(dst, "dslabs_tpu", "labs")
+                shutil.rmtree(target)
+                shutil.copytree(src, target)
+                break
+        else:
+            raise FileNotFoundError(
+                f"{submission}: no dslabs_tpu/labs/ or labs/ tree")
+    return dst
+
+
+def _run_lab(tree: str, lab: str, results_path: str) -> dict:
+    """One scored lab run; returns the parsed JSON results (or a stub)."""
+    # Belt and braces: the CLI flag below is authoritative; the env var
+    # (read by dslabs_tpu/utils/flags.py) covers run_tests.py variants in
+    # submissions that predate the flag.
+    env = dict(os.environ, DSLABS_RESULTS_OUTPUT_FILE=results_path)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "run_tests.py", "--lab", lab,
+             "--results-file", results_path],
+            cwd=tree, env=env, capture_output=True, text=True,
+            timeout=TIMEOUT_SECS)
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
+        return {"error": f"timeout after {TIMEOUT_SECS}s",
+                "points": 0, "total": 0, "passed": 0, "tests": 0}
+    if os.path.exists(results_path):
+        with open(results_path) as f:
+            data = json.load(f)
+        return {
+            "points": data.get("points_earned", 0),
+            "total": data.get("points_available", 0),
+            "passed": data.get("num_passed", 0),
+            "tests": data.get("num_tests", 0),
+            "rc": rc,
+        }
+    tail = (proc.stderr or proc.stdout).strip().splitlines()
+    return {"error": tail[-1][:200] if tail else f"rc={rc}",
+            "points": 0, "total": 0, "passed": 0, "tests": 0}
+
+
+def grade(submission: str | None, labs: list, name: str,
+          extra_ignores: tuple = ()) -> list:
+    tree = _overlay(submission, extra_ignores)
+    rows = []
+    try:
+        for lab in labs:
+            best = None
+            for attempt in range(TIMES_TO_RUN):
+                res = _run_lab(tree, lab, os.path.join(
+                    tree, f"results-lab{lab}-{attempt}.json"))
+                if best is None or res["points"] > best["points"]:
+                    best = res
+                if best.get("total") and best["points"] == best["total"]:
+                    break     # full marks; no need to re-run
+            rows.append({"submission": name, "lab": lab, **best})
+            print(f"{name} lab {lab}: {best.get('points', 0)}/"
+                  f"{best.get('total', '?')} points "
+                  f"({best.get('passed', 0)}/{best.get('tests', '?')} tests)"
+                  + (f"  [{best['error']}]" if "error" in best else ""),
+                  flush=True)
+    finally:
+        shutil.rmtree(os.path.dirname(tree), ignore_errors=True)
+    return rows
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--submissions", help="directory of per-student trees "
+                    "(default: grade the reference labs in place)")
+    ap.add_argument("--labs", nargs="+", default=["0", "1", "2", "3", "4"])
+    ap.add_argument("--out", default="grades.csv")
+    args = ap.parse_args()
+
+    all_rows = []
+    if args.submissions:
+        subs_abs = os.path.abspath(args.submissions)
+        ignores = ((os.path.basename(subs_abs.rstrip(os.sep)),)
+                   if subs_abs.startswith(REPO) else ())
+        for name in sorted(os.listdir(args.submissions)):
+            path = os.path.join(args.submissions, name)
+            if os.path.isdir(path):
+                all_rows += grade(path, args.labs, name, ignores)
+    else:
+        all_rows += grade(None, args.labs, "reference")
+
+    fields = ["submission", "lab", "points", "total", "passed", "tests",
+              "rc", "error"]
+    with open(args.out, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=fields, extrasaction="ignore")
+        w.writeheader()
+        w.writerows(all_rows)
+    print(f"wrote {args.out} ({len(all_rows)} rows)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
