@@ -151,6 +151,18 @@ class TxPrepare(Message, Command):
     tx: AMOCommand
     round: int  # retry round; stale-round votes/decisions are ignored
     coordinator_group: int
+    # The coordinator's config when it computed the participant set.  A
+    # participant on a DIFFERENT config votes abort: a config-lagging
+    # group can believe it owns none of the tx's shards, in which case
+    # "my_shards <= owned" is vacuously true and it would vote yes with
+    # no values and no locks — committing a transaction whose writes it
+    # then silently drops (observed as a lost MultiPut write under
+    # unreliable delivery in test06).
+    config_num: int
+    # The coordinator group's members, so the abort vote can be routed
+    # even when the voter's config no longer lists the coordinator group
+    # (e.g. it was removed by a Leave the voter already installed).
+    coordinator_members: Tuple[Address, ...]
 
 
 @dataclass(frozen=True)
@@ -403,7 +415,8 @@ class ShardStoreServer(ShardStoreNode):
 
     def _send_prepares(self, tx_id) -> None:
         entry = self.coord[tx_id]
-        prepare = TxPrepare(entry[0], entry[5], self.group_id)
+        prepare = TxPrepare(entry[0], entry[5], self.group_id,
+                            self.current_config.config_num, self.group)
         groups = self.current_config.groups()
         for g in self._participant_groups(entry[0].command):
             if g not in entry[1]:
@@ -417,6 +430,17 @@ class ShardStoreServer(ShardStoreNode):
         if done is not None:
             self._send_vote_to(c.coordinator_group,
                                TxVote(tx_id, c.round, self.group_id, True, ()))
+            return
+        if self.current_config.config_num != c.config_num:
+            # Config mismatch: our shard view disagrees with the
+            # coordinator's participant computation — vote abort so the
+            # client retries after the configs converge (see TxPrepare).
+            # Routed via the prepare's own member list: the coordinator
+            # group may be absent from OUR config (a Leave we already
+            # installed), and a dropped vote would wedge it forever.
+            if self.paxos.is_leader():
+                self.broadcast(TxVote(tx_id, c.round, self.group_id,
+                                      False, ()), c.coordinator_members)
             return
         cur = self.prepared.get(tx_id)
         if cur is not None and cur[4] != c.round:

@@ -29,6 +29,7 @@ from dslabs_tpu.testing.generator import NodeGenerator
 from dslabs_tpu.testing.predicates import CLIENTS_DONE, RESULTS_OK
 
 CCA = LocalAddress("configController")
+MOVER = LocalAddress("mover")
 NUM_SHARDS = 10
 
 
@@ -94,7 +95,10 @@ def _make_generator(servers_per_group, num_shard_masters, num_shards):
         return ShardStoreServer(a, masters, num_shards, grp, g)
 
     def client_supplier(a):
-        if a == CCA:
+        # Config-controller-style clients (CCA, the movement driver) talk
+        # to the shard-master group directly; everything else is a store
+        # client routing by shard.
+        if a == CCA or a == MOVER:
             return PaxosClient(a, masters)
         return ShardStoreClient(a, masters, num_shards)
 
@@ -415,7 +419,7 @@ def _constant_movement(deliver_rate=None, length_secs=8):
 
     def mover(stop):
         rng = _random.Random(9)
-        mc = state.add_client(LocalAddress("mover"))
+        mc = state.add_client(MOVER)
         mover_client[0] = mc
         while not stop.is_set():
             g = rng.randrange(1, 4)
@@ -737,7 +741,7 @@ def _repeated_puts_gets(deliver_rate=None, with_movement=False,
     if with_movement:
         def mover():
             rng = _random.Random(13)
-            mc = state.add_client(LocalAddress("mover"))
+            mc = state.add_client(MOVER)
             while not stop.is_set():
                 try:
                     mc.send_command(Move(rng.randrange(1, 3),
