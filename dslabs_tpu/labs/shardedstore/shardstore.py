@@ -485,6 +485,15 @@ class ShardStoreServer(ShardStoreNode):
 
     def _apply_tx_vote(self, c: TxVote) -> None:
         entry = self.coord.get(c.tx_id)
+        # The `entry[2] is not None` guard is load-bearing beyond plain
+        # idempotence: a participant that voted YES for round r can later
+        # emit ABORT for the SAME round (duplicate TxPrepare delivered
+        # after it installed a newer config — the config-mismatch abort
+        # path in _apply_tx_prepare).  Once the round's decision is
+        # fixed, every late vote must be ignored or that interleaving
+        # would flip a committed transaction to aborted after the
+        # client already got its reply (pinned by
+        # test_yes_then_abort_same_round_duplicate).
         if entry is None or entry[2] is not None or c.round != entry[5]:
             return
         entry[1][c.group_id] = (c.ok, c.values)

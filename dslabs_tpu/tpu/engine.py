@@ -130,6 +130,14 @@ class TensorProtocol:
     # optional masks: deliver_message(msg)->bool, deliver_timer(node)->bool
     deliver_message: Optional[Callable] = None
     deliver_timer: Optional[Callable] = None
+    # Max SIMULTANEOUS valid send rows any single transition can emit.
+    # ``max_sends`` is the static row budget summed over all (mutually
+    # exclusive) handler branches; the live count is far smaller (lab3:
+    # 29 rows budgeted, <= ~12 ever valid at once).  When set, the engine
+    # compacts sends to this width before the set-insert merge — the
+    # merge is O(S x CAP) so this directly shrinks the hot loop.  Too
+    # small a value is a loud CapacityOverflow, never silent truncation.
+    max_live_sends: Optional[int] = None
     # optional object-twin decoders for trace reconstruction
     # (tpu/trace.py): decode_message(np_record) -> (from_addr, to_addr,
     # Message); decode_timer(node_idx, np_record) -> (to_addr, Timer,
@@ -303,25 +311,48 @@ def canonicalize_net(net: jnp.ndarray) -> jnp.ndarray:
     return out[:cap]
 
 
+def compact_rows(rows: jnp.ndarray,
+                 budget: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Compact occupied rows (lane 0 != SENTINEL) of [R, W] into the first
+    ``budget`` slots of a [budget, W] output, preserving order; returns
+    ``(out, overflow)`` where overflow counts occupied rows beyond the
+    budget (callers treat nonzero as fatal — a dropped row would corrupt
+    the successor state, never a beam-style truncation).
+
+    One-hot select-reduce over the [budget, R] grid — static indexing
+    only (a pos-indexed scatter per pair is the slow dynamic path)."""
+    occ = rows[:, 0] != SENTINEL
+    pos = jnp.cumsum(occ) - 1
+    hit = occ[None, :] & (pos[None, :] == jnp.arange(budget)[:, None])
+    out = jnp.sum(jnp.where(hit[:, :, None], rows[None, :, :], 0), axis=1)
+    out = jnp.where(jnp.any(hit, axis=1)[:, None], out, SENTINEL)
+    overflow = jnp.sum(occ & (pos >= budget)).astype(jnp.int32)
+    return out, overflow
+
+
 def insert_messages(net: jnp.ndarray,
                     sends: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Set-insert up to MAX_SENDS records into the canonical network.
+    """Set-insert up to S records into the canonical network.
 
     Sort-free merge: ``net`` is always in canonical form (occupied rows
     first, raw-lane ascending — every state enters the engine through
     :func:`canonicalize_net` or this function), so inserting S small
     ``sends`` needs only O(S x CAP) lexicographic comparisons to compute
-    each row's merged rank, then one gather to materialise the result.
-    The round-2 profile showed the previous sort-per-(state,event)
-    version was 82% of the whole expand program; a fingerprint-keyed
-    variant of the compare was 6x slower than raw-lane compares (uint32
-    multiplies dominate on the VPU).
+    each row's merged rank.  The round-2 profile showed a
+    sort-per-(state,event) version was 82% of the whole expand program;
+    round 3 replaced the remaining O(CAP^2) one-hot placement of net rows
+    with S+1 STATIC shifted slices: net row j lands at j + shift_j where
+    shift_j = #valid sends below it <= S, so out[k] selects among
+    net[k-c] for c in 0..S — an O(CAP x S) select chain with no dynamic
+    indexing.  Callers compact ``sends`` to the protocol's
+    ``max_live_sends`` first, which is what makes S genuinely small.
 
     Returns ``(net', overflow)`` where overflow counts distinct occupied
     records that did not fit back into capacity — the caller surfaces any
     nonzero count as a CapacityOverflow (never a silent truncation)."""
     cap = net.shape[0]
     s = sends.shape[0]
+    w = net.shape[1]
     net_occ = net[:, 0] != SENTINEL                       # [cap]
     send_occ = sends[:, 0] != SENTINEL                    # [s]
     sn_less = _row_less(sends[:, None, :], net[None, :, :])  # send_i < net_j
@@ -342,27 +373,29 @@ def insert_messages(net: jnp.ndarray,
         (ss_less.T | (ss_eq & earlier)) & valid[None, :], axis=1)
     dst_send = net_below + sends_below                    # [s]
 
-    # Each occupied net row j sits at rank j already; valid sends below it
-    # push it right.
-    dst_net = (jnp.arange(cap) +
-               jnp.sum(sn_less & valid[:, None], axis=0))  # [cap]
-
-    # One-hot inversion: for each output slot, select the source row via a
-    # 0/1 matmul — STATIC indexing only.  (An argmax+gather formulation
-    # here lowered to per-pair dynamic gathers; materialising those under
-    # the engine's flat vmap ran at ~1 GB/s on TPU — the round-2
-    # bottleneck.  Each output slot has at most one hit, so the int32
-    # products sum exactly.)
+    # Net row j lands at j + shift_j (valid sends below push it right);
+    # place via S+1 static shifted slices: out[k] = net[k-c] when
+    # shift[k-c] == c and net[k-c] occupied.
+    shift = jnp.sum(sn_less & valid[:, None], axis=0)      # [cap]
+    pad_rows = jnp.full((s, w), SENTINEL, net.dtype)
+    pnet = jnp.concatenate([pad_rows, net])                # [s+cap, w]
+    pshift = jnp.concatenate([jnp.full((s,), -1, shift.dtype), shift])
+    pocc = jnp.concatenate([jnp.zeros((s,), bool), net_occ])
+    out = jnp.zeros((cap, w), net.dtype)
+    any_hit = jnp.zeros((cap,), bool)
+    for c in range(s + 1):
+        lo = s - c
+        hit = (pshift[lo:lo + cap] == c) & pocc[lo:lo + cap]
+        out = out + jnp.where(hit[:, None], pnet[lo:lo + cap], 0)
+        any_hit = any_hit | hit
+    # Send placement: [cap, s] one-hot select-reduce (S is small).
     k = jnp.arange(cap)
-    hit_net = net_occ[None, :] & (dst_net[None, :] == k[:, None])  # [cap,cap]
     hit_send = valid[None, :] & (dst_send[None, :] == k[:, None])  # [cap,s]
     # Masked select-reduce, not an int32 einsum: integer-multiply
     # dot_general lowers to slow VPU loops, while where+sum fuses.
-    out = (jnp.sum(jnp.where(hit_net[:, :, None], net[None, :, :], 0),
-                   axis=1)
-           + jnp.sum(jnp.where(hit_send[:, :, None], sends[None, :, :], 0),
-                     axis=1))
-    any_hit = jnp.any(hit_net, axis=1) | jnp.any(hit_send, axis=1)
+    out = out + jnp.sum(
+        jnp.where(hit_send[:, :, None], sends[None, :, :], 0), axis=1)
+    any_hit = any_hit | jnp.any(hit_send, axis=1)
     out = jnp.where(any_hit[:, None], out, SENTINEL)
     total = (jnp.sum(net_occ) + jnp.sum(valid)).astype(jnp.int32)
     overflow = jnp.maximum(total - cap, 0).astype(jnp.int32)
@@ -458,13 +491,25 @@ class TensorSearch:
                  max_depth: Optional[int] = None,
                  max_secs: Optional[float] = None,
                  record_trace: bool = False,
-                 in_chunk_dedup: bool = True):
+                 in_chunk_dedup: bool = True,
+                 ev_budget: Optional[int] = None):
         self.p = protocol
         self.frontier_cap = frontier_cap
         self.chunk = chunk
         self.max_depth = max_depth
         self.max_secs = max_secs
         self.record_trace = record_trace
+        # Occupancy-compacted event enumeration: expand only each state's
+        # VALID events (occupied messages + deliverable timers), packed
+        # into ``ev_budget`` pair slots per state, instead of the full
+        # net_cap + nn*timer_cap grid (bench protocol: mean ~30 valid of
+        # 94 grid slots at depth 16).  None = full grid (always safe).
+        # A state with more valid events than the budget overflows LOUDLY
+        # (base engine: CapacityOverflow; sharded strict: same; sharded
+        # beam: counted in SearchOutcome.dropped — coverage truncation,
+        # same class as a frontier-cap drop).
+        self._ev_slots = (min(ev_budget, self._grid_events(protocol))
+                          if ev_budget else self._grid_events(protocol))
         # When False, _expand_chunk marks every valid successor unique and
         # dedup is entirely the caller's job — only meaningful for drivers
         # with their own dedup authority (the sharded engine's owner-side
@@ -501,8 +546,15 @@ class TensorSearch:
         return {"nodes": nodes, "net": net, "timers": timers,
                 "exc": jnp.zeros((1,), jnp.int32)}
 
+    @staticmethod
+    def _grid_events(p: TensorProtocol) -> int:
+        return p.net_cap + p.n_nodes * p.timer_cap
+
     def _num_events(self) -> int:
-        return self.p.net_cap + self.p.n_nodes * self.p.timer_cap
+        """Pair slots per state in the expand program (the successor-row
+        stride): the compacted budget when ev_budget is set, else the full
+        event grid."""
+        return self._ev_slots
 
     def _step_one(self, state_slice: dict, event_idx: jnp.ndarray):
         """Expand ONE state by ONE event index -> (successor, valid, over)."""
@@ -555,6 +607,15 @@ class TensorSearch:
         # reference captures the throwable after hooks ran,
         # SearchState.java:218-222), but the state is terminal (run() ends).
 
+        send_over = jnp.int32(0)
+        if (p.max_live_sends is not None
+                and p.max_live_sends < p.max_sends):
+            # max_sends is the sum over mutually exclusive branches; the
+            # live rows are far fewer.  Compacting here shrinks the
+            # O(S x CAP) merge below; overflow is semantic (a dropped send
+            # corrupts the successor) and stays fatal.
+            sends, send_over = compact_rows(sends, p.max_live_sends)
+
         net2, net_over = insert_messages(net, sends)
         # Firing consumes the timer (SearchState.java:357); the updated
         # queue lands via the node one-hot, never a dynamic scatter.
@@ -562,20 +623,62 @@ class TensorSearch:
         timers2 = jnp.where((~is_msg & n_oh)[:, None, None],
                             fired_q[None], timers)
         timers2, t_over = append_timers(timers2, new_t)
-        over = (net_over + t_over) * valid.astype(jnp.int32)
+        over = (net_over + t_over + send_over) * valid.astype(jnp.int32)
         succ = {"nodes": nodes2, "net": net2, "timers": timers2,
                 "exc": exc}
         return succ, valid, over
 
+    def _event_table(self, chunk_state: dict, chunk_valid: jnp.ndarray):
+        """[C]-state chunk -> ([C, B] int32 compacted event ids (-1 =
+        empty slot), ev_drops scalar): each state's VALID events (occupied
+        network rows + deliverable timers, masked by the protocol's
+        deliver_* settings — exactly the predicates :meth:`_step_one`
+        re-checks) packed into the ``ev_budget`` pair slots.  Events
+        beyond the budget are counted, never silently skipped."""
+        p = self.p
+        grid = self._grid_events(p)
+        b = self._ev_slots
+        c = chunk_valid.shape[0]
+        if b >= grid:
+            ids = jnp.broadcast_to(jnp.arange(grid, dtype=jnp.int32),
+                                   (c, grid))
+            return ids, jnp.int32(0)
+        msg_ok = chunk_state["net"][:, :, 0] != SENTINEL   # [C, net_cap]
+        if p.deliver_message is not None:
+            msg_ok = msg_ok & jax.vmap(jax.vmap(p.deliver_message))(
+                chunk_state["net"])
+        tmask = jax.vmap(jax.vmap(timer_deliverable_mask))(
+            chunk_state["timers"])                         # [C, NN, T_CAP]
+        if p.deliver_timer is not None:
+            dt = jax.vmap(p.deliver_timer)(jnp.arange(p.n_nodes))
+            tmask = tmask & dt[None, :, None]
+        valid_ev = jnp.concatenate(
+            [msg_ok, tmask.reshape(c, -1)], axis=1)        # [C, grid]
+        valid_ev = valid_ev & chunk_valid[:, None]
+        pos = jnp.cumsum(valid_ev, axis=1) - 1
+        # ids[i, k] = the event id whose compact rank is k: one-hot
+        # select-reduce over the [C, B, grid] cube (static indexing; the
+        # cube is per-CHUNK, not per-pair, so it is cheap).
+        hit = valid_ev[:, None, :] & (
+            pos[:, None, :] == jnp.arange(b)[None, :, None])
+        ids = jnp.sum(jnp.where(hit, jnp.arange(grid, dtype=jnp.int32)
+                                [None, None, :], 0), axis=2)
+        ids = jnp.where(jnp.any(hit, axis=2), ids, -1)
+        ev_drops = jnp.sum(valid_ev & (pos >= b)).astype(jnp.int32)
+        return ids, ev_drops
+
     def _expand_chunk(self, chunk_state: dict, chunk_valid: jnp.ndarray):
         """[C]-state chunk -> successors + fingerprints + masks + flags.
 
-        Returns (flat_successors [C*E], valids [C*E], fp [C*E, 4] uint32,
-        unique [C*E] in-chunk-first-occurrence mask, overflow scalar,
-        flags dict) — all device arrays; no host sync inside."""
+        Returns (flat_successors [C*B], valids [C*B], fp [C*B, 4] uint32,
+        unique [C*B] in-chunk-first-occurrence mask, overflow scalar,
+        ev_drops scalar, event_ids [C, B], flags dict) — all device
+        arrays; no host sync inside.  B = the per-state pair-slot count
+        (``ev_budget`` or the full event grid)."""
         p = self.p
-        ne = self._num_events()
+        ne = self._ev_slots
         c = chunk_valid.shape[0]
+        event_ids, ev_drops = self._event_table(chunk_state, chunk_valid)
         # ONE flat vmap over all (state, event) pairs.  A nested
         # vmap-over-events-inside-vmap-over-states compiles the protocol
         # twins' traced-index gathers/scatters into a pathologically slow
@@ -583,8 +686,9 @@ class TensorSearch:
         # scatter on the fast single-batch-dim lowering.
         rep_state = jax.tree.map(
             lambda x: jnp.repeat(x, ne, axis=0), chunk_state)
-        ev = jnp.tile(jnp.arange(ne), c)
-        rep_valid = jnp.repeat(chunk_valid, ne)
+        ev = jnp.maximum(event_ids, 0).reshape(-1)
+        rep_valid = (event_ids >= 0).reshape(-1) & jnp.repeat(chunk_valid,
+                                                              ne)
         flat, valids, overs = jax.vmap(self._step_one)(rep_state, ev)
         valids = valids & rep_valid
         overflow = jnp.sum(overs * valids.astype(jnp.int32))
@@ -614,7 +718,8 @@ class TensorSearch:
                             ("prune", p.prunes)):
             for name, fn in preds.items():
                 flags[f"{kind}:{name}"] = jax.vmap(fn)(flat) & valids
-        return flat, valids, fp, unique, overflow, flags
+        return (flat, valids, fp, unique, overflow, ev_drops, event_ids,
+                flags)
 
     # ----------------------------------------------------------------- run
 
@@ -686,7 +791,12 @@ class TensorSearch:
         events = []
         for lvl in reversed(self._levels):
             parent_chunk_row = row // ne
-            events.append(int(row % ne))
+            if isinstance(lvl["event_ids"], list):
+                lvl["event_ids"] = np.concatenate(lvl["event_ids"], axis=0)
+            # The pair slot is a compacted rank when ev_budget is set; the
+            # level's spilled event table maps it back to the GRID event
+            # id (what tpu/trace.py decodes).
+            events.append(int(lvl["event_ids"][parent_chunk_row, row % ne]))
             # Map the in-level parent row back through the previous level's
             # kept-state compaction.
             row = int(lvl["parent_rows"][parent_chunk_row])
@@ -737,7 +847,8 @@ class TensorSearch:
                                      time.time() - t0)
             depth += 1
             if self.record_trace:
-                self._levels.append({"parent_rows": parent_rows})
+                self._levels.append({"parent_rows": parent_rows,
+                                     "event_ids": []})
             # ---- expand all chunks (device), collect level arrays (host)
             lvl_states: List[dict] = []
             lvl_keys: List[Tuple[np.ndarray, np.ndarray]] = []
@@ -755,13 +866,22 @@ class TensorSearch:
                     if pad else x[start:end], frontier)
                 chunk_valid = jnp.concatenate(
                     [jnp.ones(c, bool), jnp.zeros(pad, bool)])
-                flat, valids, fp, unique, overflow, flags = self._expand(
-                    chunk_state, chunk_valid)
+                (flat, valids, fp, unique, overflow, ev_drops, event_ids,
+                 flags) = self._expand(chunk_state, chunk_valid)
                 if int(overflow):
                     raise CapacityOverflow(
-                        f"{self.p.name}: net_cap={self.p.net_cap} or "
-                        f"timer_cap={self.p.timer_cap} overflowed at depth "
+                        f"{self.p.name}: net_cap={self.p.net_cap}, "
+                        f"timer_cap={self.p.timer_cap}, or max_live_sends="
+                        f"{self.p.max_live_sends} overflowed at depth "
                         f"{depth} ({int(overflow)} drops); raise the caps")
+                if int(ev_drops):
+                    raise CapacityOverflow(
+                        f"{self.p.name}: ev_budget={self._ev_slots} < "
+                        f"valid events of some state at depth {depth} "
+                        f"({int(ev_drops)} skipped); raise the budget")
+                if self.record_trace:
+                    self._levels[-1]["event_ids"].append(
+                        np.asarray(event_ids))
                 np_valids = np.asarray(valids)
                 explored += int(np_valids.sum())
                 np_exc = np.asarray(flat["exc"])

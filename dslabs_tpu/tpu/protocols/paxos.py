@@ -701,6 +701,10 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
         timer_cap=timer_cap,
         max_sends=MAX_SENDS,
         max_sets=MAX_SETS,
+        # Worst SIMULTANEOUS sends: the P1b-win cascade — S*(n-1) P2As
+        # (reproposals) + S exec replies + (n-1) heartbeats; every other
+        # branch is smaller.  Too small is a loud CapacityOverflow.
+        max_live_sends=min(S * (n - 1) + S + (n - 1) + 1, MAX_SENDS),
         init_nodes=init_nodes,
         init_messages=init_messages,
         init_timers=init_timers,

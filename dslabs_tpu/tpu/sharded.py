@@ -103,7 +103,8 @@ class ShardedTensorSearch(TensorSearch):
                  visited_cap: int = 1 << 20,
                  max_depth: Optional[int] = None,
                  max_secs: Optional[float] = None,
-                 strict: bool = True):
+                 strict: bool = True,
+                 ev_budget: Optional[int] = None):
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
         self.n_devices = int(mesh.devices.size)
@@ -134,7 +135,8 @@ class ShardedTensorSearch(TensorSearch):
         # (strict=False, drops tolerated) skip it for throughput.
         super().__init__(protocol, frontier_cap=frontier_cap,
                          chunk=chunk_per_device, max_depth=max_depth,
-                         max_secs=max_secs, in_chunk_dedup=strict)
+                         max_secs=max_secs, in_chunk_dedup=strict,
+                         ev_budget=ev_budget)
         p = protocol
         self.lanes = (p.node_width + p.net_cap * p.msg_width
                       + p.n_nodes * p.timer_cap * p.timer_width + 1)
@@ -202,6 +204,21 @@ class ShardedTensorSearch(TensorSearch):
         bucket = (C * ne if D == 1
                   else (C * ne // D + 1) * OVERFLOW_FACTOR)
         nf = len(self._flag_names)
+        # Dev bisect hook (tools/profile_sharded2.py): truncate the step
+        # after a named stage, folding that stage's outputs into the
+        # explored counter so XLA cannot DCE the work under test.  None in
+        # production; the bisect tool measures the REAL step this way
+        # instead of maintaining a drifting copy.
+        stop_after = getattr(self, "_stop_after", None)
+
+        def _stopped(carry, *live):
+            out = dict(carry)
+            acc = carry["explored"][0]
+            for x in live:
+                acc = acc + jnp.sum(x).astype(jnp.int32)
+            out["explored"] = carry["explored"].at[0].set(acc)
+            out["j"] = carry["j"] + 1
+            return out
 
         def local(carry):
             # The chunk index lives IN the carry (device-resident,
@@ -215,9 +232,11 @@ class ShardedTensorSearch(TensorSearch):
             rows_chunk = jax.lax.dynamic_slice(cur, (start, 0), (C, lanes))
             valid = (start + jnp.arange(C)) < cur_n
             states = self.unflatten_rows(rows_chunk)
-            flat, valids, fp, unique, overflow, flags = self._expand_chunk(
-                states, valid)
+            (flat, valids, fp, unique, overflow, ev_drops, _,
+             flags) = self._expand_chunk(states, valid)
             rows = flatten_state(flat)
+            if stop_after == "expand":
+                return _stopped(carry, rows, fp, unique)
 
             # ---- terminal flags, checkState order (exception first)
             hit_list = [valids & (flat["exc"] != 0)]
@@ -263,6 +282,8 @@ class ShardedTensorSearch(TensorSearch):
             counts = ends - starts
             route_drop = jnp.sum(jnp.maximum(counts - bucket, 0)).astype(
                 jnp.int32)
+            if stop_after == "route":
+                return _stopped(carry, rows, send_keys, send_valid)
 
             # ---- the exchange: every device receives the key bucket
             # destined to it from every other device (ICI all_to_all)
@@ -272,6 +293,8 @@ class ShardedTensorSearch(TensorSearch):
             recv_keys = jnp.where(recv_valid.reshape(rb, 1),
                                   recv_keys.reshape(rb, 4), MAXU32)
             recv_valid = recv_valid.reshape(rb)
+            if stop_after == "a2a":
+                return _stopped(carry, rows, recv_keys, recv_valid)
 
             # ---- owner-side dedup via an open-addressing hash table in
             # HBM ([V+1, 4] uint32, viewed as [V/8, 8]-slot buckets, last
@@ -342,6 +365,10 @@ class ShardedTensorSearch(TensorSearch):
             # (missed dedup would corrupt unique counts).
             vis_drop = jnp.sum(~resolved).astype(jnp.int32)
             n_fresh = jnp.sum(fresh_s).astype(jnp.int32)
+            if stop_after == "probe":
+                out = _stopped(carry, rows, fresh_s, resolved)
+                out["visited"] = new_visited
+                return out
 
             # ---- return each key's fresh flag to its producer (reverse
             # all_to_all — an involution on the leading axis; recv order
@@ -354,6 +381,10 @@ class ShardedTensorSearch(TensorSearch):
             fresh_rows = jnp.zeros(owner.shape[0], bool).at[
                 gidx.reshape(-1)].max(
                 fresh_back.reshape(-1) & send_valid.reshape(-1))
+            if stop_after == "back":
+                out = _stopped(carry, rows, fresh_rows)
+                out["visited"] = new_visited
+                return out
 
             # ---- append fresh, un-pruned successors (still in producer
             # order, no row permutation) to the local next frontier
@@ -382,8 +413,11 @@ class ShardedTensorSearch(TensorSearch):
                 # *expansion coverage* (beam-style) and are tolerable when
                 # the caller opts in (bench throughput runs).
                 "overflow": carry["overflow"].at[0].add(overflow + vis_drop),
+                # ev_drops (valid events past the ev_budget) truncate
+                # expansion coverage like a routing/frontier drop: fatal
+                # in strict mode (via _sync_checks), beam-tolerable else.
                 "drops": carry["drops"].at[0].add(
-                    route_drop + frontier_drop),
+                    route_drop + frontier_drop + ev_drops),
                 "flag_cnt": flag_cnt, "flag_rows": flag_rows,
             }
 

@@ -124,8 +124,11 @@ def main() -> int:
     all_rows = []
     if args.submissions:
         subs_abs = os.path.abspath(args.submissions)
+        # Path-component check, not a string prefix: "/root/repo-subs"
+        # must NOT match a repo at "/root/repo" (a sibling dir's basename
+        # would silently vanish from every overlay copy).
         ignores = ((os.path.basename(subs_abs.rstrip(os.sep)),)
-                   if subs_abs.startswith(REPO) else ())
+                   if os.path.commonpath([subs_abs, REPO]) == REPO else ())
         for name in sorted(os.listdir(args.submissions)):
             path = os.path.join(args.submissions, name)
             if os.path.isdir(path):

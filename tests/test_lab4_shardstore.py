@@ -870,3 +870,64 @@ def test11_tx_random_search():
     settings.add_prune(CLIENTS_DONE)
     results = dfs(joined, settings)
     assert not results.terminal_found()
+
+
+# ------------------------------------------------- unit: 2PC vote pinning
+
+@lab_test("4", 38, "coordinator ignores same-round votes after decision",
+          part=3, categories=(RUN_TESTS,))
+def test_yes_then_abort_same_round_duplicate():
+    """Pins the `entry[2] is not None` guard in _apply_tx_vote: a
+    participant that voted YES for round r can later vote ABORT for the
+    SAME round (duplicate TxPrepare delivered after it installed a newer
+    config — the config-mismatch abort in _apply_tx_prepare).  Once the
+    coordinator fixed the round's decision, the late vote must be
+    ignored, or a committed transaction would flip to aborted after the
+    client already got its reply (round-2 advisor finding)."""
+    from dslabs_tpu.core.node import NodeConfig
+    from dslabs_tpu.labs.clientserver.amo import AMOCommand
+    from dslabs_tpu.labs.shardedstore.shardstore import TxVote
+
+    node = ShardStoreServer(server(1, 1), (shard_master(1),), NUM_SHARDS,
+                            tuple(group(1)), 1)
+    sent = []
+    node.config(NodeConfig(
+        message_adder=lambda frm, to, m: sent.append((to, m)),
+        timer_adder=lambda frm, t, mn, mx: None,
+    ))
+    node.init()
+    # Two groups, each owning one of the tx's shards.
+    node.current_config = ShardConfig(1, {
+        1: (group(1), frozenset({key_to_shard("key-1", NUM_SHARDS)})),
+        2: (group(2), frozenset({key_to_shard("key-2", NUM_SHARDS)})),
+    })
+    client = LocalAddress("client1")
+    tx = AMOCommand(MultiPut({"key-1": "x", "key-2": "y"}), client, 1)
+    tx_id = (client, 1)
+    node.tx_round[tx_id] = 1
+    node.coord[tx_id] = [tx, {}, None, (), frozenset(), 1]
+
+    node._apply_tx_vote(TxVote(tx_id, 1, 1, True, (("key-1", "a"),)))
+    assert node.coord[tx_id][2] is None  # one vote: undecided
+    node._apply_tx_vote(TxVote(tx_id, 1, 2, True, (("key-2", "b"),)))
+    entry = node.coord[tx_id]
+    assert entry[2] is True              # all yes: committed
+    writes = entry[3]
+    assert dict(writes) == {"key-1": "x", "key-2": "y"}
+
+    # The duplicate-delivery interleaving: group 2 re-votes ABORT for the
+    # SAME round.  Must be a no-op.
+    node._apply_tx_vote(TxVote(tx_id, 1, 2, False, ()))
+    assert node.coord[tx_id][2] is True
+    assert node.coord[tx_id][3] == writes
+
+    # Contrast (documents current semantics): BEFORE the decision, a
+    # same-round re-vote does overwrite — an abort then wins.
+    tx2 = AMOCommand(MultiPut({"key-1": "x2", "key-2": "y2"}), client, 2)
+    tx2_id = (client, 2)
+    node.tx_round[tx2_id] = 1
+    node.coord[tx2_id] = [tx2, {}, None, (), frozenset(), 1]
+    node._apply_tx_vote(TxVote(tx2_id, 1, 2, True, (("key-2", "b"),)))
+    node._apply_tx_vote(TxVote(tx2_id, 1, 2, False, ()))
+    node._apply_tx_vote(TxVote(tx2_id, 1, 1, True, (("key-1", "a"),)))
+    assert node.coord[tx2_id][2] is False

@@ -1,7 +1,11 @@
-"""Bisect the sharded chunk step: progressively truncated variants of the
-local step, keeping results alive via counter sums so XLA cannot DCE the
-stages under test. Dev tool."""
+"""Bisect the sharded chunk step via the engine's `_stop_after` dev hook:
+run a REAL search to load the frontier + visited table, snapshot the
+carry, then time progressively truncated variants of the genuine
+`_build_chunk_step` program (no drifting copy).  Self-feeding loops only
+(each step consumes the previous carry) — independent-arg microbenchmarks
+lie on the axon platform.  Dev tool."""
 
+import sys
 import time
 
 import jax
@@ -10,173 +14,72 @@ jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental.shard_map import shard_map
-from jax.sharding import PartitionSpec as P
 
-from dslabs_tpu.tpu.engine import flatten_state
 from dslabs_tpu.tpu.protocols.paxos import make_paxos_protocol
-from dslabs_tpu.tpu.sharded import (MAXU32, OVERFLOW_FACTOR,
-                                    ShardedTensorSearch, make_mesh)
+from dslabs_tpu.tpu.sharded import ShardedTensorSearch, make_mesh
+
+CHUNK = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+EVB = int(sys.argv[2]) if len(sys.argv) > 2 else 48
+WARM_DEPTH = 10
+STAGES = ["expand", "route", "a2a", "probe", "back", None]
 
 
-def build_variant(search, stop_after):
-    """stop_after: 'expand' | 'route' | 'a2a' | 'probe' | 'full'."""
-    p = search.p
-    D = search.n_devices
-    C = search.cpd
-    V = search.v_cap
-    ne = search._num_events()
-    ax = search.axis
-    lanes = search.lanes
-    bucket = (C * ne // D + 1) * OVERFLOW_FACTOR
-
-    def local(carry, j):
-        cur, cur_n = carry["cur"], carry["cur_n"][0]
-        start = j * C
-        rows_chunk = jax.lax.dynamic_slice(cur, (start, 0), (C, lanes))
-        valid = (start + jnp.arange(C)) < cur_n
-        states = search.unflatten_rows(rows_chunk)
-        flat, valids, fp, unique, overflow, flags = search._expand_chunk(
-            states, valid)
-        rows = flatten_state(flat)
-        if stop_after == "expand":
-            carry = dict(carry)
-            carry["explored"] = carry["explored"].at[0].add(
-                jnp.sum(rows).astype(jnp.int32)
-                + jnp.sum(fp).astype(jnp.int32)
-                + jnp.sum(unique).astype(jnp.int32))
-            return carry
-        if stop_after == "mat":
-            # Force full materialisation of the successor rows into HBM
-            # (contiguous write, no permutation) — isolates the cost of
-            # the expand's output materialisation from routing/dedup.
-            carry = dict(carry)
-            nxt = carry["nxt"]
-            carry["nxt"] = jax.lax.dynamic_update_slice(
-                nxt, rows[:nxt.shape[0]], (0, 0))
-            carry["explored"] = carry["explored"].at[0].add(
-                jnp.sum(fp).astype(jnp.int32))
-            return carry
-
-        owner = (fp[:, 0] % jnp.uint32(D)).astype(jnp.int32)
-        owner = jnp.where(unique, owner, D)
-        order = jnp.argsort(owner, stable=True)
-        if stop_after == "argsort":
-            carry = dict(carry)
-            carry["explored"] = carry["explored"].at[0].add(
-                jnp.sum(order).astype(jnp.int32)
-                + jnp.sum(rows).astype(jnp.int32))
-            return carry
-        owner_s = owner[order]
-        dev = jnp.arange(D)
-        starts = jnp.searchsorted(owner_s, dev, side="left")
-        ends = jnp.searchsorted(owner_s, dev, side="right")
-        src = starts[:, None] + jnp.arange(bucket)[None, :]
-        send_valid = src < ends[:, None]
-        gidx = order[src.clip(0, owner.shape[0] - 1)].reshape(-1)
-        send_rows = rows[gidx].reshape(D, bucket, lanes)
-        send_keys = fp[gidx].reshape(D, bucket, 4)
-        if stop_after == "route":
-            carry = dict(carry)
-            carry["explored"] = carry["explored"].at[0].add(
-                jnp.sum(send_rows).astype(jnp.int32)
-                + jnp.sum(send_keys).astype(jnp.int32))
-            return carry
-
-        recv_rows = jax.lax.all_to_all(send_rows, ax, 0, 0)
-        recv_keys = jax.lax.all_to_all(send_keys, ax, 0, 0)
-        recv_valid = jax.lax.all_to_all(send_valid, ax, 0, 0)
-        rb = D * bucket
-        recv_rows = recv_rows.reshape(rb, lanes)
-        recv_keys = jnp.where(recv_valid.reshape(rb, 1),
-                              recv_keys.reshape(rb, 4), MAXU32)
-        recv_valid = recv_valid.reshape(rb)
-        if stop_after == "a2a":
-            carry = dict(carry)
-            carry["explored"] = carry["explored"].at[0].add(
-                jnp.sum(recv_rows).astype(jnp.int32)
-                + jnp.sum(recv_keys).astype(jnp.int32))
-            return carry
-
-        visited = carry["visited"]
-        all_max = jnp.all(recv_keys == MAXU32, axis=1)
-        ckeys = recv_keys.at[:, 3].set(
-            jnp.where(all_max & recv_valid, MAXU32 - 1, recv_keys[:, 3]))
-        bo = jnp.lexsort((ckeys[:, 3], ckeys[:, 2], ckeys[:, 1],
-                          ckeys[:, 0], ~recv_valid))
-        skeys = ckeys[bo]
-        svalid = recv_valid[bo]
-        batch_first = jnp.ones(rb, bool).at[1:].set(
-            jnp.any(skeys[1:] != skeys[:-1], axis=1))
-        cand = svalid & batch_first
-        slot0 = (skeys[:, 2] & jnp.uint32(V - 1)).astype(jnp.int32)
-        pstep = (skeys[:, 1] | jnp.uint32(1)).astype(jnp.uint32)
-
-        def probe_cond(st):
-            _, _, resolved, _, it = st
-            return (it < 64) & jnp.any(~resolved)
-
-        def probe_body(st):
-            table, slot, resolved, fresh, it = st
-            cur_ = table[slot]
-            eq = jnp.all(cur_ == skeys, axis=1)
-            empty = jnp.all(cur_ == MAXU32, axis=1)
-            unres = ~resolved
-            tryi = unres & empty
-            dsti = jnp.where(tryi, slot, V)
-            table = table.at[dsti].set(skeys)
-            back = table[slot]
-            won = tryi & jnp.all(back == skeys, axis=1)
-            resolved = resolved | eq | won
-            nslot = (slot.astype(jnp.uint32) + pstep).astype(
-                jnp.int32) & (V - 1)
-            slot = jnp.where(~resolved, nslot, slot)
-            return table, slot, resolved, fresh | won, it + 1
-
-        table, _, resolved, fresh_s, _ = jax.lax.while_loop(
-            probe_cond, probe_body,
-            (visited, slot0, ~cand, jnp.zeros(rb, bool), jnp.int32(0)))
-        if stop_after == "probe":
-            carry = dict(carry)
-            carry["visited"] = table
-            carry["explored"] = carry["explored"].at[0].add(
-                jnp.sum(fresh_s).astype(jnp.int32)
-                + jnp.sum(resolved).astype(jnp.int32))
-            return carry
-        raise ValueError(stop_after)
-
-    spec = search._carry_specs()
-    return jax.jit(shard_map(local, mesh=search.mesh,
-                             in_specs=(spec, P()), out_specs=spec,
-                             check_rep=False), donate_argnums=0)
+def make_search(stop_after):
+    import dataclasses
+    protocol = make_paxos_protocol(n=3, n_clients=2, w=1, max_slots=3,
+                                   net_cap=64, timer_cap=6)
+    protocol = dataclasses.replace(protocol, goals={})
+    mesh = make_mesh(len(jax.devices()))
+    s = ShardedTensorSearch(protocol, mesh, chunk_per_device=CHUNK,
+                            frontier_cap=1 << 16, visited_cap=1 << 22,
+                            max_depth=WARM_DEPTH, strict=False,
+                            ev_budget=(EVB or None))
+    s._stop_after = stop_after
+    # Rebuild the jitted step AFTER setting the hook (the ctor built it
+    # with stop_after=None).
+    s._chunk_step = jax.jit(s._build_chunk_step(), donate_argnums=0)
+    return s
 
 
 def main():
-    protocol = make_paxos_protocol(n=3, n_clients=2, w=1, max_slots=3,
-                                   net_cap=64, timer_cap=6)
-    mesh = make_mesh(len(jax.devices()))
-    search = ShardedTensorSearch(
-        protocol, mesh, chunk_per_device=256,
-        frontier_cap=1 << 16, visited_cap=1 << 21, max_depth=1,
-        strict=False)
-    state = search.initial_state()
-    with mesh:
-        import sys
-        variants = (sys.argv[1:] if len(sys.argv) > 1
-                    else ["expand", "argsort", "route", "a2a", "probe"])
-        for variant in variants:
-            fn = build_variant(search, variant)
-            carry = search._init_carry(state)
+    # ---- load a realistic carry with the FULL program
+    s = make_search(None)
+    with s.mesh:
+        state = s.initial_state()
+        carry = s._init_carry(state)
+        max_n = 1
+        depth = 0
+        t0 = time.time()
+        while depth < WARM_DEPTH:
+            depth += 1
+            n_chunks = -(-(max_n + s.n_devices - 1) // s.cpd)
+            for _ in range(n_chunks):
+                carry = s._chunk_step(carry)
+            _, _, _, _, max_n = s._sync_checks(carry, depth, t0)
+            carry = s._finish_level(carry)
+        print(f"warm to depth {depth}: frontier/device={max_n}",
+              flush=True)
+        host_carry = jax.device_get(carry)
+
+    for stop in STAGES:
+        sv = make_search(stop)
+        with sv.mesh:
+            c = jax.device_put(host_carry)
             t0 = time.time()
-            carry = fn(carry, jnp.int32(0))
-            jax.block_until_ready(carry["explored"])
-            print(f"{variant:8s} compile+1st {time.time()-t0:6.1f}s")
+            c = sv._chunk_step(c)
+            jax.block_until_ready(c["explored"])
+            t_first = time.time() - t0
             iters = 20
             t0 = time.time()
             for _ in range(iters):
-                carry = fn(carry, jnp.int32(0))
-            jax.block_until_ready(carry["explored"])
-            print(f"{variant:8s} steady {(time.time()-t0)/iters*1e3:9.2f} ms")
+                c = sv._chunk_step(c)
+            jax.block_until_ready(c["explored"])
+            dt = (time.time() - t0) / iters
+            name = stop or "full"
+            print(f"{name:8s} compile+1st {t_first:6.1f}s  "
+                  f"steady {dt*1e3:8.2f} ms  "
+                  f"({CHUNK*sv._num_events()/dt/1e6:.2f}M pairs/s)",
+                  flush=True)
 
 
 if __name__ == "__main__":
