@@ -42,6 +42,16 @@ def _report_violation(state, header: str, lab: Optional[str] = None,
                               lab_part=part, test_class_name="",
                               test_method_name=test_name or "")
             print(f"Saved trace to {path}")
+        if GlobalSettings.start_viz:
+            # -z: launch the branch-exploring debugger on the violating
+            # trace and halt the run there — the BaseJUnitTest startViz /
+            # VizStarted behavior (BaseJUnitTest.java:286-355).
+            from dslabs_tpu.viz.debugger import serve_debugger
+
+            events = [e.previous_event for e in state.trace()
+                      if e.previous_event is not None]
+            root = state.trace()[0]
+            serve_debugger(root, preload_events=events)
 
 
 def assert_end_condition_valid(results: SearchResults,

@@ -23,10 +23,13 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import threading
 import time
 import traceback
 from typing import List, Optional, Sequence
+
+LOG = logging.getLogger("dslabs.harness")
 
 from dslabs_tpu.harness.annotations import TestEntry
 from dslabs_tpu.harness.tee import TeeStdOutErr
@@ -147,6 +150,18 @@ def _run_one(entry: TestEntry, routers=None) -> TestResult:
         th.start()
         th.join(timeout)
         timed_out = th.is_alive()
+        if timed_out:
+            # Cooperative stop of everything the abandoned test thread
+            # started: node threads exit, single-threaded run loops
+            # break, and a brief grace join keeps late output out of the
+            # next test (the reference interrupts + joins,
+            # RunState.java:340-383).
+            from dslabs_tpu.runner.run_state import stop_active_run_states
+            stopped = stop_active_run_states()
+            if stopped:
+                LOG.warning("timeout: stopped %d leaked RunState(s)",
+                            stopped)
+            th.join(2.0)
     end = time.time()
     err = err_box[0]
     error_text = None

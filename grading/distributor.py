@@ -81,7 +81,8 @@ def _run_host(host: str, shard: list, subs_dir: str, labs: list,
         errors.append(f"{host}: {e}")
 
 
-def main() -> int:
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--config", help="JSON config (reference shape)")
     ap.add_argument("--submissions")
@@ -90,19 +91,29 @@ def main() -> int:
     ap.add_argument("--remote-dir", default=REMOTE_DIR)
     ap.add_argument("--out", default="grades.csv")
     ap.add_argument("--results-dir", default="results")
-    args = ap.parse_args()
+    args = ap.parse_args(argv)
 
     if args.config:
         with open(args.config) as fd:
             cfg = json.load(fd)
+        # CLI wins over config everywhere (labs included: regrading one
+        # lab with --labs must not be silently widened by the config).
         args.submissions = args.submissions or os.path.expanduser(
             cfg.get("submission_path", ""))
         args.hosts = args.hosts or cfg.get("hosts", [])
-        args.labs = cfg.get("labs", args.labs)
+        if "--labs" not in argv:
+            args.labs = cfg.get("labs", args.labs)
         args.remote_dir = cfg.get("remote_dir", args.remote_dir)
         args.out = cfg.get("out", args.out)
     if not args.submissions or not args.hosts:
         ap.error("--submissions and --hosts required (or via --config)")
+
+    # Clear stale per-host CSVs first: a failed host must be ABSENT from
+    # the merge, not represented by a previous run's rows.
+    for host in args.hosts:
+        stale = os.path.join(args.results_dir, f"{host}-grades.csv")
+        if os.path.exists(stale):
+            os.remove(stale)
 
     names = [n for n in os.listdir(args.submissions)
              if os.path.isdir(os.path.join(args.submissions, n))]

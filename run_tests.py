@@ -137,9 +137,10 @@ def _replay_traces() -> int:
 
 def _debugger(lab, dbg_args) -> int:
     """VizClient.main analog (VizClient.java:39-102): build a lab's
-    initial state from CLI args and render it in the viewer."""
+    initial state from CLI args and serve the interactive
+    branch-exploring debugger over it (DebuggerWindow.java:89)."""
     from dslabs_tpu.viz import viz_configs
-    from dslabs_tpu.viz.server import state_dump
+    from dslabs_tpu.viz.debugger import serve_debugger
 
     configs = viz_configs()
     if lab is None or str(lab) not in configs:
@@ -147,24 +148,29 @@ def _debugger(lab, dbg_args) -> int:
               f"{sorted(configs)}")
         return 1
     state = configs[str(lab)](list(dbg_args))
-    import json as _json
-
-    out = f"debugger-lab{lab}.json"
-    with open(out, "w") as f:
-        _json.dump(state_dump(state), f, indent=2)
-    print(f"Initial lab {lab} system state written to {out} "
-          f"({len(list(state.addresses()))} nodes); save a trace with -s "
-          "and open it with --visualize-trace for stepping")
+    serve_debugger(state)
     return 0
 
 
 def _visualize_trace(path: str) -> int:
-    try:
-        from dslabs_tpu.viz.server import serve_trace
-    except ImportError:
-        print("Trace viewer not available in this build")
+    """SavedTraceViz analog: render the static HTML step viewer AND serve
+    the interactive debugger preloaded with the trace's event path, so
+    the user can deviate at any step and explore successor branches
+    (EventTreeState.java:47-209)."""
+    from dslabs_tpu.search.trace import SerializableTrace
+    from dslabs_tpu.viz.debugger import serve_debugger
+    from dslabs_tpu.viz.server import render_trace_html
+
+    trace = SerializableTrace.load(path)
+    if trace is None:
+        print(f"Could not load trace {path}")
         return 1
-    return serve_trace(path)
+    out_path = path + ".html"
+    with open(out_path, "w") as f:
+        f.write(render_trace_html(trace))
+    print(f"Static trace view: {out_path} ({len(trace.history)} events)")
+    serve_debugger(trace.initial_state(), preload_events=trace.history)
+    return 0
 
 
 def main(argv=None) -> int:

@@ -77,3 +77,67 @@ def test_viz_configs_build_initial_states():
     assert len(list(s3.addresses())) == 4   # 3 paxos servers + client
     # The built states are searchable (events enumerable).
     assert s3.events(None)
+
+
+def test_event_tree_branch_exploration():
+    """EventTreeState.java:47-209 capability: pending events of any tree
+    node are deliverable, steps are cached, branches diverge, and the
+    path-from-initial reflects the chosen branch."""
+    from dslabs_tpu.viz.debugger import EventTree
+
+    state = viz_configs()["0"](["1", "1", "ping1,ping2"])
+    tree = EventTree(state)
+    pend = tree.pending(0)
+    assert pend, "initial state must have deliverable events"
+    a = tree.step(0, 0)
+    assert a == 1
+    assert tree.step(0, 0) == a, "step caching: same (node, event) -> same child"
+    # A second event (if any) forms a DIFFERENT branch from the root.
+    if len(pend) > 1:
+        b = tree.step(0, 1)
+        assert b not in (None, a)
+    # Walk one branch deeper; the breadcrumb path follows it.
+    deeper = tree.step(a, 0)
+    if deeper is not None:
+        j = tree.node_json(deeper)
+        assert [p["id"] for p in j["path"]][:2] == [0, a]
+        assert j["depth"] == 2
+        assert j["parent_state"] is not None
+
+
+def test_debugger_http_roundtrip():
+    """The served debugger: GET /node/0 lists pending events; POST /step
+    delivers one and the child is retrievable."""
+    import json
+    import urllib.request
+
+    from dslabs_tpu.viz.debugger import serve_debugger
+
+    state = viz_configs()["0"](["1", "1", "ping1"])
+    server, tree = serve_debugger(state, open_browser=False, block=False)
+    try:
+        port = server.server_address[1]
+
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+                return json.loads(r.read())
+
+        root = get("/node/0")
+        assert root["pending"], "root must list pending events"
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/step",
+            data=json.dumps({"id": 0, "event": 0}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=5) as r:
+            child = json.loads(r.read())["child"]
+        assert child == 1
+        node = get(f"/node/{child}")
+        assert node["parent"] == 0 and node["depth"] == 1
+        # The HTML app itself is served.
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/", timeout=5) as r:
+            assert b"dslabs debugger" in r.read()
+    finally:
+        server.shutdown()
+        server.server_close()

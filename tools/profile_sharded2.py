@@ -41,30 +41,36 @@ def make_search(stop_after):
     return s
 
 
-def main():
-    # ---- load a realistic carry with the FULL program
-    s = make_search(None)
-    with s.mesh:
-        state = s.initial_state()
-        carry = s._init_carry(state)
-        max_n = 1
-        depth = 0
-        t0 = time.time()
-        while depth < WARM_DEPTH:
-            depth += 1
-            n_chunks = -(-(max_n + s.n_devices - 1) // s.cpd)
-            for _ in range(n_chunks):
-                carry = s._chunk_step(carry)
-            _, _, _, _, max_n = s._sync_checks(carry, depth, t0)
-            carry = s._finish_level(carry)
-        print(f"warm to depth {depth}: frontier/device={max_n}",
-              flush=True)
-        host_carry = jax.device_get(carry)
+def warm_carry(s):
+    """Run the REAL search (full program) to WARM_DEPTH, returning the
+    loaded device-resident carry — no host roundtrip (a 1.5 GB carry
+    device_get/put through the tunnel dominated the old design)."""
+    state = s.initial_state()
+    carry = s._init_carry(state)
+    max_n = 1
+    depth = 0
+    t0 = time.time()
+    while depth < WARM_DEPTH:
+        depth += 1
+        n_chunks = -(-(max_n + s.n_devices - 1) // s.cpd)
+        for _ in range(n_chunks):
+            carry = s._chunk_step(carry)
+        _, _, _, _, max_n = s._sync_checks(carry, depth, t0)
+        carry = s._finish_level(carry)
+    return carry, max_n
 
+
+def main():
     for stop in STAGES:
-        sv = make_search(stop)
+        sv = make_search(None)          # warm with the FULL program
         with sv.mesh:
-            c = jax.device_put(host_carry)
+            full_step = sv._chunk_step
+            carry, max_n = warm_carry(sv)
+            if stop is not None:        # then swap in the variant
+                sv._stop_after = stop
+                sv._chunk_step = jax.jit(sv._build_chunk_step(),
+                                         donate_argnums=0)
+            c = carry
             t0 = time.time()
             c = sv._chunk_step(c)
             jax.block_until_ready(c["explored"])
@@ -76,7 +82,8 @@ def main():
             jax.block_until_ready(c["explored"])
             dt = (time.time() - t0) / iters
             name = stop or "full"
-            print(f"{name:8s} compile+1st {t_first:6.1f}s  "
+            print(f"{name:8s} (frontier/dev {max_n}) "
+                  f"compile+1st {t_first:6.1f}s  "
                   f"steady {dt*1e3:8.2f} ms  "
                   f"({CHUNK*sv._num_events()/dt/1e6:.2f}M pairs/s)",
                   flush=True)
