@@ -29,26 +29,27 @@ import traceback
 
 BASELINE_STATES_PER_MIN = 1e8
 
-# (chunk_per_device, frontier_cap, visited_cap) — per device.  The
-# 256-chunk rung leads because it reliably fits the rung timeout
-# (compile ~140 s cold); the 512 rung measured ~13% higher throughput
-# (647k vs 574k states/min on a v5e) but compiles ~470 s cold, so it
-# runs as an UPGRADE attempt after a success rather than as the lead —
-# the bench reports the best successful rung.
+# (chunk_per_device, frontier_cap, visited_cap) — per device.  Round-3
+# measured config: occupancy-compacted split event grids (EV_BUDGET
+# below), packed P1B payloads, row-native expand, tail-compacted visited
+# probe -> 2.49M unique states/min on one v5e chip at the lead rung
+# (compile ~100 s cold, cached thereafter).
 LADDER = [
-    (256, 1 << 16, 1 << 22),   # visited 4M keys/device (64 MB): the rate
-                               # saturated a 2M table before the 120 s
-                               # budget once the goal-exit was removed
-    (256, 1 << 14, 1 << 21),   # degraded caps if the big rung OOMs
+    (1024, 1 << 18, 1 << 23),  # lead: 90 ms/chunk steady, visited 8M
+                               # keys/device (128 MB) stays < 75% full
+                               # inside the 120 s budget
+    (256, 1 << 16, 1 << 22),   # round-2 fallback if the big rung OOMs
     (64, 1 << 12, 1 << 18),
 ]
 UPGRADE_LADDER = [
-    (512, 1 << 17, 1 << 22),
 ]
 RUNG_TIMEOUT_SECS = 540.0
-# The 512 program compiles ~470 s cold; 540 s could never fit compile +
-# 120 s measurement, so the upgrade attempt gets its own budget.
 UPGRADE_TIMEOUT_SECS = 780.0
+# Message/timer pair-slot budgets (ev_budget): covers the measured max
+# valid events through depth ~17 (msgs p99 ~40 of net_cap 64, timers
+# max 8 of 30); overflow truncates coverage beam-style and is counted
+# in `dropped` like any frontier-cap drop.
+EV_BUDGET = (40, 8)
 
 
 def _run_rung(chunk_per_device: int, frontier_cap: int, visited_cap: int,
@@ -76,7 +77,7 @@ def _run_rung(chunk_per_device: int, frontier_cap: int, visited_cap: int,
     search = ShardedTensorSearch(
         protocol, mesh, chunk_per_device=chunk_per_device,
         frontier_cap=frontier_cap, visited_cap=visited_cap, max_depth=1,
-        strict=False)
+        strict=False, ev_budget=EV_BUDGET)
     search.run()  # warm-up: compiles the chunk/finish programs
     search.max_depth = 64
     search.max_secs = max_secs

@@ -501,20 +501,43 @@ class TensorSearch:
         self.record_trace = record_trace
         # Occupancy-compacted event enumeration: expand only each state's
         # VALID events (occupied messages + deliverable timers), packed
-        # into ``ev_budget`` pair slots per state, instead of the full
-        # net_cap + nn*timer_cap grid (bench protocol: mean ~30 valid of
-        # 94 grid slots at depth 16).  None = full grid (always safe).
-        # A state with more valid events than the budget overflows LOUDLY
-        # (base engine: CapacityOverflow; sharded strict: same; sharded
-        # beam: counted in SearchOutcome.dropped — coverage truncation,
-        # same class as a frontier-cap drop).
-        self._ev_slots = (min(ev_budget, self._grid_events(protocol))
-                          if ev_budget else self._grid_events(protocol))
+        # into per-KIND pair-slot tables — message pairs run only the
+        # message machinery and timer pairs only the timer machinery (the
+        # round-2 select-both design computed BOTH branches for every
+        # pair).  ``ev_budget``: None = full grid per kind (always safe);
+        # int b = message slots capped at b, timer slots full; tuple
+        # (bm, bt) caps both (bench protocol: (40, 8) vs the 64+30
+        # grid; measured mean ~30 valid events at depth 16).  A state
+        # with more valid events than a budget overflows LOUDLY (base
+        # engine: CapacityOverflow; sharded strict: same; sharded beam:
+        # counted in SearchOutcome.dropped — coverage truncation, same
+        # class as a frontier-cap drop).
+        tgrid = protocol.n_nodes * protocol.timer_cap
+        if ev_budget is None:
+            bm, bt = protocol.net_cap, tgrid
+        elif isinstance(ev_budget, tuple):
+            bm, bt = ev_budget
+        else:
+            bm, bt = ev_budget, tgrid
+        self._ev_msg = min(bm, protocol.net_cap)
+        self._ev_tmr = min(bt, tgrid)
+        self._ev_slots = self._ev_msg + self._ev_tmr
         # When False, _expand_chunk marks every valid successor unique and
         # dedup is entirely the caller's job — only meaningful for drivers
         # with their own dedup authority (the sharded engine's owner-side
         # hash table); the base run() loop REQUIRES the prefilter.
         self._in_chunk_dedup = in_chunk_dedup
+        # Flat-row layout: states travel as [*, lanes] int32 rows (nodes
+        # ++ net ++ timers ++ exc) everywhere past initial_state() — the
+        # round-3 bisect showed the expand is HBM-bound, and the old
+        # dict-of-pieces representation materialised every successor
+        # twice (once as the pytree, once flattened for hashing).
+        p = protocol
+        self._off = (p.node_width,
+                     p.node_width + p.net_cap * p.msg_width,
+                     p.node_width + p.net_cap * p.msg_width
+                     + p.n_nodes * p.timer_cap * p.timer_width)
+        self.lanes = self._off[2] + 1
         # Per-level (parent row, event id) spill for trace reconstruction
         # (SURVEY §8.1; SearchState.java:361-474). Populated by run() when
         # record_trace is set; consumed by tpu/trace.py.
@@ -550,63 +573,44 @@ class TensorSearch:
     def _grid_events(p: TensorProtocol) -> int:
         return p.net_cap + p.n_nodes * p.timer_cap
 
+    def unflatten_rows(self, rows) -> dict:
+        """[N, lanes] rows -> batched state pytree (the inverse of
+        :func:`flatten_state`); slices/reshapes only, no copies."""
+        p = self.p
+        o0, o1, o2 = self._off
+        n = rows.shape[0]
+        return {
+            "nodes": rows[:, :o0],
+            "net": rows[:, o0:o1].reshape(n, p.net_cap, p.msg_width),
+            "timers": rows[:, o1:o2].reshape(
+                n, p.n_nodes, p.timer_cap, p.timer_width),
+            "exc": rows[:, o2],
+        }
+
+    def _slice_state(self, row) -> dict:
+        """[lanes] row -> ONE unbatched state dict (views)."""
+        p = self.p
+        o0, o1, o2 = self._off
+        return {
+            "nodes": row[:o0],
+            "net": row[o0:o1].reshape(p.net_cap, p.msg_width),
+            "timers": row[o1:o2].reshape(
+                p.n_nodes, p.timer_cap, p.timer_width),
+            "exc": row[o2],
+        }
+
     def _num_events(self) -> int:
         """Pair slots per state in the expand program (the successor-row
         stride): the compacted budget when ev_budget is set, else the full
         event grid."""
         return self._ev_slots
 
-    def _step_one(self, state_slice: dict, event_idx: jnp.ndarray):
-        """Expand ONE state by ONE event index -> (successor, valid, over)."""
+    def _finish_step(self, net, timers, nodes2, sends, new_t, exc, valid):
+        """Common tail of both step kinds: send compaction, network
+        set-insert, timer appends, overflow accounting.  Emits the
+        successor as ONE flat [lanes] row — the single materialisation
+        of the successor state."""
         p = self.p
-        nodes, net, timers = (state_slice["nodes"], state_slice["net"],
-                              state_slice["timers"])
-        is_msg = event_idx < p.net_cap
-        # All event picks are one-hot 0/1 sums — static indexing only
-        # (per-pair dynamic gathers materialise at ~1 GB/s under the flat
-        # vmap on TPU, the round-2 bottleneck).
-
-        def deliver_message():
-            moh = (jnp.arange(p.net_cap)
-                   == event_idx.clip(0, p.net_cap - 1))      # [net_cap]
-            msg = jnp.sum(moh[:, None] * net, axis=0)
-            occupied = msg[0] != SENTINEL
-            ok = occupied
-            if p.deliver_message is not None:
-                ok = ok & p.deliver_message(msg)
-            nodes2, sends, new_timers, exc = _normalize_step(
-                p.step_message(nodes, msg))
-            return nodes2, sends, new_timers, exc, None, ok
-
-        t_idx = event_idx - p.net_cap
-        t_node = t_idx // p.timer_cap
-        t_slot = t_idx % p.timer_cap
-        n_oh = jnp.arange(p.n_nodes) == t_node               # [NN]
-        s_oh = jnp.arange(p.timer_cap) == t_slot             # [T_CAP]
-
-        def deliver_timer():
-            queue = jnp.sum(n_oh[:, None, None] * timers, axis=0)
-            ok = jnp.sum(timer_deliverable_mask(queue) * s_oh) > 0
-            if p.deliver_timer is not None:
-                ok = ok & p.deliver_timer(t_node)
-            timer = jnp.sum(s_oh[:, None] * queue, axis=0)
-            nodes2, sends, new_timers, exc = _normalize_step(
-                p.step_timer(nodes, t_node, timer))
-            return nodes2, sends, new_timers, exc, queue, ok
-
-        m_nodes, m_sends, m_set, m_exc, _, m_ok = deliver_message()
-        t_nodes, t_sends, t_set, t_exc, t_queue, t_ok = deliver_timer()
-
-        nodes2 = jnp.where(is_msg, m_nodes, t_nodes)
-        sends = jnp.where(is_msg, m_sends, t_sends)
-        new_t = jnp.where(is_msg, m_set, t_set)
-        exc = jnp.where(is_msg, m_exc, t_exc)
-        valid = jnp.where(is_msg, m_ok, t_ok)
-        # An exception-state successor is frozen at the throwing transition:
-        # sends/new timers from the faulting handler are still applied (the
-        # reference captures the throwable after hooks ran,
-        # SearchState.java:218-222), but the state is terminal (run() ends).
-
         send_over = jnp.int32(0)
         if (p.max_live_sends is not None
                 and p.max_live_sends < p.max_sends):
@@ -615,34 +619,103 @@ class TensorSearch:
             # O(S x CAP) merge below; overflow is semantic (a dropped send
             # corrupts the successor) and stays fatal.
             sends, send_over = compact_rows(sends, p.max_live_sends)
-
         net2, net_over = insert_messages(net, sends)
+        timers2, t_over = append_timers(timers, new_t)
+        over = (net_over + t_over + send_over) * valid.astype(jnp.int32)
+        row = jnp.concatenate([
+            nodes2.astype(jnp.int32), net2.reshape(-1),
+            timers2.reshape(-1),
+            jnp.asarray(exc, jnp.int32).reshape(1)])
+        return row, valid, over
+        # An exception-state successor is frozen at the throwing
+        # transition: sends/new timers from the faulting handler are
+        # still applied (the reference captures the throwable after the
+        # hooks ran, SearchState.java:218-222), but the state is terminal
+        # (run() ends).
+
+    def _msg_step(self, row: jnp.ndarray, net_slot: jnp.ndarray):
+        """Expand ONE state row by delivering the message in network slot
+        ``net_slot`` -> (successor row, valid, over).  All event picks
+        are one-hot 0/1 sums — static indexing only (per-pair dynamic
+        gathers materialise at ~1 GB/s under the flat vmap on TPU)."""
+        p = self.p
+        s = self._slice_state(row)
+        nodes, net, timers = s["nodes"], s["net"], s["timers"]
+        moh = jnp.arange(p.net_cap) == net_slot.clip(0, p.net_cap - 1)
+        msg = jnp.sum(moh[:, None] * net, axis=0)
+        ok = msg[0] != SENTINEL
+        if p.deliver_message is not None:
+            ok = ok & p.deliver_message(msg)
+        nodes2, sends, new_t, exc = _normalize_step(
+            p.step_message(nodes, msg))
+        return self._finish_step(net, timers, nodes2, sends, new_t, exc,
+                                 ok)
+
+    def _tmr_step(self, row: jnp.ndarray, t_idx: jnp.ndarray):
+        """Expand ONE state row by firing timer grid index ``t_idx``
+        (= node * timer_cap + queue slot) -> (successor row, valid,
+        over)."""
+        p = self.p
+        s = self._slice_state(row)
+        nodes, net, timers = s["nodes"], s["net"], s["timers"]
+        t_node = t_idx // p.timer_cap
+        t_slot = t_idx % p.timer_cap
+        n_oh = jnp.arange(p.n_nodes) == t_node               # [NN]
+        s_oh = jnp.arange(p.timer_cap) == t_slot             # [T_CAP]
+        queue = jnp.sum(n_oh[:, None, None] * timers, axis=0)
+        ok = jnp.sum(timer_deliverable_mask(queue) * s_oh) > 0
+        if p.deliver_timer is not None:
+            ok = ok & p.deliver_timer(t_node)
+        timer = jnp.sum(s_oh[:, None] * queue, axis=0)
+        nodes2, sends, new_t, exc = _normalize_step(
+            p.step_timer(nodes, t_node, timer))
         # Firing consumes the timer (SearchState.java:357); the updated
         # queue lands via the node one-hot, never a dynamic scatter.
-        fired_q = remove_timer(t_queue, t_slot)
-        timers2 = jnp.where((~is_msg & n_oh)[:, None, None],
-                            fired_q[None], timers)
-        timers2, t_over = append_timers(timers2, new_t)
-        over = (net_over + t_over + send_over) * valid.astype(jnp.int32)
-        succ = {"nodes": nodes2, "net": net2, "timers": timers2,
-                "exc": exc}
-        return succ, valid, over
+        fired_q = remove_timer(queue, t_slot)
+        timers2 = jnp.where(n_oh[:, None, None], fired_q[None], timers)
+        return self._finish_step(net, timers2, nodes2, sends, new_t, exc,
+                                 ok)
 
-    def _event_table(self, chunk_state: dict, chunk_valid: jnp.ndarray):
-        """[C]-state chunk -> ([C, B] int32 compacted event ids (-1 =
-        empty slot), ev_drops scalar): each state's VALID events (occupied
-        network rows + deliverable timers, masked by the protocol's
-        deliver_* settings — exactly the predicates :meth:`_step_one`
-        re-checks) packed into the ``ev_budget`` pair slots.  Events
-        beyond the budget are counted, never silently skipped."""
+    def _step_one(self, row: jnp.ndarray, event_idx: jnp.ndarray):
+        """Expand ONE state row by ONE grid event id -> (successor row,
+        valid, over).  Select-both compatibility wrapper over the split
+        kinds — the expand pipeline uses the split grids; this remains
+        for trace replay (tpu/trace.py) and external callers."""
         p = self.p
-        grid = self._grid_events(p)
-        b = self._ev_slots
+        is_msg = event_idx < p.net_cap
+        m = self._msg_step(row, event_idx)
+        t = self._tmr_step(row, jnp.maximum(event_idx - p.net_cap, 0))
+        return jax.tree.map(lambda a, b: jnp.where(is_msg, a, b), m, t)
+
+    @staticmethod
+    def _compact_ids(valid_ev: jnp.ndarray, budget: int):
+        """[C, G] validity grid -> ([C, budget] compacted indices into G
+        (-1 = empty slot), drops scalar).  One-hot select-reduce over the
+        [C, budget, G] cube — static indexing; per-CHUNK, not per-pair."""
+        c, g = valid_ev.shape
+        if budget >= g:
+            ids = jnp.broadcast_to(jnp.arange(g, dtype=jnp.int32), (c, g))
+            return jnp.where(valid_ev, ids, -1), jnp.int32(0)
+        pos = jnp.cumsum(valid_ev, axis=1) - 1
+        hit = valid_ev[:, None, :] & (
+            pos[:, None, :] == jnp.arange(budget)[None, :, None])
+        ids = jnp.sum(jnp.where(hit, jnp.arange(g, dtype=jnp.int32)
+                                [None, None, :], 0), axis=2)
+        ids = jnp.where(jnp.any(hit, axis=2), ids, -1)
+        drops = jnp.sum(valid_ev & (pos >= budget)).astype(jnp.int32)
+        return ids, drops
+
+    def _event_tables(self, chunk_rows: jnp.ndarray,
+                      chunk_valid: jnp.ndarray):
+        """[C, lanes] chunk -> (msg_ids [C, Bm] net-slot indices, tmr_ids
+        [C, Bt] timer grid indices, ev_drops): each state's VALID events
+        (occupied network rows + deliverable timers, masked by the
+        protocol's deliver_* settings — exactly the predicates the step
+        kinds re-check) packed into per-kind pair slots.  Events beyond
+        a budget are counted, never silently skipped."""
+        p = self.p
         c = chunk_valid.shape[0]
-        if b >= grid:
-            ids = jnp.broadcast_to(jnp.arange(grid, dtype=jnp.int32),
-                                   (c, grid))
-            return ids, jnp.int32(0)
+        chunk_state = self.unflatten_rows(chunk_rows)
         msg_ok = chunk_state["net"][:, :, 0] != SENTINEL   # [C, net_cap]
         if p.deliver_message is not None:
             msg_ok = msg_ok & jax.vmap(jax.vmap(p.deliver_message))(
@@ -652,47 +725,60 @@ class TensorSearch:
         if p.deliver_timer is not None:
             dt = jax.vmap(p.deliver_timer)(jnp.arange(p.n_nodes))
             tmask = tmask & dt[None, :, None]
-        valid_ev = jnp.concatenate(
-            [msg_ok, tmask.reshape(c, -1)], axis=1)        # [C, grid]
-        valid_ev = valid_ev & chunk_valid[:, None]
-        pos = jnp.cumsum(valid_ev, axis=1) - 1
-        # ids[i, k] = the event id whose compact rank is k: one-hot
-        # select-reduce over the [C, B, grid] cube (static indexing; the
-        # cube is per-CHUNK, not per-pair, so it is cheap).
-        hit = valid_ev[:, None, :] & (
-            pos[:, None, :] == jnp.arange(b)[None, :, None])
-        ids = jnp.sum(jnp.where(hit, jnp.arange(grid, dtype=jnp.int32)
-                                [None, None, :], 0), axis=2)
-        ids = jnp.where(jnp.any(hit, axis=2), ids, -1)
-        ev_drops = jnp.sum(valid_ev & (pos >= b)).astype(jnp.int32)
-        return ids, ev_drops
+        msg_ids, m_drops = self._compact_ids(
+            msg_ok & chunk_valid[:, None], self._ev_msg)
+        tmr_ids, t_drops = self._compact_ids(
+            tmask.reshape(c, -1) & chunk_valid[:, None], self._ev_tmr)
+        return msg_ids, tmr_ids, m_drops + t_drops
 
     def _expand_chunk(self, chunk_state: dict, chunk_valid: jnp.ndarray):
-        """[C]-state chunk -> successors + fingerprints + masks + flags.
+        """[C, lanes] chunk rows -> successor rows + fingerprints + masks
+        + flags.
 
-        Returns (flat_successors [C*B], valids [C*B], fp [C*B, 4] uint32,
+        Returns (rows [C*B, lanes], valids [C*B], fp [C*B, 4] uint32,
         unique [C*B] in-chunk-first-occurrence mask, overflow scalar,
         ev_drops scalar, event_ids [C, B], flags dict) — all device
-        arrays; no host sync inside.  B = the per-state pair-slot count
-        (``ev_budget`` or the full event grid)."""
+        arrays; no host sync inside.  B = Bm + Bt, message pair slots
+        first per state (successor row = chunk_row * B + slot, the
+        arithmetic run()/_reconstruct and the sharded driver use)."""
         p = self.p
-        ne = self._ev_slots
+        bm, bt = self._ev_msg, self._ev_tmr
         c = chunk_valid.shape[0]
-        event_ids, ev_drops = self._event_table(chunk_state, chunk_valid)
-        # ONE flat vmap over all (state, event) pairs.  A nested
+        msg_ids, tmr_ids, ev_drops = self._event_tables(chunk_state,
+                                                        chunk_valid)
+        # TWO flat vmaps — one per event kind, each running only its own
+        # machinery (the round-2 select-both design ran BOTH handlers for
+        # every pair).  Flat, not nested: a nested
         # vmap-over-events-inside-vmap-over-states compiles the protocol
         # twins' traced-index gathers/scatters into a pathologically slow
-        # two-batch-dim scatter path on TPU (~100x); flattening keeps every
-        # scatter on the fast single-batch-dim lowering.
-        rep_state = jax.tree.map(
-            lambda x: jnp.repeat(x, ne, axis=0), chunk_state)
-        ev = jnp.maximum(event_ids, 0).reshape(-1)
-        rep_valid = (event_ids >= 0).reshape(-1) & jnp.repeat(chunk_valid,
-                                                              ne)
-        flat, valids, overs = jax.vmap(self._step_one)(rep_state, ev)
-        valids = valids & rep_valid
+        # two-batch-dim scatter path on TPU (~100x); flattening keeps
+        # every scatter on the fast single-batch-dim lowering.  The
+        # per-state repeat is a broadcast (XLA fuses it into the reads).
+        rep_m = jnp.repeat(chunk_state, bm, axis=0)
+        rows_m, val_m, over_m = jax.vmap(self._msg_step)(
+            rep_m, jnp.maximum(msg_ids, 0).reshape(-1))
+        val_m = val_m & (msg_ids >= 0).reshape(-1)
+        rep_t = jnp.repeat(chunk_state, bt, axis=0)
+        rows_t, val_t, over_t = jax.vmap(self._tmr_step)(
+            rep_t, jnp.maximum(tmr_ids, 0).reshape(-1))
+        val_t = val_t & (tmr_ids >= 0).reshape(-1)
+
+        def _inter(a, b):
+            return jnp.concatenate(
+                [a.reshape((c, bm) + a.shape[1:]),
+                 b.reshape((c, bt) + b.shape[1:])],
+                axis=1).reshape((c * (bm + bt),) + a.shape[1:])
+
+        rows = _inter(rows_m, rows_t)
+        valids = _inter(val_m, val_t)
+        overs = _inter(over_m, over_t)
+        # Grid event ids for trace spills: timer table entries are
+        # net_cap + t_idx in the flat grid numbering.
+        event_ids = jnp.concatenate(
+            [msg_ids, jnp.where(tmr_ids >= 0, p.net_cap + tmr_ids, -1)],
+            axis=1)                                        # [C, Bm+Bt]
         overflow = jnp.sum(overs * valids.astype(jnp.int32))
-        fp = state_fingerprints(flat)
+        fp = row_fingerprints(rows)
 
         if self._in_chunk_dedup:
             # In-chunk sort-unique on device: first occurrence of each
@@ -714,11 +800,12 @@ class TensorSearch:
             unique = valids
 
         flags = {}
+        succ_states = self.unflatten_rows(rows)    # views for predicates
         for kind, preds in (("inv", p.invariants), ("goal", p.goals),
                             ("prune", p.prunes)):
             for name, fn in preds.items():
-                flags[f"{kind}:{name}"] = jax.vmap(fn)(flat) & valids
-        return (flat, valids, fp, unique, overflow, ev_drops, event_ids,
+                flags[f"{kind}:{name}"] = jax.vmap(fn)(succ_states) & valids
+        return (rows, valids, fp, unique, overflow, ev_drops, event_ids,
                 flags)
 
     # ----------------------------------------------------------------- run
@@ -741,7 +828,7 @@ class TensorSearch:
                                          predicate_name=name)
         return None
 
-    def _terminal_outcome(self, flat, np_valids, np_exc, flags,
+    def _terminal_outcome(self, rows, np_valids, np_exc, flags,
                           explored, visited_n, depth, t0,
                           level_base_row: int = 0):
         """checkState order: exception -> invariant -> goal
@@ -749,7 +836,9 @@ class TensorSearch:
         import time
 
         def slice_state(idx):
-            return jax.tree.map(lambda x: np.asarray(x)[idx:idx + 1], flat)
+            return jax.tree.map(
+                np.asarray,
+                self.unflatten_rows(np.asarray(rows[idx:idx + 1])))
 
         exc_hit = np_valids & (np_exc != 0)
         if exc_hit.any():
@@ -830,7 +919,7 @@ class TensorSearch:
             if out is not None:
                 return out
 
-        frontier = state
+        frontier = flatten_state(state)              # [1, lanes] rows
         # parent_rows[i] = the global successor row (in the PREVIOUS level's
         # enumeration) that produced frontier state i; for the root level it
         # is -1.  Used by _reconstruct.
@@ -850,7 +939,7 @@ class TensorSearch:
                 self._levels.append({"parent_rows": parent_rows,
                                      "event_ids": []})
             # ---- expand all chunks (device), collect level arrays (host)
-            lvl_states: List[dict] = []
+            lvl_states: List[np.ndarray] = []
             lvl_keys: List[Tuple[np.ndarray, np.ndarray]] = []
             lvl_pruned: List[np.ndarray] = []
             lvl_rows: List[np.ndarray] = []
@@ -859,15 +948,14 @@ class TensorSearch:
                 end = min(start + self.chunk, frontier_n)
                 c = end - start
                 pad = self.chunk - c
-                chunk_state = jax.tree.map(
-                    lambda x: jnp.concatenate(
-                        [x[start:end],
-                         jnp.repeat(x[:1], pad, axis=0)], axis=0)
-                    if pad else x[start:end], frontier)
+                chunk_rows = (jnp.concatenate(
+                    [frontier[start:end],
+                     jnp.repeat(frontier[:1], pad, axis=0)], axis=0)
+                    if pad else frontier[start:end])
                 chunk_valid = jnp.concatenate(
                     [jnp.ones(c, bool), jnp.zeros(pad, bool)])
-                (flat, valids, fp, unique, overflow, ev_drops, event_ids,
-                 flags) = self._expand(chunk_state, chunk_valid)
+                (rows_d, valids, fp, unique, overflow, ev_drops, event_ids,
+                 flags) = self._expand(chunk_rows, chunk_valid)
                 if int(overflow):
                     raise CapacityOverflow(
                         f"{self.p.name}: net_cap={self.p.net_cap}, "
@@ -884,9 +972,9 @@ class TensorSearch:
                         np.asarray(event_ids))
                 np_valids = np.asarray(valids)
                 explored += int(np_valids.sum())
-                np_exc = np.asarray(flat["exc"])
+                np_exc = np.asarray(rows_d[:, -1])
                 out = self._terminal_outcome(
-                    flat, np_valids, np_exc, flags, explored,
+                    rows_d, np_valids, np_exc, flags, explored,
                     len(visited[0]), depth, t0,
                     level_base_row=start * ne)
                 if out is not None:
@@ -905,8 +993,7 @@ class TensorSearch:
                     lvl_keys.append((h1[idxs], h2[idxs]))
                     lvl_pruned.append(pruned[idxs])
                     lvl_rows.append(idxs + start * ne)
-                    lvl_states.append(jax.tree.map(
-                        lambda x: np.asarray(x)[idxs], flat))
+                    lvl_states.append(np.asarray(rows_d)[idxs])
 
             if not lvl_keys:
                 return SearchOutcome("SPACE_EXHAUSTED", explored,
@@ -944,17 +1031,16 @@ class TensorSearch:
 
             keep_idx = np.nonzero(expand)[0]
             # lvl_states rows align 1:1 with h1/h2/rows concatenation.
-            all_states = (jax.tree.map(
-                lambda *xs: np.concatenate(xs, axis=0), *lvl_states)
-                if len(lvl_states) > 1 else lvl_states[0])
-            nf = jax.tree.map(lambda x: x[keep_idx], all_states)
+            all_rows = (np.concatenate(lvl_states, axis=0)
+                        if len(lvl_states) > 1 else lvl_states[0])
+            nf = all_rows[keep_idx]
             parent_rows = rows[keep_idx]
-            frontier_n = len(nf["nodes"])
+            frontier_n = len(nf)
             if frontier_n > self.frontier_cap:
                 return SearchOutcome("CAPACITY_EXHAUSTED", explored,
                                      len(visited[0]), depth,
                                      time.time() - t0)
-            frontier = jax.tree.map(jnp.asarray, nf)
+            frontier = jnp.asarray(nf)
 
         return SearchOutcome("SPACE_EXHAUSTED", explored, len(visited[0]),
                              depth, 0.0)

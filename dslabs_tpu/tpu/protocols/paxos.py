@@ -80,14 +80,29 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
     # ---- message layout: [tag, frm, to, p0..]  payload:
     #   REQ:   [client, seq]
     #   P1A:   [ballot]
-    #   P1B:   [ballot, S x (exists, lballot, cmd, chosen)]
+    #   P1B:   [ballot, S x packed log entry]  (see _pack_entry: one
+    #          int32 per slot — message width drives the engine's
+    #          set-insert merge cost AND the HBM row width, the round-3
+    #          measured bottleneck; the unpacked 4-lane form made MW 16
+    #          and the network block 76% of every state row)
     #   P2A:   [ballot, slot, cmd]
     #   P2B:   [ballot, slot]
     #   HB:    [ballot, commit, gc]     HBR: [ballot, executed]
     #   CREQ:  [from_slot]              CREP: [base, count, S x cmd]
     #   REPLY: [client, seq]
-    PAYLOAD = max(1 + 4 * S, 3, 2 + S)
+    PAYLOAD = max(1 + S, 3, 2 + S)
     MW = 3 + PAYLOAD
+
+    def _pack_entry(ex, lb, cmd, ch):
+        """(exists, ballot, cmd, chosen) -> one int32: bijective within
+        ballot < 2^12 (300+ elections — unreachable at search depths) and
+        cmd < 2^17 (cmd ids are <= n_clients * w).  Bijectivity keeps
+        state equality exact; all fields nonneg so the packed lane stays
+        nonneg and lexicographic network order well-defined."""
+        return (ex | (ch << 1) | (lb << 2) | (cmd << 14)).astype(jnp.int32)
+
+    def _unpack_entry(v):
+        return v & 1, (v >> 2) & 0xFFF, v >> 14, (v >> 1) & 1
     TW = 4  # [tag, min, max, p0]
     # Exact static send/set row budgets (finalize() asserts the count at
     # trace time; a miscount fails loudly, never truncates).  Server rows:
@@ -383,9 +398,11 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
         _set(st, "b", i, jnp.where(adopt, mb, st["b"][i]))
         _set(st, "ld", i, jnp.where(adopt, 0, st["ld"][i]))
         promise = is_p1a & (mb == st["b"][i])
-        log_flat = st["log"][i].reshape(4 * S)
         sends.add(promise, P1B, i, frm,
-                  [st["b"][i]] + [log_flat[j] for j in range(4 * S)])
+                  [st["b"][i]] + [
+                      _pack_entry(st["log"][i][s][0], st["log"][i][s][1],
+                                  st["log"][i][s][2], st["log"][i][s][3])
+                      for s in range(S)])
 
         # ---- P1b (handle_P1b)
         is_p1b = here & (tag == P1B)
@@ -393,8 +410,13 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
         accept_vote = (is_p1b & (vb == st["b"][i])
                        & (st["b"][i] % n == i)
                        & (st["ld"][i] == 0))
-        vrec = jnp.concatenate([jnp.ones((1,), jnp.int32),
-                                p[1:1 + 4 * S].astype(jnp.int32)])
+        # Unpack the S packed log entries back into the raw vote-row
+        # layout [have, S x (exists, ballot, cmd, chosen)].
+        vlanes = [jnp.ones((), jnp.int32)]
+        for s in range(S):
+            ex, lb, cmd, ch = _unpack_entry(p[1 + s].astype(jnp.int32))
+            vlanes += [ex, lb, cmd, ch]
+        vrec = jnp.stack(vlanes).astype(jnp.int32)
         st["votes"] = st["votes"].at[i].set(
             oh_put(st["votes"][i], frm, n, vrec, accept_vote))
         nvotes = jnp.sum(st["votes"][i][:, 0])

@@ -48,7 +48,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dslabs_tpu.tpu.engine import (CapacityOverflow, SearchOutcome,
                                    TensorProtocol, TensorSearch,
-                                   flatten_state, state_fingerprints)
+                                   flatten_state, row_fingerprints,
+                                   state_fingerprints)
 
 __all__ = ["ShardedTensorSearch", "make_mesh"]
 
@@ -104,7 +105,8 @@ class ShardedTensorSearch(TensorSearch):
                  max_depth: Optional[int] = None,
                  max_secs: Optional[float] = None,
                  strict: bool = True,
-                 ev_budget: Optional[int] = None):
+                 ev_budget: Optional[int] = None,
+                 record_trace: bool = False):
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
         self.n_devices = int(mesh.devices.size)
@@ -136,10 +138,14 @@ class ShardedTensorSearch(TensorSearch):
         super().__init__(protocol, frontier_cap=frontier_cap,
                          chunk=chunk_per_device, max_depth=max_depth,
                          max_secs=max_secs, in_chunk_dedup=strict,
-                         ev_budget=ev_budget)
+                         ev_budget=ev_budget, record_trace=record_trace)
+        # Trace mode: each level spills (child_fp, parent_fp, event_id)
+        # for every appended successor; reconstruction walks fingerprints
+        # back to the root on the HOST (fps are stable identities, so the
+        # level rebalance needs no permutation bookkeeping) and replays
+        # the grid event ids on the object twin via tpu/trace.py.
+        self._fp_map = {}                  # child fp bytes -> (parent, ev)
         p = protocol
-        self.lanes = (p.node_width + p.net_cap * p.msg_width
-                      + p.n_nodes * p.timer_cap * p.timer_width + 1)
         self._flag_names = (["exc"]
                             + [f"inv:{n}" for n in p.invariants]
                             + [f"goal:{n}" for n in p.goals])
@@ -167,24 +173,6 @@ class ShardedTensorSearch(TensorSearch):
             ])
 
         self._stats = jax.jit(stats)
-
-    # ------------------------------------------------------------- helpers
-
-    def unflatten_rows(self, rows) -> dict:
-        """[C, lanes] int32 -> batched state pytree (inverse of
-        engine.flatten_state)."""
-        p = self.p
-        c = rows.shape[0]
-        o0 = p.node_width
-        o1 = o0 + p.net_cap * p.msg_width
-        o2 = o1 + p.n_nodes * p.timer_cap * p.timer_width
-        return {
-            "nodes": rows[:, :o0],
-            "net": rows[:, o0:o1].reshape(c, p.net_cap, p.msg_width),
-            "timers": rows[:, o1:o2].reshape(
-                c, p.n_nodes, p.timer_cap, p.timer_width),
-            "exc": rows[:, o2],
-        }
 
     # --------------------------------------------------------- level chunk
 
@@ -231,15 +219,25 @@ class ShardedTensorSearch(TensorSearch):
             start = j * C
             rows_chunk = jax.lax.dynamic_slice(cur, (start, 0), (C, lanes))
             valid = (start + jnp.arange(C)) < cur_n
-            states = self.unflatten_rows(rows_chunk)
-            (flat, valids, fp, unique, overflow, ev_drops, _,
-             flags) = self._expand_chunk(states, valid)
-            rows = flatten_state(flat)
+            (rows, valids, fp, unique, overflow, ev_drops, event_ids,
+             flags) = self._expand_chunk(rows_chunk, valid)
+            if self.record_trace:
+                # [C*B, 9] uint32 trace meta: child fp, parent fp, grid
+                # event id — spilled to host per level for fp-chain
+                # reconstruction (the sharded analog of the base
+                # engine's per-level (parent, event) spill).
+                fp_par = row_fingerprints(rows_chunk)          # [C, 4]
+                ne_slots = self._num_events()
+                meta = jnp.concatenate([
+                    fp,
+                    jnp.repeat(fp_par, ne_slots, axis=0),
+                    event_ids.reshape(-1, 1).astype(jnp.uint32),
+                ], axis=1)                                     # [C*B, 9]
             if stop_after == "expand":
                 return _stopped(carry, rows, fp, unique)
 
             # ---- terminal flags, checkState order (exception first)
-            hit_list = [valids & (flat["exc"] != 0)]
+            hit_list = [valids & (rows[:, -1] != 0)]
             for n in p.invariants:
                 hit_list.append(valids & ~flags[f"inv:{n}"])
             for n in p.goals:
@@ -252,8 +250,11 @@ class ShardedTensorSearch(TensorSearch):
             flag_rows = jnp.where(fresh_flag[:, None], new_rows_f,
                                   carry["flag_rows"])
             flag_cnt = carry["flag_cnt"] + cnts
+            if self.record_trace:
+                flag_meta = jnp.where(fresh_flag[:, None], meta[idxs],
+                                      carry["flag_meta"])
 
-            pruned = flat["exc"] != 0
+            pruned = rows[:, -1] != 0
             for n in p.prunes:
                 pruned = pruned | flags[f"prune:{n}"]
 
@@ -327,39 +328,89 @@ class ShardedTensorSearch(TensorSearch):
             VB = V // BKT
             slot0 = (skeys[:, 2] & jnp.uint32(VB - 1)).astype(jnp.int32)
             pstep = (skeys[:, 1] | jnp.uint32(1)).astype(jnp.uint32)
-            ridx = jnp.arange(rb, dtype=jnp.int32)
+            # Reservations go through a small HASHED table (bkt_i mod RT)
+            # instead of a per-bucket [VB+1] array: the full-size array
+            # cost a multi-MB init + scatter every iteration.  A hash
+            # collision between two DISTINCT buckets just makes one
+            # contender retry next iteration — correctness is unchanged
+            # (a winner must still re-win its own cell).
+            RT = 1 << max((rb * 2 - 1).bit_length(), 10)
+            # After ~2 full-batch iterations only a few percent of keys
+            # remain (deep bucket chains); compact those into a T-slot
+            # tail so late iterations stop re-scanning the whole batch —
+            # the measured high-load pathology (chunk step 90 -> 148 ms
+            # as the table filled).
+            T = max(rb // 8, 256)
 
-            def probe_cond(st):
-                _, _, resolved, _, it = st
-                return (it < 64) & jnp.any(~resolved)
-
-            def probe_body(st):
-                table, bkt_i, resolved, fresh, it = st
-                bkt = table[:V].reshape(VB, BKT, 4)[bkt_i]   # [rb, BKT, 4]
+            def _probe_iter(table, keys, bkt_i, ps, unres, idx):
+                """One probe iteration over any batch (idx = each row's
+                identity for reservation tie-breaks; rows with
+                unres=False are inert)."""
+                nb_rows = keys.shape[0]
+                bkt = table[:V].reshape(VB, BKT, 4)[bkt_i]
                 eq = jnp.any(
-                    jnp.all(bkt == skeys[:, None, :], axis=2), axis=1)
-                empty = jnp.all(bkt == MAXU32, axis=2)       # [rb, BKT]
+                    jnp.all(bkt == keys[:, None, :], axis=2), axis=1)
+                empty = jnp.all(bkt == MAXU32, axis=2)
                 has_empty = jnp.any(empty, axis=1)
                 first_empty = jnp.argmax(empty, axis=1)
-                unres = ~resolved
                 want = unres & ~eq & has_empty
-                res = jnp.full((VB + 1,), rb, jnp.int32).at[
-                    jnp.where(want, bkt_i, VB)].min(ridx)
-                winner = want & (res[bkt_i] == ridx)
+                rcell = bkt_i & (RT - 1)
+                res = jnp.full((RT + 1,), rb, jnp.int32).at[
+                    jnp.where(want, rcell, RT)].min(idx)
+                winner = want & (res[rcell] == idx)
                 dst = jnp.where(winner, bkt_i * BKT + first_empty, V)
-                table = table.at[dst].set(skeys)
-                resolved = resolved | eq | winner
+                table = table.at[dst].set(keys)
+                newly = eq | winner
                 # Losers re-read the SAME bucket next iteration (their
-                # key may now be present, or another empty slot remains);
-                # a FULL bucket advances by the double-hash step.
-                nb = (bkt_i.astype(jnp.uint32) + pstep).astype(
+                # key may now be present, or another empty slot
+                # remains); a FULL bucket advances by double-hash step.
+                nb = (bkt_i.astype(jnp.uint32) + ps).astype(
                     jnp.int32) & (VB - 1)
-                bkt_i = jnp.where(~resolved & ~has_empty, nb, bkt_i)
-                return table, bkt_i, resolved, fresh | winner, it + 1
+                bkt_i = jnp.where(unres & ~newly & ~has_empty, nb, bkt_i)
+                return table, bkt_i, newly & unres, winner & unres
 
-            table, _, resolved, fresh_s, _ = jax.lax.while_loop(
-                probe_cond, probe_body,
+            ridx = jnp.arange(rb, dtype=jnp.int32)
+
+            def full_cond(st):
+                _, _, resolved, _, it = st
+                return ((it < 2) | (jnp.sum(~resolved) > T)) & (
+                    it < 64) & jnp.any(~resolved)
+
+            def full_body(st):
+                table, bkt_i, resolved, fresh, it = st
+                table, bkt_i, newly, winner = _probe_iter(
+                    table, skeys, bkt_i, pstep, ~resolved, ridx)
+                return (table, bkt_i, resolved | newly, fresh | winner,
+                        it + 1)
+
+            table, bkt_i, resolved, fresh_s, _ = jax.lax.while_loop(
+                full_cond, full_body,
                 (visited, slot0, ~cand, jnp.zeros(rb, bool), jnp.int32(0)))
+
+            # ---- tail phase: compact the unresolved few into [T] slots
+            tail_idx = jnp.nonzero(~resolved, size=T, fill_value=rb)[0]
+            tclip = tail_idx.clip(0, rb - 1)
+            tval = tail_idx < rb
+            t_keys = skeys[tclip]
+            t_bkt = bkt_i[tclip]
+            t_ps = pstep[tclip]
+            t_id = jnp.arange(T, dtype=jnp.int32)
+
+            def tail_cond(st):
+                _, _, t_unres, _, it = st
+                return (it < 64) & jnp.any(t_unres)
+
+            def tail_body(st):
+                table, tb, t_unres, t_fresh, it = st
+                table, tb, newly, winner = _probe_iter(
+                    table, t_keys, tb, t_ps, t_unres, t_id)
+                return table, tb, t_unres & ~newly, t_fresh | winner, it + 1
+
+            table, _, t_unres, t_fresh, _ = jax.lax.while_loop(
+                tail_cond, tail_body,
+                (table, t_bkt, tval, jnp.zeros(T, bool), jnp.int32(0)))
+            resolved = resolved.at[tclip].max(tval & ~t_unres)
+            fresh_s = fresh_s.at[tclip].max(t_fresh & tval)
             new_visited = table
             # Probe exhaustion = table effectively full: semantic overflow
             # (missed dedup would corrupt unique counts).
@@ -399,7 +450,7 @@ class ShardedTensorSearch(TensorSearch):
             # the next level's chunk loop would re-expand the tail.
             n_sel = n_sel - frontier_drop
 
-            return {
+            out = {
                 "cur": cur, "cur_n": carry["cur_n"],
                 "j": carry["j"] + 1,
                 "nxt": nxt, "nxt_n": carry["nxt_n"].at[0].add(n_sel),
@@ -420,6 +471,11 @@ class ShardedTensorSearch(TensorSearch):
                     route_drop + frontier_drop + ev_drops),
                 "flag_cnt": flag_cnt, "flag_rows": flag_rows,
             }
+            if self.record_trace:
+                # Trace meta rides the SAME append scatter as the rows.
+                out["tmeta"] = carry["tmeta"].at[sdst].set(meta)
+                out["flag_meta"] = flag_meta
+            return out
 
         spec = self._carry_specs()
         return shard_map(local, mesh=self.mesh,
@@ -469,6 +525,9 @@ class ShardedTensorSearch(TensorSearch):
             carry["nxt"] = jnp.zeros((F + 1, lanes), jnp.int32)
             carry["nxt_n"] = jnp.zeros((1,), jnp.int32)
             carry["j"] = jnp.zeros((1,), jnp.int32)
+            if self.record_trace:
+                # The level's meta was spilled to host before this runs.
+                carry["tmeta"] = jnp.zeros((F + 1, 9), jnp.uint32)
             return carry
 
         spec = self._carry_specs()
@@ -478,9 +537,11 @@ class ShardedTensorSearch(TensorSearch):
 
     def _carry_specs(self):
         ax = self.axis
-        return {k: P(ax) for k in
-                ("cur", "cur_n", "j", "nxt", "nxt_n", "visited", "vis_n",
-                 "explored", "overflow", "drops", "flag_cnt", "flag_rows")}
+        keys = ["cur", "cur_n", "j", "nxt", "nxt_n", "visited", "vis_n",
+                "explored", "overflow", "drops", "flag_cnt", "flag_rows"]
+        if self.record_trace:
+            keys += ["tmeta", "flag_meta"]
+        return {k: P(ax) for k in keys}
 
     # ----------------------------------------------------------------- run
 
@@ -507,7 +568,7 @@ class ShardedTensorSearch(TensorSearch):
 
         def build(row0, k0):
             onehot_d = jnp.arange(D) == owner
-            return {
+            out = {
                 "cur": jnp.zeros((D * F, lanes), jnp.int32).at[
                     owner * F].set(row0),
                 "cur_n": onehot_d.astype(jnp.int32),
@@ -524,6 +585,10 @@ class ShardedTensorSearch(TensorSearch):
                 "flag_cnt": jnp.zeros((D * nf,), jnp.int32),
                 "flag_rows": jnp.zeros((D * nf, lanes), jnp.int32),
             }
+            if self.record_trace:
+                out["tmeta"] = jnp.zeros((D * (F + 1), 9), jnp.uint32)
+                out["flag_meta"] = jnp.zeros((D * nf, 9), jnp.uint32)
+            return out
 
         init = jax.jit(build, out_shardings={
             k: shard for k in self._carry_specs()})
@@ -538,6 +603,8 @@ class ShardedTensorSearch(TensorSearch):
             return None
         rows = np.asarray(carry["flag_rows"]).reshape(
             self.n_devices, nf, self.lanes)
+        metas = (np.asarray(carry["flag_meta"]).reshape(
+            self.n_devices, nf, 9) if self.record_trace else None)
         for fi, fname in enumerate(self._flag_names):
             devs = np.nonzero(cnts[:, fi])[0]
             if not len(devs):
@@ -545,24 +612,42 @@ class ShardedTensorSearch(TensorSearch):
             row = rows[devs[0], fi]
             st = jax.tree.map(np.asarray,
                               self.unflatten_rows(row[None]))
+            trace = None
+            if metas is not None:
+                m = metas[devs[0], fi]
+                trace = self._walk_fp_chain(
+                    tuple(int(x) for x in m[4:8]), int(m[8]))
             elapsed = time.time() - t0
             if fname == "exc":
                 return SearchOutcome(
                     "EXCEPTION_THROWN", explored, vis_total, depth, elapsed,
-                    violating_state=st, exception_code=int(st["exc"][0]))
+                    violating_state=st, exception_code=int(st["exc"][0]),
+                    trace=trace)
             kind, pname = fname.split(":", 1)
             if kind == "inv":
                 return SearchOutcome(
                     "INVARIANT_VIOLATED", explored, vis_total, depth,
-                    elapsed, violating_state=st, predicate_name=pname)
+                    elapsed, violating_state=st, predicate_name=pname,
+                    trace=trace)
             return SearchOutcome(
                 "GOAL_FOUND", explored, vis_total, depth, elapsed,
-                goal_state=st, predicate_name=pname)
+                goal_state=st, predicate_name=pname, trace=trace)
         return None
 
-    def run(self, check_initial: bool = True) -> SearchOutcome:
+    def run(self, check_initial: bool = True,
+            initial: Optional[dict] = None) -> SearchOutcome:
+        """Run the sharded BFS.  ``initial`` (a batch-1 state pytree,
+        e.g. a prior outcome's ``goal_state``) starts from an arbitrary
+        state — the staged-search pattern (PaxosTest.java:886-1096),
+        same contract as the single-device engine."""
         t0 = time.time()
-        state = self.initial_state()
+        state = (jax.tree.map(jnp.asarray, initial) if initial is not None
+                 else self.initial_state())
+        # Root of this run's trace (tpu/trace.py replays from here).
+        self._trace_root = jax.tree.map(np.asarray, state)
+        self._fp_map = {}
+        self._root_fp = tuple(np.asarray(
+            state_fingerprints(state), np.uint32)[0].tolist())
         if check_initial:
             out = self._check_initial(state, t0)
             if out is not None:
@@ -595,6 +680,13 @@ class ShardedTensorSearch(TensorSearch):
                     # checks as a full level before reporting, so a
                     # violation or capacity loss in the chunks already
                     # processed is never masked by TIME_EXHAUSTED.
+                    # Dispatch is async — without the periodic block the
+                    # whole level enqueues in milliseconds and the clock
+                    # check below can never fire mid-level (round-3: a
+                    # 120 s budget overran to 153 s, and the overrun runs
+                    # the SLOWEST, highest-table-load chunks).
+                    if (self.max_secs is not None and j % 16 == 15):
+                        jax.block_until_ready(carry["j"])
                     if (self.max_secs is not None and j + 1 < n_chunks
                             and time.time() - t0 > self.max_secs):
                         out, _, _, drops, _ = self._sync_checks(carry,
@@ -616,11 +708,52 @@ class ShardedTensorSearch(TensorSearch):
                           f"dispatch={t_disp:.2f}s "
                           f"explored={explored} unique={vis_total} "
                           f"next={max_n}", flush=True)
+                if self.record_trace:
+                    self._spill_tmeta(carry)
                 carry = self._finish_level(carry)
 
             return SearchOutcome(
                 "SPACE_EXHAUSTED", explored, vis_total, depth,
                 time.time() - t0, dropped=drops)
+
+    def _spill_tmeta(self, carry) -> None:
+        """Fold this level's appended (child_fp, parent_fp, event) rows
+        into the host-side fingerprint chain map (trace mode only).
+        Vectorised: a per-row Python loop at frontier scale would dwarf
+        the device time per level."""
+        F = self.f_cap
+        meta = np.asarray(carry["tmeta"]).reshape(
+            self.n_devices, F + 1, 9)
+        counts = np.asarray(carry["nxt_n"]).reshape(-1)
+        rows = np.concatenate([meta[d, :counts[d]]
+                               for d in range(self.n_devices)])
+        if not len(rows):
+            return
+        children = list(map(tuple, rows[:, :4].tolist()))
+        parents = list(map(tuple, rows[:, 4:8].tolist()))
+        events = rows[:, 8].tolist()
+        new = dict(zip(children, zip(parents, events)))
+        # Keep FIRST occurrence (BFS parent): existing entries win.
+        new.update(self._fp_map)
+        self._fp_map = new
+
+    def _walk_fp_chain(self, parent_fp, event_id) -> Optional[list]:
+        """flag_meta (parent fp, event) -> grid event ids root-first, by
+        walking the host fp map back to the run's root state."""
+        events = [event_id]
+        fp = parent_fp
+        seen = 0
+        while fp != self._root_fp:
+            ent = self._fp_map.get(fp)
+            if ent is None:
+                return None     # chain broken (shouldn't happen)
+            fp, ev = ent
+            events.append(ev)
+            seen += 1
+            if seen > 10 ** 6:
+                return None
+        events.reverse()
+        return events
 
     def _sync_checks(self, carry, depth, t0):
         """The per-sync check pipeline: semantic overflow (raise) ->

@@ -46,10 +46,13 @@ def decode_trace(search: TensorSearch,
     root = getattr(search, "_trace_root", None)
     if root is None:
         root = jax.tree.map(np.asarray, search.initial_state())
-    state = jax.tree.map(lambda x: np.asarray(x)[0], root)
+    from dslabs_tpu.tpu.engine import flatten_state
+    row = np.asarray(flatten_state(
+        jax.tree.map(jax.numpy.asarray, root)))[0]
     step = jax.jit(search._step_one)
     records: List[Tuple[str, tuple]] = []
     for ev in outcome.trace:
+        state = search._slice_state(row)       # numpy views
         if ev < p.net_cap:
             rec = np.asarray(state["net"][ev]).copy()
             records.append(("message", (rec,)))
@@ -58,13 +61,12 @@ def decode_trace(search: TensorSearch,
             node, slot = t_idx // p.timer_cap, t_idx % p.timer_cap
             rec = np.asarray(state["timers"][node, slot]).copy()
             records.append(("timer", (node, rec)))
-        succ, valid, _ = step(
-            jax.tree.map(lambda x: jax.numpy.asarray(x), state),
-            jax.numpy.asarray(ev))
+        succ_row, valid, _ = step(jax.numpy.asarray(row),
+                                  jax.numpy.asarray(ev))
         assert bool(valid), (
             f"trace replay hit an undeliverable event {ev} — "
             "reconstruction mapping is corrupt")
-        state = jax.tree.map(np.asarray, succ)
+        row = np.asarray(succ_row)
     return records
 
 

@@ -87,24 +87,31 @@ def main(argv=None) -> int:
     ap.add_argument("--config", help="JSON config (reference shape)")
     ap.add_argument("--submissions")
     ap.add_argument("--hosts", nargs="+")
-    ap.add_argument("--labs", nargs="+", default=["0", "1", "2", "3", "4"])
-    ap.add_argument("--remote-dir", default=REMOTE_DIR)
-    ap.add_argument("--out", default="grades.csv")
+    ap.add_argument("--labs", nargs="+", default=None)
+    ap.add_argument("--remote-dir", default=None)
+    ap.add_argument("--out", default=None)
     ap.add_argument("--results-dir", default="results")
     args = ap.parse_args(argv)
 
     if args.config:
         with open(args.config) as fd:
             cfg = json.load(fd)
-        # CLI wins over config everywhere (labs included: regrading one
-        # lab with --labs must not be silently widened by the config).
+        # CLI wins over config everywhere: every option defaults to None
+        # so "explicitly passed" is unambiguous (a string test on argv
+        # missed --labs=... and argparse prefix forms).
         args.submissions = args.submissions or os.path.expanduser(
             cfg.get("submission_path", ""))
         args.hosts = args.hosts or cfg.get("hosts", [])
-        if "--labs" not in argv:
-            args.labs = cfg.get("labs", args.labs)
-        args.remote_dir = cfg.get("remote_dir", args.remote_dir)
-        args.out = cfg.get("out", args.out)
+        if args.labs is None:
+            args.labs = cfg.get("labs")
+        if args.remote_dir is None:
+            args.remote_dir = cfg.get("remote_dir")
+        if args.out is None:
+            args.out = cfg.get("out")
+    if args.labs is None:
+        args.labs = ["0", "1", "2", "3", "4"]
+    args.remote_dir = args.remote_dir or REMOTE_DIR
+    args.out = args.out or "grades.csv"
     if not args.submissions or not args.hosts:
         ap.error("--submissions and --hosts required (or via --config)")
 

@@ -39,7 +39,7 @@ def main():
     C = 256
     search = TensorSearch(protocol, chunk=C)
     state = search.initial_state()
-    chunk_state = jax.tree.map(lambda x: jnp.repeat(x, C, axis=0), state)
+    chunk_state = jnp.repeat(flatten_state(state), C, axis=0)
     chunk_valid = jnp.ones(C, bool)
     ne = search._num_events()
     n_pairs = C * ne
@@ -52,7 +52,7 @@ def main():
     print(f"  -> {n_pairs/dt:,.0f} explored pairs/s")
 
     # pieces, over the flattened pair batch
-    rep_state = jax.tree.map(lambda x: jnp.repeat(x, ne, axis=0), chunk_state)
+    rep_state = jnp.repeat(chunk_state, ne, axis=0)
     ev = jnp.tile(jnp.arange(ne), C)
 
     def step_only(rs, e):
@@ -62,18 +62,20 @@ def main():
                   rep_state, ev)
 
     p = protocol
-    sends = jnp.full((n_pairs, p.max_sends, p.msg_width), 2**31 - 1,
-                     jnp.int32)
+    rep_states = search.unflatten_rows(rep_state)   # views into the rows
+    live = p.max_live_sends or p.max_sends
+    sends = jnp.full((n_pairs, live, p.msg_width), 2**31 - 1, jnp.int32)
 
     def ins_only(net, s):
         return jax.vmap(insert_messages)(net, s)
 
-    dt = bench_fn("insert_messages alone", ins_only, rep_state["net"], sends)
+    dt = bench_fn("insert_messages alone", ins_only, rep_states["net"],
+                  sends)
 
     def canon_only(net):
         return jax.vmap(canonicalize_net)(net)
 
-    bench_fn("canonicalize_net alone", canon_only, rep_state["net"])
+    bench_fn("canonicalize_net alone", canon_only, rep_states["net"])
 
     new_t = jnp.full((n_pairs, p.max_sets, 1 + p.timer_width), 2**31 - 1,
                      jnp.int32)
@@ -81,15 +83,17 @@ def main():
     def app_only(t, nt):
         return jax.vmap(append_timers)(t, nt)
 
-    bench_fn("append_timers alone", app_only, rep_state["timers"], new_t)
+    bench_fn("append_timers alone", app_only, rep_states["timers"], new_t)
+
+    from dslabs_tpu.tpu.engine import row_fingerprints
 
     def fp_only(rs):
-        return state_fingerprints(rs)
+        return row_fingerprints(rs)
 
-    bench_fn("state_fingerprints alone", fp_only, rep_state)
+    bench_fn("row_fingerprints alone", fp_only, rep_state)
 
     # the in-chunk lexsort
-    fp = state_fingerprints(rep_state)
+    fp = row_fingerprints(rep_state)
 
     def sort_only(fp, valids):
         inv = ~valids
@@ -103,17 +107,18 @@ def main():
              jnp.ones(n_pairs, bool))
 
     # predicate flags
-    flat_all = jax.vmap(search._step_one)(rep_state, ev)[0]
+    rows_all = jax.vmap(search._step_one)(rep_state, ev)[0]
 
-    def flags_only(flat):
+    def flags_only(rows):
+        states = search.unflatten_rows(rows)
         out = {}
         for kind, preds in (("inv", p.invariants), ("goal", p.goals),
                             ("prune", p.prunes)):
             for name, fn in preds.items():
-                out[f"{kind}:{name}"] = jax.vmap(fn)(flat)
+                out[f"{kind}:{name}"] = jax.vmap(fn)(states)
         return out
 
-    bench_fn("predicate flags alone", flags_only, flat_all)
+    bench_fn("predicate flags alone", flags_only, rows_all)
 
 
 if __name__ == "__main__":

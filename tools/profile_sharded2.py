@@ -64,7 +64,6 @@ def main():
     for stop in STAGES:
         sv = make_search(None)          # warm with the FULL program
         with sv.mesh:
-            full_step = sv._chunk_step
             carry, max_n = warm_carry(sv)
             if stop is not None:        # then swap in the variant
                 sv._stop_after = stop
