@@ -1,0 +1,520 @@
+"""Tensorised twin of lab 4 Part 2: cross-group TRANSACTIONS (2PC) in
+the search-test shape (ShardStorePart2Test.test09 / our object
+test09_single_client_multi_group_tx_search): two one-server groups, one
+shard master (timers frozen), config controller done, and a client whose
+workload is W transactions each spanning BOTH groups (e.g.
+MultiPut({key-1: v, key-6: v}) then MultiGet({key-1, key-6}) under a
+10-shard Join(1)/Join(2) rebalance).
+
+Everything the Part-1 twin models (config walk None -> cfg0 -> cfg1,
+query gating, the g1 -> g2 handoff — see shardstore.py's docstring) is
+reproduced here, plus the 2PC state machine of the object implementation
+(dslabs_tpu/labs/shardedstore/shardstore.py):
+
+* client routes a multi-group tx to the COORDINATOR group — the owner of
+  the tx's smallest shard, statically group 1 here (_target_group).
+* ``_coordinate_tx``: AMO-cached reply / absorb-while-in-progress / new
+  round -> TxPrepare to every participant (including g1 itself — all 2PC
+  traffic rides the network, so the checker explores its interleavings).
+* ``_apply_tx_prepare``: tx_done -> yes-vote; config-NUM mismatch ->
+  abort vote (the round-2 lost-write fix); stale round ignored, newer
+  round supersedes (locks released, re-prepare); fresh prepare computes
+  ok = no-conflict AND my shards owned (g2 voting while its handoff is
+  in flight votes no), locks on ok.
+* ``_apply_tx_vote``: first-writer votes, any-no -> abort decision,
+  all-yes -> commit (coordinator records the AMO result and replies to
+  the client), decision broadcast to un-acked participants.
+* ``_apply_tx_decision``: round-matched prepare popped; commit & ok
+  applies the tx's writes to owned shards and sets tx_done; own locks
+  released; aborted coordinator entries cleared; ALWAYS ack.
+* ``TxAck``: round-matched acks accumulate; all-acked deletes the entry.
+* every 2PC message delivery is a relay-mode Paxos proposal at the
+  receiving group -> decided-count + heard lanes bump on EVERY delivery,
+  duplicates included (paxos.py:349-355), exactly as in the Part-1 twin.
+* ``_reconfig_done`` (query gating) includes empty locks/prepared/coord.
+
+Why the remaining object state collapses (the Part-1 collapse arguments
+plus): vote VALUES are () in every reachable voting state (a
+transaction's keys are written only by its own commit, and re-votes
+after tx_done carry ()); commit WRITES are the workload constants; the
+recorded MultiGet result is the committed constants (a participant can
+only vote yes after the previous tx's decision released its locks, which
+also applied its writes) — so store content, vote payloads, and AMO
+result payloads are all derivable from the lanes below, and the lane
+vector is bijective with the reachable object states.  MULTI_GETS_MATCH
+therefore holds by construction in the twin (its object-side check runs
+in tests/test_lab4_shardstore.py); the tensor predicate provided here
+checks the reply-implies-commit invariant the collapse rests on.
+
+Node lanes (0 = master, 1..2 = group servers, 3 = client):
+  master  [mc, mamo_c, mamo_s1, mamo_s2]
+  server g [scfg, samo, scount, sh, sq, out_flag, out_samo, in_flag,
+            lock, (sp_rnd, sp_ok, sdone) x W]
+    + coordinator block on g1 only: (ct_lrnd, ct_rnd, ct_v1, ct_v2,
+      ct_dec, ct_a1, ct_a2) x W
+  client  [k, cfg, cq]
+
+Message lanes [tag, a, b, c]:
+  QRY/QREP/SSREQ/SSREP/WG/SM/SMACK as in the Part-1 twin, plus
+  TXP [t, rnd, dst_g]      TxPrepare (config_num constantly cfg1's)
+  TXV [t, rnd, 2*from_g + ok]   TxVote -> coordinator
+  TXD [t, rnd, 2*dst_g + commit]  TxDecision
+  TXA [t, rnd, from_g]     TxAck -> coordinator
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from dslabs_tpu.tpu.engine import SENTINEL, TensorProtocol
+
+__all__ = ["make_shardstore_tx_protocol"]
+
+(QRY, QREP, SSREQ, SSREP, WG, SM, SMACK,
+ TXP, TXV, TXD, TXA) = range(11)
+T_CLIENT, T_QUERY, T_ELECTION, T_HEARTBEAT = 1, 2, 3, 4
+
+CLIENT_MS = 100
+QUERY_MS = 50
+ELECTION_MIN, ELECTION_MAX = 150, 300
+HEARTBEAT_MS = 50
+
+G = 2          # two one-server groups; coordinator = group 1
+N_CFG = 2      # cfg0 (everything at g1), cfg1 (the final rebalance)
+
+
+def make_shardstore_tx_protocol(n_tx: int = 1, net_cap: int = 48,
+                                timer_cap: int = 6) -> TensorProtocol:
+    """``n_tx`` sequential client transactions, each spanning both
+    groups (tx t = client seq t)."""
+    W = n_tx
+    MW, TW = 4, 4
+    N_NODES = 1 + G + 1
+    CLIENT = G + 1
+
+    # ---- lane offsets
+    M_MC, M_AMOC, M_AMOS = 0, 1, 2
+    SRV = 2 + G
+    S_CFG, S_AMO, S_CNT, S_H, S_Q, S_OUT, S_OSAMO, S_IN, S_LOCK = range(9)
+    SPW = 3                                  # (sp_rnd, sp_ok, sdone) per tx
+    S_BLK = 9 + SPW * W
+    CT = SRV + S_BLK * G                     # coordinator block (g1)
+    CTW = 7                                  # per-tx coordinator lanes
+    (CT_LRND, CT_RND, CT_V1, CT_V2, CT_DEC, CT_A1, CT_A2) = range(CTW)
+    C_K = CT + CTW * W
+    C_CFG, C_CQ = C_K + 1, C_K + 2
+    NW = C_K + 3
+
+    def srv(g, off):
+        return SRV + S_BLK * (g - 1) + off
+
+    def sp(g, t, off):
+        return SRV + S_BLK * (g - 1) + 9 + SPW * (t - 1) + off
+
+    def ct(t, off):
+        return CT + CTW * (t - 1) + off
+
+    def msg_row(cond, tag, a, b=0, c=0):
+        rec = jnp.stack([jnp.asarray(x, jnp.int32) for x in (tag, a, b, c)])
+        return jnp.where(cond, rec,
+                         jnp.full((MW,), SENTINEL, jnp.int32))[None]
+
+    def timer_row(cond, node, tag, mn, mx, p0):
+        rec = jnp.stack([jnp.asarray(x, jnp.int32)
+                         for x in (node, tag, mn, mx, p0)])
+        return jnp.where(cond, rec,
+                         jnp.full((1 + TW,), SENTINEL, jnp.int32))[None]
+
+    blank_msg = jnp.full((1, MW), SENTINEL, jnp.int32)
+    blank_set = jnp.full((1, 1 + TW), SENTINEL, jnp.int32)
+
+    def served_kind(arg):
+        return jnp.where((arg < 0) | (arg >= N_CFG),
+                         N_CFG - 1, arg).astype(jnp.int32)
+
+    def set_lane(nodes, lane, cond, val):
+        return nodes.at[lane].set(
+            jnp.where(cond, val, nodes[lane]).astype(jnp.int32))
+
+    def bump(nodes, g, cond):
+        """Relay-mode proposal at group g: decided count + heard."""
+        nodes = set_lane(nodes, srv(g, S_CNT), cond,
+                         nodes[srv(g, S_CNT)] + 1)
+        return set_lane(nodes, srv(g, S_H), cond, 1)
+
+    def reconfig_done(nodes, g):
+        """_reconfig_done: handoff drained AND no 2PC state outstanding
+        (shardstore.py:283-287)."""
+        done = ((nodes[srv(g, S_OUT)] == 0) & (nodes[srv(g, S_IN)] == 0)
+                & (nodes[srv(g, S_LOCK)] == 0))
+        for t in range(1, W + 1):
+            done = done & (nodes[sp(g, t, 0)] == 0)
+            if g == 1:
+                done = done & (nodes[ct(t, CT_RND)] == 0)
+        return done
+
+    def one_tx(t, x):
+        """where-chain select of per-tx lane values for traced tx id."""
+        out = jnp.asarray(x(1), jnp.int32)
+        for tt in range(2, W + 1):
+            out = jnp.where(t == tt, x(tt), out)
+        return out
+
+    # ------------------------------------------------------------ handlers
+
+    def step_message(nodes, msg):
+        tag, a, b, c = msg[0], msg[1], msg[2], msg[3]
+        sends = []
+        tsets = []
+
+        # ---- QRY -> master (identical to the Part-1 twin)
+        is_qry = tag == QRY
+        src, seq, arg = a, b, c
+        for sidx in range(0, G + 1):
+            lane = M_AMOC if sidx == 0 else M_AMOS + sidx - 1
+            here = is_qry & (src == sidx)
+            last = nodes[lane]
+            fresh = here & (seq > last)
+            nodes = set_lane(nodes, lane, fresh, seq)
+            nodes = set_lane(nodes, M_MC, fresh, nodes[M_MC] + 1)
+            sends.append(msg_row(here & (seq >= last), QREP, src, seq,
+                                 served_kind(arg)))
+
+        # ---- QREP -> client: adopt the latest config, send pending tx
+        is_qrep_c = (tag == QREP) & (a == 0)
+        k = nodes[C_K]
+        adopt = is_qrep_c & (nodes[C_CFG] == 0)
+        nodes = set_lane(nodes, C_CFG, adopt, 1)
+        sends.append(msg_row(adopt & (k <= W), SSREQ, k))
+
+        # ---- QREP -> server g: install next config when reconfig done
+        for g in range(1, G + 1):
+            here = (tag == QREP) & (a == g)
+            kind = c
+            scfg = nodes[srv(g, S_CFG)]
+            install = (here & (kind == scfg) & (scfg < N_CFG)
+                       & reconfig_done(nodes, g))
+            is_final = install & (scfg == N_CFG - 1)
+            if g == 1:
+                nodes = set_lane(nodes, srv(g, S_OUT), is_final, 1)
+                nodes = set_lane(nodes, srv(g, S_OSAMO), is_final,
+                                 nodes[srv(g, S_AMO)])
+                sends.append(msg_row(is_final, SM, 2,
+                                     nodes[srv(g, S_AMO)]))
+            else:
+                nodes = set_lane(nodes, srv(g, S_IN), is_final, 1)
+            nodes = set_lane(nodes, srv(g, S_CFG), install, scfg + 1)
+            nodes = bump(nodes, g, install)
+
+        # ---- SSREQ -> coordinator g1 (all txs span both groups; the
+        # client routes to the min-shard owner = g1 under every config)
+        is_ss = tag == SSREQ
+        kk = a
+        nodes = bump(nodes, 1, is_ss)
+        scfg1 = nodes[srv(1, S_CFG)]
+        samo1 = nodes[srv(1, S_AMO)]
+        # cfg0: the tx is SINGLE-group (g1 owns everything) -> direct
+        # execution exactly like a Part-1 command (no locks can exist
+        # at cfg0: prepares carry cfg1's number and mismatch).
+        direct = is_ss & (scfg1 == 1)
+        execd = direct & (kk > samo1)
+        nodes = set_lane(nodes, srv(1, S_AMO), execd, kk)
+        sends.append(msg_row(direct & (kk >= samo1), SSREP, kk))
+        # cfg1: _coordinate_tx — cached reply / absorb / new round
+        co = is_ss & (scfg1 == 2)
+        cached = co & (samo1 >= kk)
+        sends.append(msg_row(cached & (kk == samo1), SSREP, kk))
+        in_prog = one_tx(kk, lambda t: nodes[ct(t, CT_RND)]) > 0
+        start = co & ~cached & ~in_prog
+        for t in range(1, W + 1):
+            here_t = start & (kk == t)
+            rnd = nodes[ct(t, CT_LRND)] + 1
+            nodes = set_lane(nodes, ct(t, CT_LRND), here_t, rnd)
+            nodes = set_lane(nodes, ct(t, CT_RND), here_t, rnd)
+            for off in (CT_V1, CT_V2, CT_DEC, CT_A1, CT_A2):
+                nodes = set_lane(nodes, ct(t, off), here_t, 0)
+            sends.append(msg_row(here_t, TXP, t, rnd, 1))
+            sends.append(msg_row(here_t, TXP, t, rnd, 2))
+
+        # ---- SSREP -> client (ClientWorker pumps the next command)
+        is_rep = tag == SSREP
+        k = nodes[C_K]
+        match = is_rep & (a == k) & (k <= W)
+        k2 = jnp.where(match, k + 1, k)
+        nodes = nodes.at[C_K].set(k2.astype(jnp.int32))
+        has_next = match & (k2 <= W)
+        sends.append(msg_row(has_next, SSREQ, k2))
+        tsets.append(timer_row(has_next, CLIENT, T_CLIENT,
+                               CLIENT_MS, CLIENT_MS, k2))
+
+        # ---- WG -> client: re-query (unreachable for tx workloads —
+        # the coordinator always owns the min shard — kept for parity
+        # with the object handler)
+        is_wg = (tag == WG) & (a == nodes[C_K]) & (nodes[C_K] <= W)
+        cq = nodes[C_CQ]
+        nodes = set_lane(nodes, C_CQ, is_wg, cq + 1)
+        sends.append(msg_row(is_wg, QRY, 0, cq + 1, -1))
+
+        # ---- SM / SMACK: the g1 -> g2 handoff (as in the Part-1 twin)
+        is_sm = (tag == SM) & (a == 2)
+        scfg2 = nodes[srv(2, S_CFG)]
+        at_final = scfg2 == N_CFG
+        inst = is_sm & at_final & (nodes[srv(2, S_IN)] == 1)
+        reack = is_sm & at_final & (nodes[srv(2, S_IN)] == 0)
+        nodes = bump(nodes, 2, inst)
+        samo2 = nodes[srv(2, S_AMO)]
+        nodes = set_lane(nodes, srv(2, S_AMO), inst,
+                         jnp.maximum(samo2, b))
+        nodes = set_lane(nodes, srv(2, S_IN), inst, 0)
+        sends.append(msg_row(inst | reack, SMACK, 1))
+        is_ack = (tag == SMACK) & (a == 1)
+        fin = is_ack & (nodes[srv(1, S_OUT)] == 1)
+        nodes = bump(nodes, 1, fin)
+        nodes = set_lane(nodes, srv(1, S_OUT), fin, 0)
+
+        # ---- TXP -> participant dst (shardstore.py _apply_tx_prepare)
+        is_txp = tag == TXP
+        for g in (1, 2):
+            here = is_txp & (c == g)
+            nodes = bump(nodes, g, here)
+            scfg = nodes[srv(g, S_CFG)]
+            for t in range(1, W + 1):
+                h = here & (a == t)
+                rnd = b
+                dn = nodes[sp(g, t, 2)] == 1
+                # tx already done -> yes vote (any config)
+                sends.append(msg_row(h & (scfg >= 1) & dn, TXV, t, rnd,
+                                     2 * g + 1))
+                # config mismatch (participant still at cfg0) -> abort
+                sends.append(msg_row(h & (scfg == 1) & ~dn, TXV, t, rnd,
+                                     2 * g + 0))
+                # config match: prepare/resend/supersede
+                m = h & (scfg == 2) & ~dn
+                prnd = nodes[sp(g, t, 0)]
+                stale = m & (prnd > rnd)
+                supersede = m & (prnd > 0) & (prnd < rnd)
+                # release own locks on supersede
+                lock = nodes[srv(g, S_LOCK)]
+                nodes = set_lane(nodes, srv(g, S_LOCK),
+                                 supersede & (lock == t), 0)
+                fresh = m & ((prnd == 0) | supersede)
+                lock2 = nodes[srv(g, S_LOCK)]
+                conflict = (lock2 != 0) & (lock2 != t)
+                owned = (jnp.asarray(True) if g == 1
+                         else nodes[srv(g, S_IN)] == 0)
+                ok = fresh & ~conflict & owned
+                nodes = set_lane(nodes, srv(g, S_LOCK), ok, t)
+                nodes = set_lane(nodes, sp(g, t, 0), fresh, rnd)
+                nodes = set_lane(nodes, sp(g, t, 1), fresh,
+                                 ok.astype(jnp.int32))
+                # vote with the STORED (round, ok) — fresh or resend
+                vote = m & ~stale
+                sends.append(msg_row(vote, TXV, t, nodes[sp(g, t, 0)],
+                                     2 * g + nodes[sp(g, t, 1)]))
+
+        # ---- TXV -> coordinator g1 (_apply_tx_vote)
+        is_txv = tag == TXV
+        nodes = bump(nodes, 1, is_txv)
+        for t in range(1, W + 1):
+            h = is_txv & (a == t)
+            rnd, fg, okv = b, c // 2, c % 2
+            live = (h & (nodes[ct(t, CT_RND)] == rnd) & (rnd > 0)
+                    & (nodes[ct(t, CT_DEC)] == 0))
+            vval = jnp.where(okv == 1, 1, 2)
+            nodes = set_lane(nodes, ct(t, CT_V1), live & (fg == 1), vval)
+            nodes = set_lane(nodes, ct(t, CT_V2), live & (fg == 2), vval)
+            v1, v2 = nodes[ct(t, CT_V1)], nodes[ct(t, CT_V2)]
+            dec_abort = live & ((v1 == 2) | (v2 == 2))
+            dec_commit = live & (v1 == 1) & (v2 == 1)
+            nodes = set_lane(nodes, ct(t, CT_DEC), dec_abort, 2)
+            nodes = set_lane(nodes, ct(t, CT_DEC), dec_commit, 1)
+            # commit: AMO record + client reply (coordinator side)
+            nodes = set_lane(nodes, srv(1, S_AMO),
+                             dec_commit & (nodes[srv(1, S_AMO)] < t), t)
+            sends.append(msg_row(dec_commit, SSREP, t))
+            decided = dec_abort | dec_commit
+            cbit = dec_commit.astype(jnp.int32)
+            sends.append(msg_row(decided, TXD, t, rnd, 2 * 1 + cbit))
+            sends.append(msg_row(decided, TXD, t, rnd, 2 * 2 + cbit))
+
+        # ---- TXD -> participant dst (_apply_tx_decision)
+        is_txd = tag == TXD
+        for g in (1, 2):
+            here = is_txd & (c // 2 == g)
+            nodes = bump(nodes, g, here)
+            commit = c % 2 == 1
+            for t in range(1, W + 1):
+                h = here & (a == t)
+                rnd = b
+                pmatch = h & (nodes[sp(g, t, 0)] == rnd) & (rnd > 0)
+                apply_w = pmatch & commit & (nodes[sp(g, t, 1)] == 1)
+                nodes = set_lane(nodes, sp(g, t, 2), apply_w, 1)
+                # pop prepared + release own locks (round-matched only)
+                lock = nodes[srv(g, S_LOCK)]
+                nodes = set_lane(nodes, srv(g, S_LOCK),
+                                 pmatch & (lock == t), 0)
+                nodes = set_lane(nodes, sp(g, t, 0), pmatch, 0)
+                nodes = set_lane(nodes, sp(g, t, 1), pmatch, 0)
+                if g == 1:
+                    # aborted coordinator entry cleared (round-matched)
+                    clear = (h & ~commit & (nodes[ct(t, CT_DEC)] == 2)
+                             & (nodes[ct(t, CT_RND)] == rnd))
+                    for off in (CT_RND, CT_V1, CT_V2, CT_DEC, CT_A1,
+                                CT_A2):
+                        nodes = set_lane(nodes, ct(t, off), clear, 0)
+                # always ack when a config exists
+                sends.append(msg_row(h & (nodes[srv(g, S_CFG)] >= 1),
+                                     TXA, t, rnd, g))
+
+        # ---- TXA -> coordinator g1
+        is_txa = tag == TXA
+        nodes = bump(nodes, 1, is_txa)
+        for t in range(1, W + 1):
+            h = is_txa & (a == t)
+            rnd, fg = b, c
+            live = h & (nodes[ct(t, CT_RND)] == rnd) & (rnd > 0)
+            nodes = set_lane(nodes, ct(t, CT_A1), live & (fg == 1), 1)
+            nodes = set_lane(nodes, ct(t, CT_A2), live & (fg == 2), 1)
+            full = (live & (nodes[ct(t, CT_A1)] == 1)
+                    & (nodes[ct(t, CT_A2)] == 1))
+            for off in (CT_RND, CT_V1, CT_V2, CT_DEC, CT_A1, CT_A2):
+                nodes = set_lane(nodes, ct(t, off), full, 0)
+
+        sends = jnp.concatenate(sends + [blank_msg]
+                                * (MAX_SENDS - len(sends)))
+        tsets = jnp.concatenate(tsets + [blank_set]
+                                * (MAX_SETS - len(tsets)))
+        return nodes, sends[:MAX_SENDS], tsets[:MAX_SETS]
+
+    def step_timer(nodes, node_idx, timer):
+        tag, p0 = timer[0], timer[3]
+        sends = []
+        tsets = []
+
+        # ---- ClientTimer: re-query (+1 when no config yet) + resend
+        k = nodes[C_K]
+        live = ((node_idx == CLIENT) & (tag == T_CLIENT) & (p0 == k)
+                & (k <= W))
+        cq = nodes[C_CQ]
+        has_cfg = nodes[C_CFG] == 1
+        cq2 = jnp.where(live, jnp.where(has_cfg, cq + 1, cq + 2), cq)
+        nodes = nodes.at[C_CQ].set(cq2.astype(jnp.int32))
+        sends.append(msg_row(live, QRY, 0, cq + 1, -1))
+        sends.append(jnp.where(has_cfg,
+                               msg_row(live, SSREQ, k)[0],
+                               msg_row(live, QRY, 0, cq + 2, -1)[0])[None])
+        tsets.append(timer_row(live, CLIENT, T_CLIENT,
+                               CLIENT_MS, CLIENT_MS, k))
+
+        for g in range(1, G + 1):
+            here = node_idx == g
+            # ---- QueryTimer: gated on _reconfig_done (which now
+            # includes empty 2PC state); _send_moves always runs
+            is_q = here & (tag == T_QUERY)
+            ask = is_q & reconfig_done(nodes, g)
+            sq = nodes[srv(g, S_Q)]
+            nodes = set_lane(nodes, srv(g, S_Q), ask, sq + 1)
+            sends.append(msg_row(ask, QRY, g, sq + 1,
+                                 nodes[srv(g, S_CFG)]))
+            if g == 1:
+                sends.append(msg_row(is_q & (nodes[srv(1, S_OUT)] == 1),
+                                     SM, 2, nodes[srv(1, S_OSAMO)]))
+            tsets.append(timer_row(is_q, g, T_QUERY, QUERY_MS, QUERY_MS,
+                                   0))
+            # ---- ElectionTimer / HeartbeatTimer (as in Part 1)
+            is_el = here & (tag == T_ELECTION)
+            nodes = set_lane(nodes, srv(g, S_H), is_el, 0)
+            tsets.append(timer_row(is_el, g, T_ELECTION,
+                                   ELECTION_MIN, ELECTION_MAX, 0))
+            is_hb = here & (tag == T_HEARTBEAT)
+            tsets.append(timer_row(is_hb, g, T_HEARTBEAT,
+                                   HEARTBEAT_MS, HEARTBEAT_MS, 0))
+
+        sends = jnp.concatenate(sends + [blank_msg]
+                                * (MAX_SENDS - len(sends)))
+        tsets = jnp.concatenate(tsets + [blank_set]
+                                * (MAX_SETS - len(tsets)))
+        return nodes, sends[:MAX_SENDS], tsets[:MAX_SETS]
+
+    # Row budgets: total appended rows per step function (each row is
+    # condition-masked; the pad below must never truncate a real one).
+    # step_message: (G+1) QREP + client SSREQ + G install rows (1 SM) +
+    # 2 direct/cached SSREP + 2W TXP + pumped SSREQ + WG-requery +
+    # SM/SMACK rows (2) + TXP votes (2 per (g,t) x ... ) etc.
+    MAX_SENDS = ((G + 1) + 1 + 1 + 2 + 2 * W + 1 + 1 + 2
+                 + 2 * (3 * W)          # TXP: 3 vote rows per (g, t)
+                 + W * 3                # TXV: reply + 2 decisions
+                 + 2 * W                # TXD: ack per (g, t)
+                 )
+    MAX_SETS = 1 + 3 * G
+    MAX_LIVE_SENDS = 6   # worst: a TXV commit (reply + 2 TXDs) + slack
+
+    # ------------------------------------------------------------ initials
+
+    def init_nodes():
+        nodes = np.zeros((NW,), np.int32)
+        nodes[M_MC] = G
+        nodes[C_K] = 1
+        nodes[C_CQ] = 2
+        return nodes
+
+    def init_messages():
+        return np.array([[QRY, 0, 1, -1], [QRY, 0, 2, -1]], np.int32)
+
+    def init_timers():
+        rows = []
+        for g in range(1, G + 1):
+            rows.append([g, T_ELECTION, ELECTION_MIN, ELECTION_MAX, 0])
+            rows.append([g, T_HEARTBEAT, HEARTBEAT_MS, HEARTBEAT_MS, 0])
+            rows.append([g, T_QUERY, QUERY_MS, QUERY_MS, 0])
+        rows.append([CLIENT, T_CLIENT, CLIENT_MS, CLIENT_MS, 1])
+        return np.array(rows, np.int32)
+
+    def msg_dest(msg):
+        tag, a, c = msg[0], msg[1], msg[3]
+        dest = jnp.asarray(0, jnp.int32)                 # QRY -> master
+        dest = jnp.where(tag == QREP,
+                         jnp.where(a == 0, CLIENT, a), dest)
+        dest = jnp.where(tag == SSREQ, 1, dest)          # coordinator
+        dest = jnp.where((tag == SSREP) | (tag == WG), CLIENT, dest)
+        dest = jnp.where((tag == SM) | (tag == SMACK), a, dest)
+        dest = jnp.where(tag == TXP, c, dest)
+        dest = jnp.where((tag == TXV) | (tag == TXA), 1, dest)
+        dest = jnp.where(tag == TXD, c // 2, dest)
+        return dest
+
+    def clients_done(state):
+        return state["nodes"][C_K] == W + 1
+
+    def multi_gets_match(state):
+        """The collapse invariant MULTI_GETS_MATCH rests on: a client
+        that received tx t's reply implies the coordinator recorded its
+        commit (so the reply content was the committed constants)."""
+        ok = jnp.asarray(True)
+        for t in range(1, W + 1):
+            replied = state["nodes"][C_K] > t
+            committed = state["nodes"][srv(1, S_AMO)] >= t
+            ok = ok & (~replied | committed)
+        return ok
+
+    return TensorProtocol(
+        name=f"shardstore-tx-g{G}-w{W}",
+        n_nodes=N_NODES,
+        node_width=NW,
+        msg_width=MW,
+        timer_width=TW,
+        net_cap=net_cap,
+        timer_cap=timer_cap,
+        max_sends=MAX_SENDS,
+        max_sets=MAX_SETS,
+        max_live_sends=MAX_LIVE_SENDS,
+        init_nodes=init_nodes,
+        init_messages=init_messages,
+        init_timers=init_timers,
+        step_message=step_message,
+        step_timer=step_timer,
+        msg_dest=msg_dest,
+        invariants={"MULTI_GETS_MATCH": multi_gets_match},
+        goals={"CLIENTS_DONE": clients_done},
+    )

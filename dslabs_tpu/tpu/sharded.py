@@ -106,7 +106,18 @@ class ShardedTensorSearch(TensorSearch):
                  max_secs: Optional[float] = None,
                  strict: bool = True,
                  ev_budget: Optional[int] = None,
-                 record_trace: bool = False):
+                 record_trace: bool = False,
+                 checkpoint_path: Optional[str] = None,
+                 checkpoint_every: int = 0):
+        # Frontier checkpointing (SURVEY §5 "dump SoA tensors"): every
+        # ``checkpoint_every`` levels the whole carry (frontier shards,
+        # visited table, counters) is dumped to ``checkpoint_path`` as a
+        # host .npz (atomic rename), and ``run(resume=True)`` continues a
+        # killed search from the last dump with identical final verdict
+        # and unique count.  0 = off (the dump is a full device->host
+        # readback — seconds at bench scale, so it is opt-in).
+        self.checkpoint_path = checkpoint_path
+        self.checkpoint_every = checkpoint_every
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
         self.n_devices = int(mesh.devices.size)
@@ -634,12 +645,73 @@ class ShardedTensorSearch(TensorSearch):
                 goal_state=st, predicate_name=pname, trace=trace)
         return None
 
+    # ------------------------------------------------------- checkpointing
+
+    def _save_checkpoint(self, carry, depth: int, elapsed: float) -> None:
+        """Dump the carry + loop counters to ``checkpoint_path`` (atomic
+        rename; SURVEY §5: frontier checkpointing is 'cheap: dump SoA
+        tensors')."""
+        host = {f"carry_{k}": np.asarray(v) for k, v in carry.items()}
+        host["depth"] = np.int64(depth)
+        host["elapsed"] = np.float64(elapsed)
+        host["config"] = np.bytes_(self._ckpt_signature())
+        if self.record_trace and self._fp_map:
+            items = [(k + v[0] + (v[1],)) for k, v in self._fp_map.items()]
+            host["fp_map"] = np.asarray(items, dtype=np.int64)
+        tmp = self.checkpoint_path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **host)
+        os.replace(tmp, self.checkpoint_path)
+
+    def _ckpt_signature(self) -> str:
+        return repr((self.p.name, self.f_cap, self.v_cap, self.cpd,
+                     self.n_devices, self._ev_msg, self._ev_tmr,
+                     self.strict, self.record_trace))
+
+    def has_resumable_checkpoint(self) -> bool:
+        """Existence + config-signature check WITHOUT loading the carry
+        (the full load device_puts hundreds of MB; callers that only
+        need a boolean must not pay that twice)."""
+        if (not self.checkpoint_path
+                or not os.path.exists(self.checkpoint_path)):
+            return False
+        try:
+            with np.load(self.checkpoint_path) as z:
+                return ("config" in z.files and
+                        z["config"].item().decode()
+                        == self._ckpt_signature())
+        except Exception:
+            return False
+
+    def _load_checkpoint(self):
+        """-> (carry on device, depth, elapsed) or None (no dump, or a
+        dump from a DIFFERENT configuration — never resumed silently)."""
+        if (not self.checkpoint_path
+                or not os.path.exists(self.checkpoint_path)):
+            return None
+        z = np.load(self.checkpoint_path)
+        if ("config" not in z.files
+                or z["config"].item().decode() != self._ckpt_signature()):
+            return None
+        shard = NamedSharding(self.mesh, P(self.axis))
+        carry = {k[len("carry_"):]: jax.device_put(z[k], shard)
+                 for k in z.files if k.startswith("carry_")}
+        if "fp_map" in z.files:
+            rows = z["fp_map"]
+            self._fp_map = {tuple(r[:4]): (tuple(r[4:8]), int(r[8]))
+                            for r in rows.tolist()}
+        return carry, int(z["depth"]), float(z["elapsed"])
+
     def run(self, check_initial: bool = True,
-            initial: Optional[dict] = None) -> SearchOutcome:
+            initial: Optional[dict] = None,
+            resume: bool = False) -> SearchOutcome:
         """Run the sharded BFS.  ``initial`` (a batch-1 state pytree,
         e.g. a prior outcome's ``goal_state``) starts from an arbitrary
         state — the staged-search pattern (PaxosTest.java:886-1096),
-        same contract as the single-device engine."""
+        same contract as the single-device engine.  ``resume=True``
+        continues from ``checkpoint_path`` if a dump exists (a killed
+        search restarts at its last checkpointed level with identical
+        final verdict and unique count)."""
         t0 = time.time()
         state = (jax.tree.map(jnp.asarray, initial) if initial is not None
                  else self.initial_state())
@@ -654,9 +726,22 @@ class ShardedTensorSearch(TensorSearch):
                 return out
 
         with self.mesh:
-            carry = self._init_carry(state)
-            depth = 0
-            max_n = 1
+            resumed = self._load_checkpoint() if resume else None
+            if resumed is not None:
+                carry, depth, prev_elapsed = resumed
+                t0 = time.time() - prev_elapsed
+                max_n = int(np.asarray(carry["cur_n"]).max())
+                # Pre-loop totals: a checkpoint saved after the FINAL
+                # level has an empty frontier, so the while body (which
+                # normally binds these) never runs.
+                explored = int(np.asarray(carry["explored"]).sum())
+                vis_total = int(np.asarray(carry["vis_n"]).sum())
+                drops = int(np.asarray(carry["drops"]).sum())
+            else:
+                carry = self._init_carry(state)
+                depth = 0
+                max_n = 1
+                explored, vis_total, drops = 0, 1, 0   # the root state
             while max_n > 0:
                 if self.max_depth is not None and depth >= self.max_depth:
                     return self._limit_outcome("DEPTH_EXHAUSTED", carry,
@@ -711,6 +796,9 @@ class ShardedTensorSearch(TensorSearch):
                 if self.record_trace:
                     self._spill_tmeta(carry)
                 carry = self._finish_level(carry)
+                if (self.checkpoint_every and self.checkpoint_path
+                        and depth % self.checkpoint_every == 0):
+                    self._save_checkpoint(carry, depth, time.time() - t0)
 
             return SearchOutcome(
                 "SPACE_EXHAUSTED", explored, vis_total, depth,

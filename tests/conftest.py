@@ -16,3 +16,24 @@ xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The env var alone is NOT enough on machines with the axon TPU plugin:
+# its site hook re-pins jax_platforms to "axon,cpu" at interpreter start
+# (AFTER the env is read), so default jits land on the real TPU even
+# though jax.devices("cpu") shows the virtual mesh — measured round 3:
+# the whole "CPU" suite was silently compiling on (and contending for)
+# the accelerator.  Re-pin through the config, which wins over the
+# plugin because conftest runs after site initialisation.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except Exception:
+    pass  # older jax: XLA_FLAGS above already sets the count
+
+# Persistent compile cache: XLA compiles dominate the suite's wall time
+# (measured: 20 min cold, most of it building the same tensor-engine
+# programs every run); cached re-runs skip straight to execution.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache-cpu")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -81,3 +81,74 @@ def test_lab4_goal_parity():
     ten = TensorSearch(make_shardstore_protocol([1, 1]), chunk=1024,
                        frontier_cap=1 << 18, max_depth=11).run()
     assert ten.end_condition == "GOAL_FOUND"   # depth 10, ~22k unique
+
+
+# ----------------------------------------------------- Part 2: 2PC twin
+
+def _object_tx_joined(max_levels, n_tx=1):
+    """Object oracle for the Part-2 shape: 2 one-server groups joined,
+    client workload of cross-group transactions (test09's configuration
+    with the tx spanning shards 1 and 6 of the 10-shard rebalance)."""
+    from dslabs_tpu.labs.shardedstore.txkvstore import (MultiGet,
+                                                       MultiGetResult,
+                                                       MultiPut,
+                                                       MultiPutOk)
+    from dslabs_tpu.testing.workload import Workload
+
+    cmds = [MultiPut({"key-1": "v", "key-6": "v"})]
+    results = [MultiPutOk()]
+    if n_tx > 1:
+        cmds.append(MultiGet({"key-1", "key-6"}))
+        results.append(MultiGetResult({"key-1": "v", "key-6": "v"}))
+    state = lab4.make_search(2, 1, 1, 10)
+    joined = lab4._joined_state(state, 2)
+    joined.add_client_worker(LocalAddress("client1"),
+                             Workload(commands=cmds, results=results))
+    settings = SearchSettings().max_time(600)
+    settings.add_invariant(RESULTS_OK)
+    settings.node_active(lab4.CCA, False)
+    settings.deliver_timers(lab4.CCA, False)
+    settings.deliver_timers(lab4.shard_master(1), False)
+    settings.set_max_depth(joined.depth + max_levels)
+    return BFS(settings).run(joined)
+
+
+def test_lab4_tx_depth_parity():
+    """Cross-group 2PC twin parity (MultiPut spanning both groups —
+    the flagship lab4 semantics on the tensor backend)."""
+    from dslabs_tpu.tpu.protocols.shardstore_tx import \
+        make_shardstore_tx_protocol
+
+    obj = _object_tx_joined(3)
+    ten = TensorSearch(make_shardstore_tx_protocol(n_tx=1), chunk=256,
+                       max_depth=3).run()
+    assert ten.unique_states == obj.discovered_count, (
+        f"tensor {ten.unique_states} != object {obj.discovered_count}")
+
+
+@SLOW
+def test_lab4_tx_deep_parity():
+    """Depths 4-5 (slow: the object oracle expands thousands of 2PC
+    interleavings)."""
+    from dslabs_tpu.tpu.protocols.shardstore_tx import \
+        make_shardstore_tx_protocol
+
+    for d in (4, 5):
+        obj = _object_tx_joined(d)
+        ten = TensorSearch(make_shardstore_tx_protocol(n_tx=1),
+                           chunk=512, max_depth=d).run()
+        assert ten.unique_states == obj.discovered_count, (
+            f"depth {d}: tensor {ten.unique_states} != "
+            f"object {obj.discovered_count}")
+
+
+@SLOW
+def test_lab4_tx_goal_and_invariant():
+    """The 2PC twin completes the transaction (CLIENTS_DONE reached)
+    with MULTI_GETS_MATCH clean along the way."""
+    from dslabs_tpu.tpu.protocols.shardstore_tx import \
+        make_shardstore_tx_protocol
+
+    ten = TensorSearch(make_shardstore_tx_protocol(n_tx=1), chunk=1024,
+                       frontier_cap=1 << 18, max_depth=14).run()
+    assert ten.end_condition == "GOAL_FOUND"

@@ -105,3 +105,57 @@ def test_sharded_violation_trace_replays_on_object_twin():
     r = never_done.check(end)
     assert not r.value, "replayed end state must violate NEVER_DONE"
     assert end.depth <= len(outcome.trace)
+
+
+def test_checkpoint_resume_identical_outcome(tmp_path):
+    """Kill-and-resume semantics (SURVEY §5 frontier checkpointing): a
+    search checkpointed every level, interrupted, then resumed from the
+    dump must reach the identical verdict, unique count, and explored
+    count as an uninterrupted run."""
+    proto = _pruned_pingpong()
+    mesh = make_mesh(8)
+    full = ShardedTensorSearch(
+        proto, mesh, chunk_per_device=16, frontier_cap=1 << 8,
+        visited_cap=1 << 10).run()
+    assert full.end_condition == "SPACE_EXHAUSTED"
+
+    ckpt = str(tmp_path / "search.npz")
+    # "Crash" after 2 levels: only the checkpoint file survives.
+    interrupted = ShardedTensorSearch(
+        proto, mesh, chunk_per_device=16, frontier_cap=1 << 8,
+        visited_cap=1 << 10, max_depth=2,
+        checkpoint_path=ckpt, checkpoint_every=1)
+    out = interrupted.run()
+    assert out.end_condition == "DEPTH_EXHAUSTED"
+    import os
+    assert os.path.exists(ckpt)
+
+    resumed = ShardedTensorSearch(
+        proto, mesh, chunk_per_device=16, frontier_cap=1 << 8,
+        visited_cap=1 << 10, checkpoint_path=ckpt)
+    r = resumed.run(resume=True)
+    assert r.end_condition == full.end_condition
+    assert r.unique_states == full.unique_states
+    assert r.states_explored == full.states_explored
+
+    # A dump from a DIFFERENT config is never resumed silently.
+    other = ShardedTensorSearch(
+        proto, mesh, chunk_per_device=32, frontier_cap=1 << 8,
+        visited_cap=1 << 10, checkpoint_path=ckpt)
+    assert other._load_checkpoint() is None
+    assert not other.has_resumable_checkpoint()
+
+    # Resuming a checkpoint saved AFTER the final level (empty frontier)
+    # returns the finished verdict instead of crashing.
+    done_ckpt = str(tmp_path / "done.npz")
+    finished = ShardedTensorSearch(
+        proto, mesh, chunk_per_device=16, frontier_cap=1 << 8,
+        visited_cap=1 << 10, checkpoint_path=done_ckpt,
+        checkpoint_every=1)
+    f1 = finished.run()
+    assert f1.end_condition == "SPACE_EXHAUSTED"
+    f2 = ShardedTensorSearch(
+        proto, mesh, chunk_per_device=16, frontier_cap=1 << 8,
+        visited_cap=1 << 10, checkpoint_path=done_ckpt).run(resume=True)
+    assert f2.end_condition == "SPACE_EXHAUSTED"
+    assert f2.unique_states == f1.unique_states
