@@ -402,6 +402,104 @@ def insert_messages(net: jnp.ndarray,
     return out, overflow
 
 
+def compact_rows_batched(rowsT: jnp.ndarray,
+                         budget: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Batched, TRANSPOSED :func:`compact_rows`: ``rowsT`` is
+    [R, W, P] (pairs on the MINOR axis — full 128-lane VPU utilisation;
+    the per-pair vmapped form left 7/8 of every vector op idle, the
+    round-3 measured pathology) -> ([budget, W, P], overflow [P])."""
+    r, w, pp = rowsT.shape
+    occ = rowsT[:, 0, :] != SENTINEL                 # [R, P]
+    pos = jnp.cumsum(occ, axis=0) - 1                # [R, P]
+    outs = []
+    hits = []
+    for b in range(budget):
+        hit = occ & (pos == b)                       # [R, P]
+        outs.append(jnp.sum(jnp.where(hit[:, None, :], rowsT, 0), axis=0))
+        hits.append(jnp.any(hit, axis=0))
+    out = jnp.stack(outs)                            # [budget, W, P]
+    has = jnp.stack(hits)                            # [budget, P]
+    out = jnp.where(has[:, None, :], out, SENTINEL)
+    overflow = jnp.sum(occ & (pos >= budget), axis=0).astype(jnp.int32)
+    return out, overflow
+
+
+def insert_messages_batched(netT: jnp.ndarray, sendsT: jnp.ndarray
+                            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Batched, TRANSPOSED :func:`insert_messages`: ``netT`` [CAP, MW, P]
+    (canonical per pair), ``sendsT`` [S, MW, P] (compacted) ->
+    (merged [CAP, MW, P], overflow [P]).
+
+    Same math as the per-pair form — lexicographic ranks, S+1 static
+    shifted slices for net placement, one-hot send placement — but every
+    op is [CAP, P] or [S, S, P] with pairs riding the minor (lane) axis.
+    Measured on the v5e: the per-pair form's [S, CAP, MW]-shaped compare
+    ran ~30x slower than this layout purely from lane waste (MW = 8 of
+    128 lanes)."""
+    cap, mw, pp = netT.shape
+    s = sendsT.shape[0]
+    net_occ = netT[:, 0, :] != SENTINEL              # [CAP, P]
+    send_occ = sendsT[:, 0, :] != SENTINEL           # [S, P]
+
+    # send_i vs net_j lexicographic, one send at a time: [CAP, P] lanes.
+    sn_less_l, sn_eq_l = [], []
+    for si in range(s):
+        lt = jnp.zeros((cap, pp), bool)
+        eqp = jnp.ones((cap, pp), bool)
+        for l in range(mw):
+            nv = netT[:, l, :]
+            sv = sendsT[si, l, :][None, :]
+            lt = lt | (eqp & (sv < nv))
+            eqp = eqp & (sv == nv)
+        sn_less_l.append(lt)
+        sn_eq_l.append(eqp)
+    sn_less = jnp.stack(sn_less_l)                   # [S, CAP, P]
+    sn_eq = jnp.stack(sn_eq_l)
+    dup_net = jnp.any(sn_eq & net_occ[None], axis=1)  # [S, P]
+
+    # send_i vs send_j lexicographic: [S, S, P].
+    lt = jnp.zeros((s, s, pp), bool)
+    eqp = jnp.ones((s, s, pp), bool)
+    for l in range(mw):
+        a = sendsT[:, None, l, :]
+        b = sendsT[None, :, l, :]
+        lt = lt | (eqp & (a < b))
+        eqp = eqp & (a == b)
+    ss_less, ss_eq = lt, eqp
+    earlier = jnp.tril(jnp.ones((s, s), bool), k=-1)[:, :, None]
+    earlier_dup = jnp.any(ss_eq & earlier & send_occ[None, :, :], axis=1)
+    valid = send_occ & ~dup_net & ~earlier_dup       # [S, P]
+
+    net_below = jnp.sum(~sn_less & ~sn_eq & net_occ[None], axis=1)
+    sends_below = jnp.sum(
+        (jnp.swapaxes(ss_less, 0, 1) | (ss_eq & earlier))
+        & valid[None, :, :], axis=1)
+    dst_send = net_below + sends_below               # [S, P]
+    shift = jnp.sum(sn_less & valid[:, None, :], axis=0)   # [CAP, P]
+
+    pad_rows = jnp.full((s, mw, pp), SENTINEL, netT.dtype)
+    pnet = jnp.concatenate([pad_rows, netT])         # [S+CAP, MW, P]
+    pshift = jnp.concatenate([jnp.full((s, pp), -1, shift.dtype), shift])
+    pocc = jnp.concatenate([jnp.zeros((s, pp), bool), net_occ])
+    out = jnp.zeros((cap, mw, pp), netT.dtype)
+    any_hit = jnp.zeros((cap, pp), bool)
+    for c in range(s + 1):
+        lo = s - c
+        hit = (pshift[lo:lo + cap] == c) & pocc[lo:lo + cap]   # [CAP, P]
+        out = out + jnp.where(hit[:, None, :], pnet[lo:lo + cap], 0)
+        any_hit = any_hit | hit
+    k = jnp.arange(cap)[:, None]
+    for si in range(s):
+        hit = valid[si][None, :] & (dst_send[si][None, :] == k)
+        out = out + jnp.where(hit[:, None, :], sendsT[si][None], 0)
+        any_hit = any_hit | hit
+    out = jnp.where(any_hit[:, None, :], out, SENTINEL)
+    total = (jnp.sum(net_occ, axis=0) + jnp.sum(valid, axis=0)
+             ).astype(jnp.int32)
+    overflow = jnp.maximum(total - cap, 0).astype(jnp.int32)
+    return out, overflow
+
+
 def timer_deliverable_mask(queue: jnp.ndarray) -> jnp.ndarray:
     """[T_CAP, TW] -> [T_CAP] bool: the TimerQueue partial order
     (TimerQueue.java:66-105).  Lane 1 = min, lane 2 = max; empty rows are
@@ -605,39 +703,12 @@ class TensorSearch:
         event grid."""
         return self._ev_slots
 
-    def _finish_step(self, net, timers, nodes2, sends, new_t, exc, valid):
-        """Common tail of both step kinds: send compaction, network
-        set-insert, timer appends, overflow accounting.  Emits the
-        successor as ONE flat [lanes] row — the single materialisation
-        of the successor state."""
-        p = self.p
-        send_over = jnp.int32(0)
-        if (p.max_live_sends is not None
-                and p.max_live_sends < p.max_sends):
-            # max_sends is the sum over mutually exclusive branches; the
-            # live rows are far fewer.  Compacting here shrinks the
-            # O(S x CAP) merge below; overflow is semantic (a dropped send
-            # corrupts the successor) and stays fatal.
-            sends, send_over = compact_rows(sends, p.max_live_sends)
-        net2, net_over = insert_messages(net, sends)
-        timers2, t_over = append_timers(timers, new_t)
-        over = (net_over + t_over + send_over) * valid.astype(jnp.int32)
-        row = jnp.concatenate([
-            nodes2.astype(jnp.int32), net2.reshape(-1),
-            timers2.reshape(-1),
-            jnp.asarray(exc, jnp.int32).reshape(1)])
-        return row, valid, over
-        # An exception-state successor is frozen at the throwing
-        # transition: sends/new timers from the faulting handler are
-        # still applied (the reference captures the throwable after the
-        # hooks ran, SearchState.java:218-222), but the state is terminal
-        # (run() ends).
-
-    def _msg_step(self, row: jnp.ndarray, net_slot: jnp.ndarray):
-        """Expand ONE state row by delivering the message in network slot
-        ``net_slot`` -> (successor row, valid, over).  All event picks
-        are one-hot 0/1 sums — static indexing only (per-pair dynamic
-        gathers materialise at ~1 GB/s under the flat vmap on TPU)."""
+    def _msg_step_raw(self, row: jnp.ndarray, net_slot: jnp.ndarray):
+        """Handler half of a message step (no network merge): ONE state
+        row + net slot -> (nodes', sends, timers', exc, ok, t_over).
+        All event picks are one-hot 0/1 sums — static indexing only
+        (per-pair dynamic gathers materialise at ~1 GB/s under the flat
+        vmap on TPU)."""
         p = self.p
         s = self._slice_state(row)
         nodes, net, timers = s["nodes"], s["net"], s["timers"]
@@ -648,13 +719,12 @@ class TensorSearch:
             ok = ok & p.deliver_message(msg)
         nodes2, sends, new_t, exc = _normalize_step(
             p.step_message(nodes, msg))
-        return self._finish_step(net, timers, nodes2, sends, new_t, exc,
-                                 ok)
+        timers2, t_over = append_timers(timers, new_t)
+        return nodes2, sends, timers2, exc, ok, t_over
 
-    def _tmr_step(self, row: jnp.ndarray, t_idx: jnp.ndarray):
-        """Expand ONE state row by firing timer grid index ``t_idx``
-        (= node * timer_cap + queue slot) -> (successor row, valid,
-        over)."""
+    def _tmr_step_raw(self, row: jnp.ndarray, t_idx: jnp.ndarray):
+        """Handler half of a timer step (no network merge): timer grid
+        index t_idx = node * timer_cap + queue slot."""
         p = self.p
         s = self._slice_state(row)
         nodes, net, timers = s["nodes"], s["net"], s["timers"]
@@ -672,9 +742,81 @@ class TensorSearch:
         # Firing consumes the timer (SearchState.java:357); the updated
         # queue lands via the node one-hot, never a dynamic scatter.
         fired_q = remove_timer(queue, t_slot)
-        timers2 = jnp.where(n_oh[:, None, None], fired_q[None], timers)
-        return self._finish_step(net, timers2, nodes2, sends, new_t, exc,
-                                 ok)
+        timers1 = jnp.where(n_oh[:, None, None], fired_q[None], timers)
+        timers2, t_over = append_timers(timers1, new_t)
+        return nodes2, sends, timers2, exc, ok, t_over
+
+    def _finish_row(self, net, nodes2, sends, timers2, exc, ok, t_over):
+        """Per-pair merge tail (the batched expand uses the TRANSPOSED
+        tail in _batched_tail; this form remains for _step_one)."""
+        p = self.p
+        send_over = jnp.int32(0)
+        if (p.max_live_sends is not None
+                and p.max_live_sends < p.max_sends):
+            sends, send_over = compact_rows(sends, p.max_live_sends)
+        net2, net_over = insert_messages(net, sends)
+        over = (net_over + t_over + send_over) * ok.astype(jnp.int32)
+        row = jnp.concatenate([
+            nodes2.astype(jnp.int32), net2.reshape(-1),
+            timers2.reshape(-1),
+            jnp.asarray(exc, jnp.int32).reshape(1)])
+        return row, ok, over
+        # An exception-state successor is frozen at the throwing
+        # transition: sends/new timers from the faulting handler are
+        # still applied (the reference captures the throwable after the
+        # hooks ran, SearchState.java:218-222), but the state is terminal
+        # (run() ends).
+
+    def _msg_step(self, row: jnp.ndarray, net_slot: jnp.ndarray):
+        """ONE state row x message slot -> (successor row, valid, over)."""
+        s = self._slice_state(row)
+        nodes2, sends, timers2, exc, ok, t_over = self._msg_step_raw(
+            row, net_slot)
+        return self._finish_row(s["net"], nodes2, sends, timers2, exc,
+                                ok, t_over)
+
+    def _tmr_step(self, row: jnp.ndarray, t_idx: jnp.ndarray):
+        """ONE state row x timer grid index -> (successor row, valid,
+        over)."""
+        s = self._slice_state(row)
+        nodes2, sends, timers2, exc, ok, t_over = self._tmr_step_raw(
+            row, t_idx)
+        return self._finish_row(s["net"], nodes2, sends, timers2, exc,
+                                ok, t_over)
+
+    def _batched_tail(self, chunk_rows, c, b, nodes2, sendsP, timersP,
+                      excP, okP, toverP):
+        """Batched TRANSPOSED merge tail: pairs ride the minor axis so
+        the set-insert's compare/select ops use all 128 VPU lanes (the
+        vmapped per-pair tail used MW = 8 of them — measured ~30x slower
+        on the v5e).  The parent network is broadcast from the CHUNK
+        rows ([CAP, MW, C] -> [CAP, MW, C*B]) instead of being
+        materialised per pair."""
+        p = self.p
+        pp = c * b
+        live = (p.max_live_sends
+                if (p.max_live_sends is not None
+                    and p.max_live_sends < p.max_sends) else None)
+        sendsT = jnp.transpose(sendsP, (1, 2, 0))        # [S, MW, P]
+        send_over = jnp.zeros((pp,), jnp.int32)
+        if live:
+            sendsT, send_over = compact_rows_batched(sendsT, live)
+        o0, o1, _ = self._off
+        net_rows = chunk_rows[:, o0:o1].reshape(c, p.net_cap,
+                                                p.msg_width)
+        netT = jnp.transpose(net_rows, (1, 2, 0))        # [CAP, MW, C]
+        netT = jnp.broadcast_to(
+            netT[:, :, :, None],
+            (p.net_cap, p.msg_width, c, b)).reshape(
+            p.net_cap, p.msg_width, pp)
+        outT, net_over = insert_messages_batched(netT, sendsT)
+        net_flat = jnp.transpose(outT, (2, 0, 1)).reshape(pp, -1)
+        rows = jnp.concatenate([
+            nodes2.astype(jnp.int32), net_flat,
+            timersP.reshape(pp, -1),
+            excP.astype(jnp.int32).reshape(pp, 1)], axis=1)
+        over = (net_over + send_over + toverP) * okP.astype(jnp.int32)
+        return rows, over
 
     def _step_one(self, row: jnp.ndarray, event_idx: jnp.ndarray):
         """Expand ONE state row by ONE grid event id -> (successor row,
@@ -754,14 +896,24 @@ class TensorSearch:
         # two-batch-dim scatter path on TPU (~100x); flattening keeps
         # every scatter on the fast single-batch-dim lowering.  The
         # per-state repeat is a broadcast (XLA fuses it into the reads).
+        # Only the HANDLER half is vmapped; the network merge runs as
+        # ONE batched transposed program per kind (_batched_tail).
         rep_m = jnp.repeat(chunk_state, bm, axis=0)
-        rows_m, val_m, over_m = jax.vmap(self._msg_step)(
+        (nodes_m, sends_m, timers_m, exc_m, ok_m,
+         tover_m) = jax.vmap(self._msg_step_raw)(
             rep_m, jnp.maximum(msg_ids, 0).reshape(-1))
-        val_m = val_m & (msg_ids >= 0).reshape(-1)
+        rows_m, over_m = self._batched_tail(
+            chunk_state, c, bm, nodes_m, sends_m, timers_m, exc_m, ok_m,
+            tover_m)
+        val_m = ok_m & (msg_ids >= 0).reshape(-1)
         rep_t = jnp.repeat(chunk_state, bt, axis=0)
-        rows_t, val_t, over_t = jax.vmap(self._tmr_step)(
+        (nodes_t, sends_t, timers_t, exc_t, ok_t,
+         tover_t) = jax.vmap(self._tmr_step_raw)(
             rep_t, jnp.maximum(tmr_ids, 0).reshape(-1))
-        val_t = val_t & (tmr_ids >= 0).reshape(-1)
+        rows_t, over_t = self._batched_tail(
+            chunk_state, c, bt, nodes_t, sends_t, timers_t, exc_t, ok_t,
+            tover_t)
+        val_t = ok_t & (tmr_ids >= 0).reshape(-1)
 
         def _inter(a, b):
             return jnp.concatenate(

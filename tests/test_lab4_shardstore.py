@@ -480,7 +480,7 @@ def _joined_state(state, n_groups, servers_per_group=1,
                                           results=[Ok()] * len(cmds)))
 
     masters = [shard_master(i) for i in range(1, num_shard_masters + 1)]
-    settings = SearchSettings().max_time(120)
+    settings = SearchSettings().max_time(420)
     settings.add_invariant(RESULTS_OK)
     settings.partition(CCA, *masters)
     # Store servers are cut off anyway; their timers only add noise.

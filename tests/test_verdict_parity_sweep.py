@@ -1,0 +1,228 @@
+"""Verdict-parity sweep (SURVEY §8.2 step 6): ONE registry enumerating a
+search configuration per tensor twin x verdict class, each run on BOTH
+backends — the object-graph checker (the oracle) and the TPU tensor
+engine — with end conditions diffed, not hand-picked pairwise tests.
+
+Every entry returns (object EndCondition, object discovered count) and
+(tensor end_condition, tensor unique count); the sweep asserts the
+verdicts agree under the shared mapping and, for exhaustion/depth-limit
+entries (order-independent), that the state counts match exactly.
+
+The per-lab parity tests (test_tpu_engine / test_tpu_lab4 /
+test_tpu_sharded) probe these pairings more deeply; this file is the
+breadth guarantee the round-2 verdict asked for: every search-capable
+twin's verdict is diffed in CI, in one place.
+"""
+
+import dataclasses
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from dslabs_tpu.search.results import EndCondition
+from dslabs_tpu.tpu.engine import TensorSearch
+
+# Object EndCondition <-> tensor end-condition string.  The object
+# checker treats the depth limit as a prune (Search.java:222-229), so a
+# depth-limited object run ends SPACE_EXHAUSTED where the tensor engine
+# reports DEPTH_EXHAUSTED — both map to "exhausted".
+VERDICT = {
+    EndCondition.GOAL_FOUND: "GOAL_FOUND",
+    EndCondition.SPACE_EXHAUSTED: "SPACE_EXHAUSTED",
+    EndCondition.INVARIANT_VIOLATED: "INVARIANT_VIOLATED",
+    EndCondition.EXCEPTION_THROWN: "EXCEPTION_THROWN",
+}
+
+
+def _never_done(p):
+    """Invariant that must be violated once the workload completes —
+    turns any goal-reaching twin config into an INVARIANT_VIOLATED
+    probe."""
+    done = p.goals["CLIENTS_DONE"]
+    return dataclasses.replace(
+        p, goals={}, invariants={**p.invariants,
+                                 "NEVER_DONE": lambda s, f=done: ~f(s)})
+
+
+# ---- registry: name -> (object_runner, tensor_runner, count_exact)
+# object_runner() -> SearchResults; tensor_runner() -> SearchOutcome.
+
+
+def _pingpong_goal():
+    import tests.test_tpu_engine as te
+    return te.object_search(2), te.tensor_search(2), False
+
+
+def _pingpong_exhaust():
+    import tests.test_tpu_engine as te
+    return (te.object_search(2, prune_done=True),
+            te.tensor_search(2, prune_done=True), True)
+
+
+def _pingpong_violation():
+    import tests.test_tpu_engine as te
+    from dslabs_tpu.search.search import bfs
+    from dslabs_tpu.search.search_state import SearchState
+    from dslabs_tpu.search.settings import SearchSettings
+    from dslabs_tpu.testing.predicates import CLIENTS_DONE
+    from dslabs_tpu.tpu.protocols.pingpong import make_pingpong_protocol
+
+    # Object side rebuilt with the NEVER_DONE invariant.
+    from dslabs_tpu.core.address import LocalAddress
+    from dslabs_tpu.labs.pingpong.pingpong import (Ping, PingClient,
+                                                   PingServer, Pong)
+    from dslabs_tpu.testing.generator import NodeGenerator
+    from dslabs_tpu.testing.workload import Workload
+
+    def parser(c, r):
+        return Ping(c), (Pong(r) if r is not None else None)
+
+    gen = NodeGenerator(
+        server_supplier=lambda a: PingServer(a),
+        client_supplier=lambda a: PingClient(a, te.SERVER),
+        workload_supplier=lambda a: Workload(
+            command_strings=["hi-1"], result_strings=["hi-1"],
+            parser=parser))
+    state = SearchState(gen)
+    state.add_server(te.SERVER)
+    state.add_client_worker(LocalAddress("client1"))
+    settings = SearchSettings().add_invariant(CLIENTS_DONE.negate())
+    settings.max_time(60)
+    obj = bfs(state, settings)
+    ten = TensorSearch(_never_done(make_pingpong_protocol(1)),
+                       chunk=256).run()
+    return obj, ten, False
+
+
+def _clientserver_exhaust():
+    import tests.test_tpu_engine as te
+    from dslabs_tpu.tpu.protocols.clientserver import \
+        make_clientserver_protocol
+
+    obj = te._clientserver_object_search(1, 1, prune_done=True)
+    p = make_clientserver_protocol(n_clients=1, w=1)
+    p = dataclasses.replace(p, goals={},
+                            prunes={"DONE": p.goals["CLIENTS_DONE"]})
+    return obj, TensorSearch(p, chunk=256).run(), True
+
+
+def _clientserver_violation():
+    import tests.test_tpu_engine as te
+    from dslabs_tpu.search.search import bfs  # noqa: F401
+    from dslabs_tpu.tpu.protocols.clientserver import \
+        make_clientserver_protocol
+
+    # Object oracle: same workload, NEVER_DONE invariant.
+    from dslabs_tpu.search.settings import SearchSettings
+    from dslabs_tpu.search.search import BFS
+    from dslabs_tpu.testing.predicates import CLIENTS_DONE
+    import tests.test_tpu_trace as tt
+
+    state = tt._object_initial(1, 1)
+    settings = SearchSettings().add_invariant(CLIENTS_DONE.negate())
+    settings.max_time(120)
+    obj = BFS(settings).run(state)
+    ten = TensorSearch(
+        _never_done(make_clientserver_protocol(n_clients=1, w=1)),
+        chunk=256).run()
+    return obj, ten, False
+
+
+def _pb_depth():
+    import tests.test_tpu_engine as te
+    from dslabs_tpu.tpu.protocols.primarybackup import make_pb_protocol
+
+    obj = te._pb_object_search(2, 1, 1, 3)
+    ten = TensorSearch(make_pb_protocol(ns=2, n_clients=1, w=1),
+                       chunk=256, max_depth=3).run()
+    return obj, ten, True
+
+
+def _paxos_depth():
+    from dslabs_tpu.core.address import LocalAddress
+    from dslabs_tpu.labs.clientserver.kv_workload import kv_workload
+    from dslabs_tpu.labs.clientserver.kvstore import KVStore
+    from dslabs_tpu.labs.paxos.paxos import PaxosClient, PaxosServer
+    from dslabs_tpu.search.search import BFS
+    from dslabs_tpu.search.search_state import SearchState
+    from dslabs_tpu.search.settings import SearchSettings
+    from dslabs_tpu.testing.generator import NodeGenerator
+    from dslabs_tpu.tpu.protocols.paxos import make_paxos_protocol
+
+    servers = tuple(LocalAddress(f"server{i}") for i in range(1, 4))
+    gen = NodeGenerator(
+        server_supplier=lambda a: PaxosServer(a, servers, KVStore()),
+        client_supplier=lambda a: PaxosClient(a, servers),
+        workload_supplier=lambda a: None)
+    st = SearchState(gen)
+    for a in servers:
+        st.add_server(a)
+    st.add_client_worker(LocalAddress("client0"),
+                         kv_workload(["PUT:key-0:v1"], ["PutOk"]))
+    settings = SearchSettings()
+    settings.set_max_depth(3).max_time(300)
+    obj = BFS(settings).run(st)
+    ten = TensorSearch(make_paxos_protocol(n=3, n_clients=1, w=1,
+                                           max_slots=2, net_cap=48,
+                                           timer_cap=6),
+                       chunk=256, max_depth=3).run()
+    return obj, ten, True
+
+
+def _shardstore_depth():
+    import tests.test_tpu_lab4 as tl
+    from dslabs_tpu.tpu.protocols.shardstore import \
+        make_shardstore_protocol
+
+    obj = tl._object_joined(3)
+    ten = TensorSearch(make_shardstore_protocol([1, 1]), chunk=256,
+                       max_depth=3).run()
+    return obj, ten, True
+
+
+def _shardstore_tx_depth():
+    import tests.test_tpu_lab4 as tl
+    from dslabs_tpu.tpu.protocols.shardstore_tx import \
+        make_shardstore_tx_protocol
+
+    obj = tl._object_tx_joined(3)
+    ten = TensorSearch(make_shardstore_tx_protocol(n_tx=1), chunk=256,
+                       max_depth=3).run()
+    return obj, ten, True
+
+
+REGISTRY = {
+    "lab0-pingpong-goal": _pingpong_goal,
+    "lab0-pingpong-exhaust": _pingpong_exhaust,
+    "lab0-pingpong-violation": _pingpong_violation,
+    "lab1-clientserver-exhaust": _clientserver_exhaust,
+    "lab1-clientserver-violation": _clientserver_violation,
+    "lab2-pb-depth": _pb_depth,
+    "lab3-paxos-depth": _paxos_depth,
+    "lab4-shardstore-depth": _shardstore_depth,
+    "lab4-shardstore-tx-depth": _shardstore_tx_depth,
+}
+
+
+@pytest.mark.parametrize("name", sorted(REGISTRY))
+def test_verdict_parity(name):
+    obj, ten, count_exact = REGISTRY[name]()
+    expect = VERDICT.get(obj.end_condition)
+    assert expect is not None, (
+        f"{name}: object ended {obj.end_condition} (budget too small?)")
+    # DEPTH_EXHAUSTED and SPACE_EXHAUSTED can legitimately interchange
+    # when the depth limit coincides with exhaustion; everything else
+    # must match exactly.
+    if expect in ("DEPTH_EXHAUSTED", "SPACE_EXHAUSTED"):
+        assert ten.end_condition in ("DEPTH_EXHAUSTED",
+                                     "SPACE_EXHAUSTED"), (
+            f"{name}: object {expect}, tensor {ten.end_condition}")
+        assert ten.end_condition == expect or count_exact, name
+    else:
+        assert ten.end_condition == expect, (
+            f"{name}: object {expect}, tensor {ten.end_condition}")
+    if count_exact:
+        assert ten.unique_states == obj.discovered_count, (
+            f"{name}: object discovered {obj.discovered_count}, "
+            f"tensor {ten.unique_states}")

@@ -19,7 +19,7 @@ from dslabs_tpu.tpu.protocols.paxos import make_paxos_protocol
 from dslabs_tpu.tpu.sharded import ShardedTensorSearch, make_mesh
 
 CHUNK = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
-EVB = int(sys.argv[2]) if len(sys.argv) > 2 else 48
+EVB = int(sys.argv[2]) if len(sys.argv) > 2 else 48  # 48 -> (40, 8)
 WARM_DEPTH = 10
 STAGES = ["expand", "route", "a2a", "probe", "back", None]
 
@@ -31,9 +31,9 @@ def make_search(stop_after):
     protocol = dataclasses.replace(protocol, goals={})
     mesh = make_mesh(len(jax.devices()))
     s = ShardedTensorSearch(protocol, mesh, chunk_per_device=CHUNK,
-                            frontier_cap=1 << 16, visited_cap=1 << 22,
+                            frontier_cap=1 << 17, visited_cap=1 << 23,
                             max_depth=WARM_DEPTH, strict=False,
-                            ev_budget=(EVB or None))
+                            ev_budget=((40, 8) if EVB == 48 else (EVB or None)))
     s._stop_after = stop_after
     # Rebuild the jitted step AFTER setting the hook (the ctor built it
     # with stop_after=None).
