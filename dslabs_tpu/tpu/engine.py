@@ -799,7 +799,7 @@ class TensorSearch:
                     and p.max_live_sends < p.max_sends) else None)
         sendsT = jnp.transpose(sendsP, (1, 2, 0))        # [S, MW, P]
         send_over = jnp.zeros((pp,), jnp.int32)
-        if live:
+        if live is not None:
             sendsT, send_over = compact_rows_batched(sendsT, live)
         o0, o1, _ = self._off
         net_rows = chunk_rows[:, o0:o1].reshape(c, p.net_cap,
