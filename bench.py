@@ -32,7 +32,7 @@ BASELINE_STATES_PER_MIN = 1e8
 # (chunk_per_device, frontier_cap, visited_cap) — per device.  Round-3
 # measured config: occupancy-compacted split event grids (EV_BUDGET
 # below), packed P1B payloads, row-native expand, tail-compacted visited
-# probe -> 3.22M unique states/min on one v5e chip at the lead rung
+# probe -> 3.55M unique states/min on one v5e chip at the lead rung
 # (compile ~2-3 min cold, cached thereafter).
 LADDER = [
     (4096, 1 << 19, 1 << 24),  # lead: 319 ms/chunk steady; visited 16M
@@ -50,9 +50,6 @@ UPGRADE_TIMEOUT_SECS = 780.0
 # max 8 of 30); overflow truncates coverage beam-style and is counted
 # in `dropped` like any frontier-cap drop.
 EV_BUDGET = (40, 8)
-
-
-CKPT_PATH = "/tmp/dslabs_bench_ckpt.npz"
 
 
 def _bench_protocol():
@@ -81,20 +78,20 @@ def _run_rung(chunk_per_device: int, frontier_cap: int, visited_cap: int,
     from dslabs_tpu.tpu.sharded import ShardedTensorSearch, make_mesh
 
     mesh = make_mesh(len(jax.devices()))
+    # NO checkpointing inside the measured window: dumping the multi-GB
+    # carry through the device tunnel costs minutes (measured: a
+    # checkpoint_every=4 rung spent 300 s saving and recorded 140
+    # states/min), which is the whole budget.  Kill-resume is exercised
+    # by tests/test_tpu_sharded.py and available to long strict
+    # searches; a crashed rung here restarts fresh on the retry.
     search = ShardedTensorSearch(
         _bench_protocol(), mesh, chunk_per_device=chunk_per_device,
         frontier_cap=frontier_cap, visited_cap=visited_cap, max_depth=1,
-        strict=False, ev_budget=EV_BUDGET,
-        checkpoint_path=CKPT_PATH, checkpoint_every=4)
-    resumable = search.has_resumable_checkpoint()
-    if not resumable:
-        search.run()  # warm-up: compiles the chunk/finish programs
+        strict=False, ev_budget=EV_BUDGET)
+    search.run()  # warm-up: compiles the chunk/finish programs
     search.max_depth = 64
     search.max_secs = max_secs
-    # resume=True continues a rung a previous bench attempt crashed out
-    # of (the checkpoint signature guards against config mismatch); the
-    # engine restores cumulative elapsed so the rate stays honest.
-    outcome = search.run(resume=resumable)
+    outcome = search.run()
     elapsed = max(outcome.elapsed_secs, 1e-9)
     return {
         "value": outcome.unique_states / elapsed * 60.0,
@@ -104,7 +101,6 @@ def _run_rung(chunk_per_device: int, frontier_cap: int, visited_cap: int,
         "end": outcome.end_condition,
         "dropped": outcome.dropped,
         "elapsed": elapsed,
-        "resumed": resumable,
     }
 
 
@@ -192,12 +188,10 @@ def _try_strict(timeout=UPGRADE_TIMEOUT_SECS):
 def main() -> None:
     platform, n_dev = _probe_platform()
     max_secs = 120.0 if platform != "cpu" else 45.0
-    if os.path.exists(CKPT_PATH):
-        os.remove(CKPT_PATH)   # stale dumps from an earlier bench
     best, err = None, None
-    # The lead rung gets TWO attempts: a crashed first attempt leaves a
-    # checkpoint, and the retry resumes it instead of restarting.  CPU
-    # runs are a smoke test — only the smallest rung is viable there.
+    # The lead rung gets TWO attempts (a crash falls through to a fresh
+    # retry before degrading).  CPU runs are a smoke test — only the
+    # smallest rung is viable there.
     attempts = ([LADDER[0]] + LADDER if platform != "cpu"
                 else [LADDER[-1]])
     for chunk, f_cap, v_cap in attempts:

@@ -114,8 +114,12 @@ class ShardedTensorSearch(TensorSearch):
         # visited table, counters) is dumped to ``checkpoint_path`` as a
         # host .npz (atomic rename), and ``run(resume=True)`` continues a
         # killed search from the last dump with identical final verdict
-        # and unique count.  0 = off (the dump is a full device->host
-        # readback — seconds at bench scale, so it is opt-in).
+        # and unique count.  0 = off.  The dump is a full device->host
+        # readback of the carry — MINUTES for a GB-scale carry over the
+        # tunnelled runtime (measured round 3) — so it is opt-in and
+        # belongs to long searches whose level time amortises it, never
+        # inside a short measured window (bench.py learned this the
+        # hard way).
         self.checkpoint_path = checkpoint_path
         self.checkpoint_every = checkpoint_every
         self.mesh = mesh
@@ -384,7 +388,10 @@ class ShardedTensorSearch(TensorSearch):
 
             def full_cond(st):
                 _, _, resolved, _, it = st
-                return ((it < 2) | (jnp.sum(~resolved) > T)) & (
+                # ONE guaranteed full-batch iteration: below 50% table
+                # load the first bucket read resolves all but the
+                # full-bucket collisions, which fit the tail buffer.
+                return ((it < 1) | (jnp.sum(~resolved) > T)) & (
                     it < 64) & jnp.any(~resolved)
 
             def full_body(st):

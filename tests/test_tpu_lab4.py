@@ -152,3 +152,20 @@ def test_lab4_tx_goal_and_invariant():
     ten = TensorSearch(make_shardstore_tx_protocol(n_tx=1), chunk=1024,
                        frontier_cap=1 << 18, max_depth=14).run()
     assert ten.end_condition == "GOAL_FOUND"
+
+
+@SLOW
+def test_lab4_tx2_depth_parity():
+    """n_tx=2 (MultiPut then MultiGet) twin parity at depths 3-5.  The
+    second transaction only becomes reachable much deeper; these depths
+    pin the lane layout and the shared config-walk/2PC prefix."""
+    from dslabs_tpu.tpu.protocols.shardstore_tx import \
+        make_shardstore_tx_protocol
+
+    for d in (3, 4, 5):
+        obj = _object_tx_joined(d, n_tx=2)
+        ten = TensorSearch(make_shardstore_tx_protocol(n_tx=2),
+                           chunk=512, max_depth=d).run()
+        assert ten.unique_states == obj.discovered_count, (
+            f"depth {d}: tensor {ten.unique_states} != "
+            f"object {obj.discovered_count}")
