@@ -32,12 +32,12 @@ BASELINE_STATES_PER_MIN = 1e8
 # (chunk_per_device, frontier_cap, visited_cap) — per device.  Round-3
 # measured config: occupancy-compacted split event grids (EV_BUDGET
 # below), packed P1B payloads, row-native expand, tail-compacted visited
-# probe -> 3.55M unique states/min on one v5e chip at the lead rung
+# probe -> 4.00M unique states/min on one v5e chip at the lead rung
 # (compile ~2-3 min cold, cached thereafter).
 LADDER = [
-    (4096, 1 << 19, 1 << 24),  # lead: 319 ms/chunk steady; visited 16M
-                               # keys/device (256 MB) stays < 50% full
-                               # inside the 120 s budget
+    (8192, 1 << 19, 1 << 24),  # lead: ~495 ms/chunk steady; visited 16M
+                               # keys/device (256 MB) reaches ~51% full
+                               # at the end of the 120 s budget
     (1024, 1 << 18, 1 << 23),  # fallback if the big rung OOMs
     (64, 1 << 12, 1 << 18),
 ]
