@@ -830,31 +830,51 @@ class TensorSearch:
         return jax.tree.map(lambda a, b: jnp.where(is_msg, a, b), m, t)
 
     @staticmethod
-    def _compact_ids(valid_ev: jnp.ndarray, budget: int):
+    def _compact_ids(valid_ev: jnp.ndarray, budget: int, offset=0):
         """[C, G] validity grid -> ([C, budget] compacted indices into G
-        (-1 = empty slot), drops scalar).  One-hot select-reduce over the
-        [C, budget, G] cube — static indexing; per-CHUNK, not per-pair."""
+        (-1 = empty slot), remaining scalar).  One-hot select-reduce over
+        the [C, budget, G] cube — static indexing; per-CHUNK, not
+        per-pair.
+
+        ``offset`` (static int or traced scalar) selects the event WINDOW
+        [offset, offset + budget) by valid-event rank: the spill
+        mechanism re-steps a chunk with the next window when
+        ``remaining`` (valid events at rank >= offset + budget) is
+        nonzero, so a budget smaller than the worst-case event count
+        truncates nothing — it just costs extra passes on the rare
+        over-budget chunk (the round-3 drop-or-abort became round 4's
+        count-then-respill)."""
         c, g = valid_ev.shape
         if budget >= g:
+            # Window 0 covers every rank (remaining always 0) — but the
+            # OTHER event kind may still spill the chunk, so later passes
+            # must present an empty table here or the full-grid kind's
+            # events would be re-expanded (and re-counted) every pass.
             ids = jnp.broadcast_to(jnp.arange(g, dtype=jnp.int32), (c, g))
-            return jnp.where(valid_ev, ids, -1), jnp.int32(0)
+            first = jnp.asarray(offset, jnp.int32) == 0
+            return jnp.where(valid_ev & first, ids, -1), jnp.int32(0)
         pos = jnp.cumsum(valid_ev, axis=1) - 1
         hit = valid_ev[:, None, :] & (
-            pos[:, None, :] == jnp.arange(budget)[None, :, None])
+            pos[:, None, :] == jnp.arange(budget)[None, :, None] + offset)
         ids = jnp.sum(jnp.where(hit, jnp.arange(g, dtype=jnp.int32)
                                 [None, None, :], 0), axis=2)
         ids = jnp.where(jnp.any(hit, axis=2), ids, -1)
-        drops = jnp.sum(valid_ev & (pos >= budget)).astype(jnp.int32)
-        return ids, drops
+        remaining = jnp.sum(valid_ev
+                            & (pos >= budget + offset)).astype(jnp.int32)
+        return ids, remaining
 
     def _event_tables(self, chunk_rows: jnp.ndarray,
-                      chunk_valid: jnp.ndarray):
+                      chunk_valid: jnp.ndarray, ev_pass=0):
         """[C, lanes] chunk -> (msg_ids [C, Bm] net-slot indices, tmr_ids
-        [C, Bt] timer grid indices, ev_drops): each state's VALID events
-        (occupied network rows + deliverable timers, masked by the
+        [C, Bt] timer grid indices, ev_remaining): each state's VALID
+        events (occupied network rows + deliverable timers, masked by the
         protocol's deliver_* settings — exactly the predicates the step
-        kinds re-check) packed into per-kind pair slots.  Events beyond
-        a budget are counted, never silently skipped."""
+        kinds re-check) packed into per-kind pair slots.  ``ev_pass``
+        selects the budget WINDOW (pass w covers valid-event ranks
+        [w*budget, (w+1)*budget) of each kind); ``ev_remaining`` counts
+        valid events past the current window — spill drivers re-step the
+        chunk at the next window until it reaches zero, so a finite
+        budget never truncates coverage."""
         p = self.p
         c = chunk_valid.shape[0]
         chunk_state = self.unflatten_rows(chunk_rows)
@@ -867,27 +887,56 @@ class TensorSearch:
         if p.deliver_timer is not None:
             dt = jax.vmap(p.deliver_timer)(jnp.arange(p.n_nodes))
             tmask = tmask & dt[None, :, None]
-        msg_ids, m_drops = self._compact_ids(
-            msg_ok & chunk_valid[:, None], self._ev_msg)
-        tmr_ids, t_drops = self._compact_ids(
-            tmask.reshape(c, -1) & chunk_valid[:, None], self._ev_tmr)
-        return msg_ids, tmr_ids, m_drops + t_drops
+        msg_ids, m_rem = self._compact_ids(
+            msg_ok & chunk_valid[:, None], self._ev_msg,
+            ev_pass * self._ev_msg)
+        tmr_ids, t_rem = self._compact_ids(
+            tmask.reshape(c, -1) & chunk_valid[:, None], self._ev_tmr,
+            ev_pass * self._ev_tmr)
+        return msg_ids, tmr_ids, m_rem + t_rem
 
-    def _expand_chunk(self, chunk_state: dict, chunk_valid: jnp.ndarray):
+    def _expand_chunk(self, chunk_rows: jnp.ndarray,
+                      chunk_valid: jnp.ndarray, ev_pass=0):
         """[C, lanes] chunk rows -> successor rows + fingerprints + masks
         + flags.
 
         Returns (rows [C*B, lanes], valids [C*B], fp [C*B, 4] uint32,
         unique [C*B] in-chunk-first-occurrence mask, overflow scalar,
-        ev_drops scalar, event_ids [C, B], flags dict) — all device
-        arrays; no host sync inside.  B = Bm + Bt, message pair slots
-        first per state (successor row = chunk_row * B + slot, the
+        ev_remaining scalar (valid events past this pass's window — see
+        :meth:`_event_tables`), event_ids [C, B], flags dict) — all
+        device arrays; no host sync inside.  B = Bm + Bt, message pair
+        slots first per state (successor row = chunk_row * B + slot, the
         arithmetic run()/_reconstruct and the sharded driver use)."""
         p = self.p
         bm, bt = self._ev_msg, self._ev_tmr
         c = chunk_valid.shape[0]
-        msg_ids, tmr_ids, ev_drops = self._event_tables(chunk_state,
-                                                        chunk_valid)
+        # Dev bisect hook (tools/profile_sharded2.py): expand-internal
+        # stages.  Each truncation returns dummy outputs whose shapes
+        # match the contract, folding the live stage outputs into the
+        # overflow scalar so XLA cannot DCE the work under test.
+        stop = getattr(self, "_stop_after", None)
+
+        def _cut(*live):
+            b = bm + bt
+            acc = jnp.int32(0)
+            for x in live:
+                acc = acc + jnp.sum(x).astype(jnp.int32)
+            return (jnp.zeros((c * b, self.lanes), jnp.int32),
+                    jnp.zeros((c * b,), bool),
+                    jnp.zeros((c * b, 4), jnp.uint32),
+                    jnp.zeros((c * b,), bool), acc, jnp.int32(0),
+                    jnp.zeros((c, b), jnp.int32),
+                    {f"{kind}:{name}": jnp.zeros((c * b,), bool)
+                     for kind, preds in (("inv", p.invariants),
+                                         ("goal", p.goals),
+                                         ("prune", p.prunes))
+                     for name in preds})
+
+        msg_ids, tmr_ids, ev_drops = self._event_tables(chunk_rows,
+                                                        chunk_valid,
+                                                        ev_pass)
+        if stop == "events":
+            return _cut(msg_ids, tmr_ids)
         # TWO flat vmaps — one per event kind, each running only its own
         # machinery (the round-2 select-both design ran BOTH handlers for
         # every pair).  Flat, not nested: a nested
@@ -898,22 +947,27 @@ class TensorSearch:
         # per-state repeat is a broadcast (XLA fuses it into the reads).
         # Only the HANDLER half is vmapped; the network merge runs as
         # ONE batched transposed program per kind (_batched_tail).
-        rep_m = jnp.repeat(chunk_state, bm, axis=0)
+        rep_m = jnp.repeat(chunk_rows, bm, axis=0)
         (nodes_m, sends_m, timers_m, exc_m, ok_m,
          tover_m) = jax.vmap(self._msg_step_raw)(
             rep_m, jnp.maximum(msg_ids, 0).reshape(-1))
-        rows_m, over_m = self._batched_tail(
-            chunk_state, c, bm, nodes_m, sends_m, timers_m, exc_m, ok_m,
-            tover_m)
-        val_m = ok_m & (msg_ids >= 0).reshape(-1)
-        rep_t = jnp.repeat(chunk_state, bt, axis=0)
+        rep_t = jnp.repeat(chunk_rows, bt, axis=0)
         (nodes_t, sends_t, timers_t, exc_t, ok_t,
          tover_t) = jax.vmap(self._tmr_step_raw)(
             rep_t, jnp.maximum(tmr_ids, 0).reshape(-1))
+        if stop == "handlers":
+            return _cut(nodes_m, sends_m, timers_m, ok_m,
+                        nodes_t, sends_t, timers_t, ok_t)
+        rows_m, over_m = self._batched_tail(
+            chunk_rows, c, bm, nodes_m, sends_m, timers_m, exc_m, ok_m,
+            tover_m)
+        val_m = ok_m & (msg_ids >= 0).reshape(-1)
         rows_t, over_t = self._batched_tail(
-            chunk_state, c, bt, nodes_t, sends_t, timers_t, exc_t, ok_t,
+            chunk_rows, c, bt, nodes_t, sends_t, timers_t, exc_t, ok_t,
             tover_t)
         val_t = ok_t & (tmr_ids >= 0).reshape(-1)
+        if stop == "tail":
+            return _cut(rows_m, rows_t)
 
         def _inter(a, b):
             return jnp.concatenate(
@@ -931,6 +985,8 @@ class TensorSearch:
             axis=1)                                        # [C, Bm+Bt]
         overflow = jnp.sum(overs * valids.astype(jnp.int32))
         fp = row_fingerprints(rows)
+        if stop == "fp":
+            return _cut(fp, valids)
 
         if self._in_chunk_dedup:
             # In-chunk sort-unique on device: first occurrence of each

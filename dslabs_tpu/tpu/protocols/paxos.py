@@ -53,6 +53,9 @@ __all__ = ["make_paxos_protocol"]
 REQ, P1A, P1B, P2A, P2B, HB, HBR, CREQ, CREP, REPLY = range(10)
 # Timer tags
 T_ELECTION, T_HEARTBEAT, T_CLIENT = 1, 2, 3
+# Exception code: a ballot/cmd reached a _pack_entry field width — the
+# search ends EXCEPTION_THROWN instead of silently aliasing states.
+EXC_PACK_WIDTH = 101
 
 ELECTION_MIN, ELECTION_MAX = 150, 300
 HEARTBEAT_MS = 50
@@ -98,8 +101,24 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
         ballot < 2^12 (300+ elections — unreachable at search depths) and
         cmd < 2^17 (cmd ids are <= n_clients * w).  Bijectivity keeps
         state equality exact; all fields nonneg so the packed lane stays
-        nonneg and lexicographic network order well-defined."""
+        nonneg and lexicographic network order well-defined.  Width
+        violations are guarded loudly: every step sets the exception lane
+        to EXC_PACK_WIDTH if any ballot/cmd in the state reaches the pack
+        limits (see _pack_guard) — distinct states can never silently
+        alias."""
         return (ex | (ch << 1) | (lb << 2) | (cmd << 14)).astype(jnp.int32)
+
+    def _pack_guard(st):
+        """int32 exception code: EXC_PACK_WIDTH when any ballot or cmd id
+        anywhere in the state has reached a _pack_entry field width (the
+        NEXT pack would alias distinct states).  Checked on every step so
+        the search ends loudly (EXCEPTION_THROWN) instead of undercounting
+        states — the tensor analog of CapacityOverflow for a packed
+        lane."""
+        over = (jnp.any(st["b"] >= (1 << 12))
+                | jnp.any(st["log"][:, :, 1] >= (1 << 12))
+                | jnp.any(st["log"][:, :, 2] >= (1 << 17)))
+        return jnp.where(over, EXC_PACK_WIDTH, 0).astype(jnp.int32)
 
     def _unpack_entry(v):
         return v & 1, (v >> 2) & 0xFFF, v >> 14, (v >> 1) & 1
@@ -362,7 +381,7 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
             cli_sets = t if cli_sets is None else jnp.minimum(cli_sets, t)
         rows = jnp.concatenate([srv_rows, cli_rows])
         tsets = jnp.concatenate([srv_sets, cli_sets])
-        return _repack(st), rows, tsets
+        return _repack(st), rows, tsets, _pack_guard(st)
 
     def _server_handle(st, i, here, tag, frm, p, sends, sets):
         ballot = st["b"][i]
@@ -609,7 +628,7 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
             cli_sets = t if cli_sets is None else jnp.minimum(cli_sets, t)
         rows = jnp.concatenate([srv_rows, cli_rows])
         tsets = jnp.concatenate([srv_sets, cli_sets])
-        return _repack(st), rows, tsets
+        return _repack(st), rows, tsets, _pack_guard(st)
 
     def _server_timer(st, i, here, tag, p0, sends: Sends, sets: Sets):
         ballot = st["b"][i]

@@ -106,6 +106,7 @@ class ShardedTensorSearch(TensorSearch):
                  max_secs: Optional[float] = None,
                  strict: bool = True,
                  ev_budget: Optional[int] = None,
+                 ev_spill: Optional[bool] = None,
                  record_trace: bool = False,
                  checkpoint_path: Optional[str] = None,
                  checkpoint_every: int = 0):
@@ -143,16 +144,27 @@ class ShardedTensorSearch(TensorSearch):
         self.f_cap = frontier_cap          # per device
         self.v_cap = visited_cap           # per device
         self.cpd = chunk_per_device
+        # Event-window spill (round-4): when a chunk has valid events past
+        # the ev_budget window, re-step it at the next window instead of
+        # dropping (beam) / aborting (strict) — a finite budget then costs
+        # extra passes on rare over-budget chunks, never coverage.
+        # Default: on for strict (exactness), off for beam (the re-pass
+        # of a whole chunk for a few tail events is the wrong throughput
+        # trade; drops are counted as before).
+        self.ev_spill = strict if ev_spill is None else ev_spill
         # The owner-side hash table is the dedup authority, so the
         # engine's in-chunk sort-unique prefilter is redundant work — but
         # without it, duplicate successors (all sharing one fingerprint,
         # hence one owner) can pile onto a single fixed-size routing
-        # bucket.  strict mode must never abort a search the dedup'd
-        # path would complete, so it keeps the prefilter; bench runs
-        # (strict=False, drops tolerated) skip it for throughput.
+        # bucket.  On ONE device the bucket holds the entire successor
+        # batch exactly (bucket = C * ne below), so pileup cannot
+        # overflow and even strict runs skip the prefilter (it measured
+        # ~60% of a loaded chunk step).  Multi-device strict keeps it:
+        # per-owner buckets have only 2x-mean headroom.
         super().__init__(protocol, frontier_cap=frontier_cap,
                          chunk=chunk_per_device, max_depth=max_depth,
-                         max_secs=max_secs, in_chunk_dedup=strict,
+                         max_secs=max_secs,
+                         in_chunk_dedup=strict and self.n_devices > 1,
                          ev_budget=ev_budget, record_trace=record_trace)
         # Trace mode: each level spills (child_fp, parent_fp, event_id)
         # for every appended successor; reconstruction walks fingerprints
@@ -182,6 +194,11 @@ class ShardedTensorSearch(TensorSearch):
                     jnp.max(carry["vis_n"]),
                     jnp.sum(carry["vis_n"]),
                     jnp.max(carry["nxt_n"]),
+                    # Slowest device's completed-chunk count: the spill
+                    # re-dispatch loop reads it from the SAME readback as
+                    # the level sync (no extra host round-trips when no
+                    # chunk spilled).
+                    jnp.min(carry["j"]),
                 ], jnp.int32),
                 jnp.sum(carry["flag_cnt"].reshape(self.n_devices, nf),
                         axis=0).astype(jnp.int32),
@@ -234,8 +251,23 @@ class ShardedTensorSearch(TensorSearch):
             start = j * C
             rows_chunk = jax.lax.dynamic_slice(cur, (start, 0), (C, lanes))
             valid = (start + jnp.arange(C)) < cur_n
-            (rows, valids, fp, unique, overflow, ev_drops, event_ids,
-             flags) = self._expand_chunk(rows_chunk, valid)
+            ev_pass = carry["evp"][0]
+            (rows, valids, fp, unique, overflow, ev_rem, event_ids,
+             flags) = self._expand_chunk(rows_chunk, valid, ev_pass)
+            # Spill: valid events past this pass's window mean the SAME
+            # chunk must re-step at the next window before j advances
+            # (run() re-dispatches until every device's j reaches its
+            # chunk count).  Without spill, the remainder is a counted
+            # beam-style drop exactly as in round 3.
+            if self.ev_spill:
+                spill = ev_rem > 0
+                j_next = carry["j"] + jnp.where(spill, 0, 1)
+                evp_next = jnp.where(spill, carry["evp"] + 1, 0)
+                ev_drops = jnp.int32(0)
+            else:
+                j_next = carry["j"] + 1
+                evp_next = carry["evp"]
+                ev_drops = ev_rem
             if self.record_trace:
                 # [C*B, 9] uint32 trace meta: child fp, parent fp, grid
                 # event id — spilled to host per level for fp-chain
@@ -248,8 +280,13 @@ class ShardedTensorSearch(TensorSearch):
                     jnp.repeat(fp_par, ne_slots, axis=0),
                     event_ids.reshape(-1, 1).astype(jnp.uint32),
                 ], axis=1)                                     # [C*B, 9]
-            if stop_after == "expand":
-                return _stopped(carry, rows, fp, unique)
+            if stop_after in ("events", "handlers", "tail", "fp",
+                              "expand"):
+                # The engine-internal stages already truncated inside
+                # _expand_chunk (dummy outputs, live sums folded into
+                # `overflow`); fold here and skip the rest of the step.
+                return _stopped(carry, rows, fp, unique,
+                                jnp.asarray([overflow]))
 
             # ---- terminal flags, checkState order (exception first)
             hit_list = [valids & (rows[:, -1] != 0)]
@@ -456,8 +493,17 @@ class ShardedTensorSearch(TensorSearch):
                 return out
 
             # ---- append fresh, un-pruned successors (still in producer
-            # order, no row permutation) to the local next frontier
-            sel = fresh_rows & ~pruned
+            # order, no row permutation) to the local next frontier.
+            # noapp (set by run() for the FINAL depth-limited level):
+            # fresh states still count into vis_n/flags — discovered,
+            # checked, never expanded — but skip the frontier append, so
+            # a last level D times larger than frontier_cap needs no
+            # frontier memory (the depth limit ends the search exactly as
+            # DEPTH_EXHAUSTED would; the reference's BFS likewise never
+            # queues states at the cutoff depth).
+            noapp = carry["noapp"][0] == 1
+            sel_would = fresh_rows & ~pruned
+            sel = sel_would & ~noapp
             spos = jnp.cumsum(sel) - 1
             nxt, nxt_n = carry["nxt"], carry["nxt_n"][0]
             sdst = jnp.where(sel & (nxt_n + spos < F), nxt_n + spos, F)
@@ -470,8 +516,17 @@ class ShardedTensorSearch(TensorSearch):
 
             out = {
                 "cur": cur, "cur_n": carry["cur_n"],
-                "j": carry["j"] + 1,
-                "nxt": nxt, "nxt_n": carry["nxt_n"].at[0].add(n_sel),
+                "j": j_next, "evp": evp_next, "noapp": carry["noapp"],
+                # On a noapp level nxt_n counts the WOULD-BE appends
+                # (rows themselves are skipped, no frontier-cap drops):
+                # run() reads it to tell DEPTH_EXHAUSTED (successors
+                # remained) from SPACE_EXHAUSTED (space ended exactly at
+                # the depth limit) — the base engine's verdict for the
+                # same boundary (engine.py run(): not lvl_keys).
+                "nxt": nxt, "nxt_n": carry["nxt_n"].at[0].add(
+                    jnp.where(noapp,
+                              jnp.sum(sel_would).astype(jnp.int32),
+                              n_sel)),
                 "visited": new_visited,
                 "vis_n": carry["vis_n"].at[0].add(n_fresh),
                 "explored": carry["explored"].at[0].add(
@@ -543,6 +598,7 @@ class ShardedTensorSearch(TensorSearch):
             carry["nxt"] = jnp.zeros((F + 1, lanes), jnp.int32)
             carry["nxt_n"] = jnp.zeros((1,), jnp.int32)
             carry["j"] = jnp.zeros((1,), jnp.int32)
+            carry["evp"] = jnp.zeros((1,), jnp.int32)
             if self.record_trace:
                 # The level's meta was spilled to host before this runs.
                 carry["tmeta"] = jnp.zeros((F + 1, 9), jnp.uint32)
@@ -555,8 +611,9 @@ class ShardedTensorSearch(TensorSearch):
 
     def _carry_specs(self):
         ax = self.axis
-        keys = ["cur", "cur_n", "j", "nxt", "nxt_n", "visited", "vis_n",
-                "explored", "overflow", "drops", "flag_cnt", "flag_rows"]
+        keys = ["cur", "cur_n", "j", "evp", "noapp", "nxt", "nxt_n",
+                "visited", "vis_n", "explored", "overflow", "drops",
+                "flag_cnt", "flag_rows"]
         if self.record_trace:
             keys += ["tmeta", "flag_meta"]
         return {k: P(ax) for k in keys}
@@ -591,6 +648,8 @@ class ShardedTensorSearch(TensorSearch):
                     owner * F].set(row0),
                 "cur_n": onehot_d.astype(jnp.int32),
                 "j": jnp.zeros((D,), jnp.int32),
+                "evp": jnp.zeros((D,), jnp.int32),
+                "noapp": jnp.zeros((D,), jnp.int32),
                 "nxt": jnp.zeros((D * (F + 1), lanes), jnp.int32),
                 "nxt_n": jnp.zeros((D,), jnp.int32),
                 "visited": jnp.full((D * (V + 1), 4), MAXU32,
@@ -671,9 +730,11 @@ class ShardedTensorSearch(TensorSearch):
         os.replace(tmp, self.checkpoint_path)
 
     def _ckpt_signature(self) -> str:
-        return repr((self.p.name, self.f_cap, self.v_cap, self.cpd,
+        # "v4": carry layout gained evp/noapp (round-3 dumps must not
+        # resume into a step program that expects the new keys).
+        return repr(("v4", self.p.name, self.f_cap, self.v_cap, self.cpd,
                      self.n_devices, self._ev_msg, self._ev_tmr,
-                     self.strict, self.record_trace))
+                     self.strict, self.ev_spill, self.record_trace))
 
     def has_resumable_checkpoint(self) -> bool:
         """Existence + config-signature check WITHOUT loading the carry
@@ -759,6 +820,18 @@ class ShardedTensorSearch(TensorSearch):
                                                depth, t0)
                 depth += 1
                 t_lvl = time.time()
+                # Final depth-limited level: count/check fresh successors
+                # without building the next frontier (it would never be
+                # expanded — and at bench scale it would not even FIT:
+                # the depth-10 strict probe's last level is ~4x the
+                # frontier cap).  The explicit DEPTH_EXHAUSTED return
+                # below replaces the loop-top check for this level.
+                noapp_level = (self.max_depth is not None
+                               and depth >= self.max_depth)
+                if noapp_level:
+                    shard = NamedSharding(self.mesh, P(self.axis))
+                    carry["noapp"] = jax.device_put(
+                        np.ones(self.n_devices, np.int32), shard)
                 # max_n was read BEFORE the rebalance: a device can end up
                 # with ceil(total/D) <= max_n + D - 1 rows afterwards, so
                 # widen the chunk grid by that bound (at most one extra,
@@ -781,18 +854,34 @@ class ShardedTensorSearch(TensorSearch):
                         jax.block_until_ready(carry["j"])
                     if (self.max_secs is not None and j + 1 < n_chunks
                             and time.time() - t0 > self.max_secs):
-                        out, _, _, drops, _ = self._sync_checks(carry,
-                                                                depth, t0)
+                        out, _, _, drops, _, _ = self._sync_checks(
+                            carry, depth, t0)
                         if out is not None:
                             return out
                         return self._limit_outcome("TIME_EXHAUSTED", carry,
                                                    depth, t0)
                 t_disp = time.time() - t_disp
-                # ---- the one host sync per level
-                out, explored, vis_total, drops, max_n = self._sync_checks(
-                    carry, depth, t0)
-                if out is not None:
-                    return out
+                # ---- the one host sync per level.  With event-window
+                # spill, a chunk that had valid events past its window
+                # held j back — re-dispatch until the slowest device has
+                # completed all its chunks (no extra readbacks when
+                # nothing spilled: j_done rides the same stats vector).
+                while True:
+                    (out, explored, vis_total, drops, max_n,
+                     j_done) = self._sync_checks(carry, depth, t0)
+                    if out is not None:
+                        return out
+                    if not self.ev_spill or j_done >= n_chunks:
+                        break
+                    # Spill rounds respect the time budget too (the
+                    # checks above already ran, so a verdict in the
+                    # completed chunks is never masked).
+                    if (self.max_secs is not None
+                            and time.time() - t0 > self.max_secs):
+                        return self._limit_outcome("TIME_EXHAUSTED",
+                                                   carry, depth, t0)
+                    for _ in range(n_chunks - j_done):
+                        carry = self._chunk_step(carry)
                 if _LEVEL_TIMING:
                     dt = time.time() - t_lvl
                     print(f"[level {depth}] chunks={n_chunks} "
@@ -800,6 +889,16 @@ class ShardedTensorSearch(TensorSearch):
                           f"dispatch={t_disp:.2f}s "
                           f"explored={explored} unique={vis_total} "
                           f"next={max_n}", flush=True)
+                if noapp_level:
+                    # max_n counted the final level's would-be appends:
+                    # zero means the space ended exactly at the depth
+                    # limit — SPACE_EXHAUSTED, matching the base engine
+                    # and the pre-noapp loop's verdict at this boundary.
+                    return SearchOutcome(
+                        "DEPTH_EXHAUSTED" if max_n > 0
+                        else "SPACE_EXHAUSTED",
+                        explored, vis_total, depth,
+                        time.time() - t0, dropped=drops)
                 if self.record_trace:
                     self._spill_tmeta(carry)
                 carry = self._finish_level(carry)
@@ -827,8 +926,13 @@ class ShardedTensorSearch(TensorSearch):
         children = list(map(tuple, rows[:, :4].tolist()))
         parents = list(map(tuple, rows[:, 4:8].tolist()))
         events = rows[:, 8].tolist()
-        new = dict(zip(children, zip(parents, events)))
-        # Keep FIRST occurrence (BFS parent): existing entries win.
+        # Keep FIRST occurrence (BFS parent) both within the level's batch
+        # (reversed zip: earlier rows overwrite later duplicates — today
+        # owner-side dedup already makes within-level children unique, but
+        # first-wins must not depend on that) and across levels (existing
+        # entries win via the update order below).
+        new = dict(zip(reversed(children),
+                       zip(reversed(parents), reversed(events))))
         new.update(self._fp_map)
         self._fp_map = new
 
@@ -856,11 +960,13 @@ class ShardedTensorSearch(TensorSearch):
         visited load factor (raise).  ONE device->host readback (the fused
         ``_stats`` vector); the expensive flag-row readback happens only
         when a terminal flag actually fired.  Returns
-        (outcome_or_none, explored, vis_total, drops, nxt_max)."""
+        (outcome_or_none, explored, vis_total, drops, nxt_max, j_done)
+        where j_done is the slowest device's completed-chunk count (the
+        spill re-dispatch signal)."""
         s = np.asarray(self._stats(carry))
-        overflow, drops, explored, vis_max, vis_total, nxt_max = (
-            int(x) for x in s[:6])
-        flag_counts = s[6:]
+        (overflow, drops, explored, vis_max, vis_total, nxt_max,
+         j_done) = (int(x) for x in s[:7])
+        flag_counts = s[7:]
         if overflow:
             raise CapacityOverflow(
                 f"{self.p.name}: {overflow} semantic drops at depth "
@@ -879,13 +985,13 @@ class ShardedTensorSearch(TensorSearch):
                                             depth, t0)
             if out is not None:
                 out.dropped = drops
-                return out, explored, vis_total, drops, nxt_max
+                return out, explored, vis_total, drops, nxt_max, j_done
         if vis_max > 3 * self.v_cap // 4:
             raise CapacityOverflow(
                 f"{self.p.name}: visited hash table > 75% full "
                 f"({vis_max}/{self.v_cap} per device) "
                 f"at depth {depth}; raise visited_cap")
-        return None, explored, vis_total, drops, nxt_max
+        return None, explored, vis_total, drops, nxt_max, j_done
 
     def _limit_outcome(self, cond, carry, depth, t0):
         return SearchOutcome(

@@ -43,7 +43,9 @@ def _sh(args, **kw) -> int:
 
 
 def _partition(names, n):
-    """Contiguous near-even shards, like the reference's ceil-split."""
+    """Round-robin over sorted names: near-even shard sizes (the
+    reference ceil-splits contiguously, grading/distributor.py; shard
+    CONTENTS differ but the merge step is order-independent)."""
     shards = [[] for _ in range(n)]
     for i, name in enumerate(sorted(names)):
         shards[i % n].append(name)

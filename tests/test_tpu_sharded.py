@@ -159,3 +159,41 @@ def test_checkpoint_resume_identical_outcome(tmp_path):
         visited_cap=1 << 10, checkpoint_path=done_ckpt).run(resume=True)
     assert f2.end_condition == "SPACE_EXHAUSTED"
     assert f2.unique_states == f1.unique_states
+
+
+def test_event_window_spill_exact_counts():
+    """A tiny ev_budget with window spill must reproduce the full-grid
+    unique/explored counts exactly: events past a window re-step the
+    chunk at the next window (sharded.py round-4 spill), so the budget
+    is a throughput knob, never a coverage cut."""
+    proto = _pruned_pingpong()
+    mesh = make_mesh(8)
+    full = ShardedTensorSearch(
+        proto, mesh, chunk_per_device=16, frontier_cap=1 << 8,
+        visited_cap=1 << 10, strict=True).run()
+    # Budget far below the protocol's event grid: forces multi-pass
+    # spills on nearly every loaded chunk.
+    tiny = ShardedTensorSearch(
+        proto, mesh, chunk_per_device=16, frontier_cap=1 << 8,
+        visited_cap=1 << 10, strict=True, ev_budget=(2, 1),
+        ev_spill=True).run()
+    assert tiny.end_condition == full.end_condition == "SPACE_EXHAUSTED"
+    assert tiny.unique_states == full.unique_states
+    assert tiny.states_explored == full.states_explored
+    assert tiny.dropped == 0
+
+
+def test_count_only_final_level_matches_depth_limit():
+    """max_depth runs count/check the final level's fresh states without
+    building its frontier (noapp); unique/explored totals must equal a
+    run whose frontier cap could hold that level."""
+    proto = _pruned_pingpong()
+    mesh = make_mesh(8)
+    wide = ShardedTensorSearch(
+        proto, mesh, chunk_per_device=16, frontier_cap=1 << 8,
+        visited_cap=1 << 10, strict=True, max_depth=4).run()
+    assert wide.end_condition == "DEPTH_EXHAUSTED"
+    single = TensorSearch(proto, chunk=64, max_depth=4).run()
+    assert single.end_condition == "DEPTH_EXHAUSTED"
+    assert wide.unique_states == single.unique_states
+    assert wide.states_explored == single.states_explored

@@ -58,7 +58,8 @@ def main():
             n_chunks = -(-(max_n + search.n_devices - 1) // search.cpd)
             for _ in range(n_chunks):
                 carry = search._chunk_step(carry)
-            _, _, _, drops, max_n = search._sync_checks(carry, depth, t0)
+            _, _, _, drops, max_n, _ = search._sync_checks(carry, depth,
+                                                           t0)
             carry = search._finish_level(carry)
             hist, mx, tot, n, mmx, tmx = jax.tree.map(np.asarray,
                                                       jstats(carry))

@@ -21,7 +21,8 @@ from dslabs_tpu.tpu.sharded import ShardedTensorSearch, make_mesh
 CHUNK = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
 EVB = int(sys.argv[2]) if len(sys.argv) > 2 else 48  # 48 -> (40, 8)
 WARM_DEPTH = 10
-STAGES = ["expand", "route", "a2a", "probe", "back", None]
+STAGES = ["events", "handlers", "tail", "fp", "expand", "route",
+          "a2a", "probe", "back", None]
 
 
 def make_search(stop_after):
@@ -55,7 +56,7 @@ def warm_carry(s):
         n_chunks = -(-(max_n + s.n_devices - 1) // s.cpd)
         for _ in range(n_chunks):
             carry = s._chunk_step(carry)
-        _, _, _, _, max_n = s._sync_checks(carry, depth, t0)
+        _, _, _, _, max_n, _ = s._sync_checks(carry, depth, t0)
         carry = s._finish_level(carry)
     return carry, max_n
 
