@@ -40,7 +40,8 @@ def _check_markers(st) -> Tuple[bool, Optional[str]]:
 
 
 MARKERS_VALID = StatePredicate(
-    "First non-cleared and last non-empty valid", _check_markers)
+    "First non-cleared and last non-empty valid", _check_markers,
+    tkey=("PAXOS_MARKERS_VALID",))
 
 
 def _slot_valid(st, i: int) -> Tuple[bool, Optional[str]]:
@@ -83,7 +84,8 @@ def _slot_valid(st, i: int) -> Tuple[bool, Optional[str]]:
 
 def slot_valid(i: int) -> StatePredicate:
     return StatePredicate(f"Logs consistent for slot {i}",
-                          lambda st: _slot_valid(st, i))
+                          lambda st: _slot_valid(st, i),
+                          tkey=("PAXOS_SLOT_VALID", i))
 
 
 def _logs_consistent(st, all_slots: bool) -> Tuple[bool, Optional[str]]:
@@ -101,17 +103,21 @@ def _logs_consistent(st, all_slots: bool) -> Tuple[bool, Optional[str]]:
 
 
 LOGS_CONSISTENT = StatePredicate(
-    "Active log slots consistent", lambda st: _logs_consistent(st, False))
+    "Active log slots consistent", lambda st: _logs_consistent(st, False),
+    tkey=("PAXOS_LOGS_CONSISTENT", False))
 
 LOGS_CONSISTENT_ALL_SLOTS = StatePredicate(
-    "Non-empty log slots consistent", lambda st: _logs_consistent(st, True))
+    "Non-empty log slots consistent", lambda st: _logs_consistent(st, True),
+    tkey=("PAXOS_LOGS_CONSISTENT", True))
 
 
 def has_status(a, i: int, status: str) -> StatePredicate:
     return StatePredicate(f"{a} has status {status} in slot {i}",
-                          lambda st: st.servers[a].status(i) == status)
+                          lambda st: st.servers[a].status(i) == status,
+                          tkey=("PAXOS_HAS_STATUS", a, i, status))
 
 
 def has_command(a, i: int, c) -> StatePredicate:
     return StatePredicate(f"{a} has command {c} in slot {i}",
-                          lambda st: st.servers[a].command(i) == c)
+                          lambda st: st.servers[a].command(i) == c,
+                          tkey=("PAXOS_HAS_COMMAND", a, i, c))

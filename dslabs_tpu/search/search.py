@@ -285,9 +285,23 @@ class RandomDFS(Search):
 
 def bfs(initial_state: SearchState,
         settings: Optional[SearchSettings] = None) -> SearchResults:
+    """BFS entry point (Search.bfs, Search.java:390-402).  The search
+    STRATEGY is selectable via ``GlobalSettings.search_backend``
+    (``run_tests.py --search-backend tensor``): the tensor strategy runs
+    the same state + settings on the TPU engine through the lab's
+    protocol twin (tpu/backend.py) and fails loudly when no twin
+    exists — it never silently falls back to the object checker."""
+    if GlobalSettings.search_backend == "tensor":
+        from dslabs_tpu.tpu.backend import tensor_bfs
+
+        return tensor_bfs(initial_state, settings)
     return BFS(settings).run(initial_state)
 
 
 def dfs(initial_state: SearchState,
         settings: Optional[SearchSettings] = None) -> SearchResults:
+    if GlobalSettings.search_backend == "tensor":
+        from dslabs_tpu.tpu.backend import tensor_dfs
+
+        return tensor_dfs(initial_state, settings)
     return RandomDFS(settings).run(initial_state)

@@ -278,24 +278,43 @@ class SearchState(AbstractState):
 
     # ----------------------------------------------------------------- drops
 
+    def _record_staged_op(self, op: tuple) -> None:
+        """Mirror a staged network mutation into this state's tensor
+        provenance (tpu/backend.py), so the next tensor-backend phase can
+        re-derive its twin root by replaying the same op.  Ops on a state
+        with no provenance yet (e.g. drop_pending_messages on the pristine
+        initial state) accumulate in ``_staged_ops`` and are picked up by
+        the backend's depth-0 path."""
+        tp = getattr(self, "_tensor_provenance", None)
+        if tp is not None:
+            tp.history.append(op)
+        else:
+            if not hasattr(self, "_staged_ops"):
+                self._staged_ops = []
+            self._staged_ops.append(op)
+
     def drop_pending_messages(self) -> None:
         """Temporarily ignore all pending messages (used by staged searches,
         SearchState.java:534-541)."""
         self._dropped.update(self._network)
         self._network.clear()
+        self._record_staged_op(("drop",))
 
     def undrop_messages(self) -> None:
         self._network.update(self._dropped)
+        self._record_staged_op(("undrop_all",))
 
     def undrop_messages_from(self, address: Address) -> None:
         for m in self._dropped:
             if m.frm == address:
                 self._network[m] = None
+        self._record_staged_op(("undrop_from", str(address.root_address())))
 
     def undrop_messages_to(self, address: Address) -> None:
         for m in self._dropped:
             if m.to == address:
                 self._network[m] = None
+        self._record_staged_op(("undrop_to", str(address.root_address())))
 
     # ---------------------------------------------------------------- traces
 

@@ -58,9 +58,18 @@ class StatePredicate:
     negate/and/or/implies mirror StatePredicate.java:382-432.
     """
 
-    def __init__(self, name: str, fn: Callable[[Any], Any]):
+    def __init__(self, name: str, fn: Callable[[Any], Any],
+                 tkey: Any = None):
         self.name = name
         self._fn = fn
+        # Tensor-translation metadata (SURVEY §8.1 "the TPU backend is a
+        # new Search strategy selectable by settings"): ``tkey`` names a
+        # primitive predicate for the tensor backend's twin adapters
+        # (e.g. ("PAXOS_HAS_STATUS", addr, slot, status)); ``structure``
+        # records combinator shape so compound predicates translate
+        # structurally.  Both are inert on the object path.
+        self.tkey = tkey
+        self.structure = None
 
     def check(self, state: Any) -> PredicateResult:
         """Full evaluation, capturing exceptions."""
@@ -86,8 +95,10 @@ class StatePredicate:
     # ----------------------------------------------------------- combinators
 
     def negate(self) -> "StatePredicate":
-        return StatePredicate(f"not ({self.name})",
-                              lambda s: not self.check_raises(s))
+        p = StatePredicate(f"not ({self.name})",
+                           lambda s: not self.check_raises(s))
+        p.structure = ("not", self)
+        return p
 
     def check_raises(self, state: Any) -> bool:
         r = self.check(state)
@@ -96,16 +107,22 @@ class StatePredicate:
         return r.value
 
     def and_(self, other: "StatePredicate") -> "StatePredicate":
-        return StatePredicate(f"({self.name}) and ({other.name})",
-                              lambda s: self.check_raises(s) and other.check_raises(s))
+        p = StatePredicate(f"({self.name}) and ({other.name})",
+                           lambda s: self.check_raises(s) and other.check_raises(s))
+        p.structure = ("and", self, other)
+        return p
 
     def or_(self, other: "StatePredicate") -> "StatePredicate":
-        return StatePredicate(f"({self.name}) or ({other.name})",
-                              lambda s: self.check_raises(s) or other.check_raises(s))
+        p = StatePredicate(f"({self.name}) or ({other.name})",
+                           lambda s: self.check_raises(s) or other.check_raises(s))
+        p.structure = ("or", self, other)
+        return p
 
     def implies(self, other: "StatePredicate") -> "StatePredicate":
-        return StatePredicate(f"({self.name}) implies ({other.name})",
-                              lambda s: (not self.check_raises(s)) or other.check_raises(s))
+        p = StatePredicate(f"({self.name}) implies ({other.name})",
+                           lambda s: (not self.check_raises(s)) or other.check_raises(s))
+        p.structure = ("implies", self, other)
+        return p
 
     def __repr__(self) -> str:
         return f"StatePredicate({self.name!r})"
@@ -124,27 +141,32 @@ def _results_ok(state) -> Tuple[bool, Optional[str]]:
     return True, None
 
 
-RESULTS_OK = StatePredicate("Clients got expected results", _results_ok)
+RESULTS_OK = StatePredicate("Clients got expected results", _results_ok,
+                            tkey=("RESULTS_OK",))
 
 NONE_DECIDED = StatePredicate(
     "No results returned",
-    lambda state: all(len(w.results) == 0 for w in state.client_workers().values()))
+    lambda state: all(len(w.results) == 0 for w in state.client_workers().values()),
+    tkey=("NONE_DECIDED",))
 
 CLIENTS_DONE = StatePredicate(
     "All clients done",
-    lambda state: all(w.done() for w in state.client_workers().values()))
+    lambda state: all(w.done() for w in state.client_workers().values()),
+    tkey=("CLIENTS_DONE",))
 
 
 def client_done(address) -> StatePredicate:
     return StatePredicate(
         f"Client {address} done",
-        lambda state: state.client_workers()[address].done())
+        lambda state: state.client_workers()[address].done(),
+        tkey=("CLIENT_DONE", address))
 
 
 def client_has_results(address, num_results: int) -> StatePredicate:
     return StatePredicate(
         f"Client {address} has {num_results} result(s)",
-        lambda state: len(state.client_workers()[address].results) >= num_results)
+        lambda state: len(state.client_workers()[address].results) >= num_results,
+        tkey=("CLIENT_HAS_RESULTS", address, num_results))
 
 
 def _all_results_same(state) -> Tuple[bool, Optional[str]]:

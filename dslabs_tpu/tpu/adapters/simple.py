@@ -1,0 +1,238 @@
+"""Lab 0 (ping-pong) and lab 1 (exactly-once client/server) twin
+adapters for the harness search backend (tpu/backend.py).
+
+Both twins collapse application values to per-client sequence progress
+(tpu/protocols/pingpong.py, clientserver.py docstrings); the adapters
+rebuild exact object messages from the binding's ACTUAL workloads, and
+resolve the one value the twins do not model — the server reply's
+application result — from the replayed object state's network via
+MessageTemplate (tpu/trace.py), the same value-collapse discipline as
+the paxos adapter (tpu/adapters/paxos.py docstring)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+from dslabs_tpu.tpu.adapters.paxos import _num_suffix, _workload_pairs
+from dslabs_tpu.tpu.backend import (NoTensorTwin, TwinBinding,
+                                    register_adapter)
+
+__all__ = ["PingPongBinding", "ClientServerBinding"]
+
+
+class PingPongBinding(TwinBinding):
+    """One PingServer + one ClientWorker(PingClient) walking a finite
+    echo workload; twin node indices: server 0, client 1."""
+
+    def __init__(self, state):
+        workers = state.client_workers()
+        self.server_name = str(next(iter(state.servers)))
+        self.client_name = str(next(iter(workers)))
+        self.addr_index = {self.server_name: 0, self.client_name: 1}
+        (addr, worker), = workers.items()
+        pairs = _workload_pairs(worker, addr)
+        self.cmds = [c for c, _ in pairs]
+        for c, r in pairs:
+            if r is not None and r.value != c.value:
+                raise NoTensorTwin(
+                    "pingpong twin models the echo server; expected "
+                    f"result {r!r} != command {c!r}")
+        self.w = len(pairs)
+        self.key = ("pingpong", self.server_name, self.client_name,
+                    tuple(repr(c) for c in self.cmds))
+
+    def initial_caps(self):
+        return 8, 4
+
+    def build_protocol(self, net_cap, timer_cap):
+        from dslabs_tpu.tpu.protocols.pingpong import \
+            make_pingpong_protocol
+
+        p = make_pingpong_protocol(self.w)
+        return dataclasses.replace(
+            p, net_cap=max(net_cap // 4, p.net_cap),
+            timer_cap=max(timer_cap // 2, p.timer_cap),
+            decode_message=self._decode_message,
+            decode_timer=self._decode_timer)
+
+    def _decode_message(self, rec):
+        from dslabs_tpu.core.address import LocalAddress
+        from dslabs_tpu.labs.pingpong.pingpong import (PingRequest,
+                                                       PongReply, Pong)
+        from dslabs_tpu.tpu.protocols.pingpong import REQ
+
+        tag, i = int(rec[0]), int(rec[1])
+        server = LocalAddress(self.server_name)
+        client = LocalAddress(self.client_name)
+        cmd = self.cmds[i - 1]
+        if tag == REQ:
+            return client, server, PingRequest(cmd)
+        return server, client, PongReply(Pong(cmd.value))
+
+    def _decode_timer(self, node_idx, rec):
+        from dslabs_tpu.core.address import LocalAddress
+        from dslabs_tpu.labs.pingpong.pingpong import PingTimer
+        from dslabs_tpu.tpu.protocols.pingpong import PING_MS
+
+        i = int(rec[3])
+        return (LocalAddress(self.client_name), PingTimer(self.cmds[i - 1]),
+                PING_MS, PING_MS)
+
+    def predicate(self, tkey):
+        kind = tkey[0]
+        w = self.w
+
+        def k(s):
+            return s["nodes"][0]
+
+        if kind in ("RESULTS_OK", "RESULTS_LINEARIZABLE",
+                    "ALL_RESULTS_SAME"):
+            return lambda s: k(s) >= 0
+        if kind in ("CLIENTS_DONE", "CLIENT_DONE"):
+            return lambda s: k(s) == w + 1
+        if kind == "NONE_DECIDED":
+            return lambda s: k(s) == 1
+        if kind == "CLIENT_HAS_RESULTS":
+            return lambda s: k(s) >= tkey[2] + 1
+        return None
+
+
+class ClientServerBinding(TwinBinding):
+    """One SimpleServer + NC ClientWorker(SimpleClient)s with finite KV
+    workloads; twin node indices: server 0, client c -> 1 + c."""
+
+    def __init__(self, state):
+        workers = state.client_workers()
+        clients = sorted(workers,
+                         key=lambda a: _num_suffix(str(a), "client") or 0)
+        self.server_name = str(next(iter(state.servers)))
+        self.client_names = [str(a) for a in clients]
+        self.nc = len(clients)
+        self.addr_index = {self.server_name: 0}
+        self.addr_index.update(
+            {c: 1 + j for j, c in enumerate(self.client_names)})
+        pairs = [_workload_pairs(workers[a], a) for a in clients]
+        sizes = {len(p) for p in pairs}
+        if len(sizes) != 1:
+            raise NoTensorTwin(
+                f"per-client workload sizes differ ({sizes})")
+        self.w = sizes.pop()
+        self.pairs = pairs
+        self.key = ("clientserver", self.server_name,
+                    tuple(self.client_names),
+                    tuple(repr(c) for p in pairs for c, _ in p))
+
+    def initial_caps(self):
+        return 16, 4
+
+    def build_protocol(self, net_cap, timer_cap):
+        from dslabs_tpu.tpu.protocols.clientserver import \
+            make_clientserver_protocol
+
+        p = make_clientserver_protocol(n_clients=self.nc, w=self.w,
+                                       net_cap=net_cap,
+                                       timer_cap=timer_cap)
+        return dataclasses.replace(
+            p, decode_message=self._decode_message,
+            decode_timer=self._decode_timer)
+
+    def _amo(self, c, s):
+        from dslabs_tpu.core.address import LocalAddress
+        from dslabs_tpu.labs.clientserver.amo import AMOCommand
+
+        return AMOCommand(self.pairs[c][s - 1][0],
+                          LocalAddress(self.client_names[c]), s)
+
+    def _decode_message(self, rec):
+        from dslabs_tpu.core.address import LocalAddress
+        from dslabs_tpu.labs.clientserver.amo import AMOResult
+        from dslabs_tpu.labs.clientserver.clientserver import (Reply,
+                                                               Request)
+        from dslabs_tpu.tpu.protocols.clientserver import REQ
+        from dslabs_tpu.tpu.trace import MessageTemplate
+
+        tag, c, s = int(rec[0]), int(rec[1]), int(rec[2])
+        server = LocalAddress(self.server_name)
+        client = LocalAddress(self.client_names[c])
+        if tag == REQ:
+            return client, server, Request(self._amo(c, s))
+        fallback = Reply(AMOResult(self.pairs[c][s - 1][1], s))
+        return server, client, MessageTemplate(
+            Reply, fallback, lambda m, s=s: m.result.sequence_num == s)
+
+    def _decode_timer(self, node_idx, rec):
+        from dslabs_tpu.core.address import LocalAddress
+        from dslabs_tpu.labs.clientserver.clientserver import ClientTimer
+        from dslabs_tpu.tpu.protocols.clientserver import CLIENT_MS
+
+        c, s = int(node_idx) - 1, int(rec[3])
+        return (LocalAddress(self.client_names[c]),
+                ClientTimer(self._amo(c, s)), CLIENT_MS, CLIENT_MS)
+
+    def predicate(self, tkey):
+        import jax.numpy as jnp
+
+        kind = tkey[0]
+        nc, w = self.nc, self.w
+
+        def k(s, c):
+            return s["nodes"][nc + c]
+
+        if kind in ("RESULTS_OK", "RESULTS_LINEARIZABLE",
+                    "ALL_RESULTS_SAME"):
+            return lambda s: k(s, 0) >= 0
+        if kind == "CLIENTS_DONE":
+            def fn(s):
+                done = jnp.asarray(True)
+                for c in range(nc):
+                    done = done & (k(s, c) == w + 1)
+                return done
+            return fn
+        if kind == "NONE_DECIDED":
+            def fn(s):
+                nd = jnp.asarray(True)
+                for c in range(nc):
+                    nd = nd & (k(s, c) == 1)
+                return nd
+            return fn
+        if kind == "CLIENT_DONE":
+            c = self.client_names.index(str(tkey[1].root_address()))
+            return lambda s: k(s, c) == w + 1
+        if kind == "CLIENT_HAS_RESULTS":
+            c = self.client_names.index(str(tkey[1].root_address()))
+            return lambda s: k(s, c) >= tkey[2] + 1
+        return None
+
+
+@register_adapter
+def match_pingpong(state):
+    from dslabs_tpu.labs.pingpong.pingpong import PingClient, PingServer
+
+    servers = state.servers
+    workers = state.client_workers()
+    if len(servers) != 1 or len(workers) != 1:
+        return None
+    if not all(isinstance(s, PingServer) for s in servers.values()):
+        return None
+    if not all(isinstance(wk.client, PingClient)
+               for wk in workers.values()):
+        return None
+    return PingPongBinding(state)
+
+
+@register_adapter
+def match_clientserver(state):
+    from dslabs_tpu.labs.clientserver.clientserver import (SimpleClient,
+                                                           SimpleServer)
+
+    servers = state.servers
+    workers = state.client_workers()
+    if len(servers) != 1 or not workers:
+        return None
+    if not all(isinstance(s, SimpleServer) for s in servers.values()):
+        return None
+    if not all(isinstance(wk.client, SimpleClient)
+               for wk in workers.values()):
+        return None
+    return ClientServerBinding(state)

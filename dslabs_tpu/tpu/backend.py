@@ -1,0 +1,442 @@
+"""The tensor engine as a harness-selectable search strategy.
+
+SURVEY §8.1: "a ``Search``/``SearchSettings``-shaped plugin point; the TPU
+backend is a new ``Search`` strategy selectable by settings" (reference
+entry points ``Search.bfs/dfs``, Search.java:390-402).  This module is
+that plugin point: :func:`tensor_bfs` accepts the SAME object
+``SearchState`` + ``SearchSettings`` the lab search tests build, runs the
+search on the TPU tensor engine via the lab's protocol twin, and returns
+an object ``SearchResults`` whose terminal states are REAL object states
+(reconstructed by trace replay on the object twin, tpu/trace.py) — so
+staged searches (``results.goal_matching_state`` fed into the next
+``bfs``) and trace assertions keep working unchanged.
+
+Pipeline per call:
+
+1. **Twin resolution** — registered :class:`TwinAdapter`\\ s inspect the
+   object state's node composition and return a :class:`TwinBinding`
+   (tensor protocol + address/command maps + lane predicates).  No twin =
+   loud :class:`NoTensorTwin`, never a silent object-path fallback.
+2. **Root derivation** — a depth-0 canonical state maps to the twin's
+   initial state.  A STAGED state (a goal state from a previous
+   tensor-backend phase) carries a :class:`TensorProvenance` history
+   (event ids + staged ops like dropPendingMessages); the tensor root is
+   re-derived by replaying that history through the twin's transition,
+   the exact inverse of how the object state itself was materialised.
+3. **Settings compilation** — the link matrix / sender / receiver /
+   network flags become a [NN, NN] delivery matrix (the twin's
+   ``deliver_message`` mask), per-node timer gating a [NN] vector, and
+   every invariant/goal/prune ``StatePredicate`` is translated to a lane
+   predicate via its ``tkey`` metadata (combinators translate
+   structurally).  Untranslatable predicate = loud NoTensorTwin.
+4. **Run** — ShardedTensorSearch, strict=True (drops are fatal: lab
+   verdicts must be exact), record_trace=True; capacity ladder retries
+   CapacityOverflow with doubled caps (no hand-tuned budgets).
+5. **Results adaptation** — end conditions map onto the object
+   ``EndCondition`` (the object checker treats the depth limit as a
+   prune, so tensor DEPTH_EXHAUSTED reports SPACE_EXHAUSTED); terminal
+   tensor states are replayed onto the object twin and re-checked with
+   the ORIGINAL object predicate — a twin/object verdict divergence
+   raises instead of returning a wrong answer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["NoTensorTwin", "TensorProvenance", "TwinBinding",
+           "register_adapter", "tensor_bfs", "tensor_dfs"]
+
+
+class NoTensorTwin(RuntimeError):
+    """No tensor twin / translation exists for this search configuration.
+
+    Raised loudly (the test errors) rather than silently falling back to
+    the object checker: ``--search-backend tensor`` must mean the tensor
+    engine actually ran the search."""
+
+
+@dataclasses.dataclass
+class TensorProvenance:
+    """How a staged object state was produced, in twin terms: the binding
+    config it belongs to and the ordered history of events and staged ops
+    (``("ev_msg", net_slot)``, ``("ev_tmr", node, queue_slot)``,
+    ``("drop",)``, ``("undrop_from", name)``, ``("undrop_to", name)``,
+    ``("undrop_all",)``) from the twin's initial state.  Events are
+    recorded CAP-INDEPENDENTLY — canonical network packing keeps occupied
+    slot indices identical across any net_cap >= occupancy, and timer
+    (node, queue-slot) pairs do not reference the grid stride — so the
+    history replays identically under a different capacity-ladder rung
+    than the one that recorded it.  Lets the next search phase re-derive
+    the tensor root without an object->tensor state encoder."""
+
+    key: tuple
+    history: List[tuple] = dataclasses.field(default_factory=list)
+
+
+def _norm_event(p, ev: int) -> tuple:
+    """Grid event id (relative to protocol p's caps) -> cap-independent
+    provenance op."""
+    if ev < p.net_cap:
+        return ("ev_msg", int(ev))
+    t = ev - p.net_cap
+    return ("ev_tmr", int(t) // p.timer_cap, int(t) % p.timer_cap)
+
+
+def _denorm_event(p, op: tuple) -> int:
+    if op[0] == "ev_msg":
+        if op[1] >= p.net_cap:
+            raise NoTensorTwin("provenance slot beyond net_cap")
+        return op[1]
+    if op[2] >= p.timer_cap:
+        raise NoTensorTwin("provenance timer slot beyond timer_cap")
+    return p.net_cap + op[1] * p.timer_cap + op[2]
+
+
+class TwinBinding:
+    """A resolved (object configuration -> tensor twin) binding.
+
+    Subclasses (one per lab family, see tpu/adapters/) provide:
+
+    - ``key``: hashable config identity (stable across staged phases)
+    - ``build_protocol(net_cap, timer_cap) -> TensorProtocol`` (no masks)
+    - ``addr_index``: root-address name -> twin node index
+    - ``predicate(tkey) -> fn(state_slice) -> bool`` lane predicate
+    - ``initial_caps() -> (net_cap, timer_cap)`` starting capacities
+    """
+
+    key: tuple = ()
+    addr_index: Dict[str, int] = {}
+
+    def build_protocol(self, net_cap: int, timer_cap: int):
+        raise NotImplementedError
+
+    def initial_caps(self) -> Tuple[int, int]:
+        raise NotImplementedError
+
+    def predicate(self, tkey) -> Callable:
+        raise NotImplementedError
+
+
+_ADAPTERS: List[Callable] = []
+
+
+def register_adapter(fn: Callable) -> Callable:
+    """Register ``fn(object_state) -> Optional[TwinBinding]``."""
+    _ADAPTERS.append(fn)
+    return fn
+
+
+def _load_adapters() -> None:
+    # Import for registration side effects; lazy to avoid jax import cost
+    # on the object path.
+    from dslabs_tpu.tpu.adapters import paxos as _p  # noqa: F401
+    from dslabs_tpu.tpu.adapters import simple as _s  # noqa: F401
+
+
+def resolve_binding(state) -> TwinBinding:
+    _load_adapters()
+    for fn in _ADAPTERS:
+        b = fn(state)
+        if b is not None:
+            return b
+    kinds = sorted({type(n).__name__ for n in state.nodes()})
+    raise NoTensorTwin(
+        f"no tensor twin adapter matches node composition {kinds} — "
+        "the tensor search backend only covers protocols with registered "
+        "twins (tpu/adapters/)")
+
+
+# ------------------------------------------------------------ predicates
+
+def translate_predicate(binding: TwinBinding, pred) -> Callable:
+    """Object StatePredicate -> twin lane predicate, recursing through
+    combinator structure; loud NoTensorTwin when untranslatable."""
+    import jax.numpy as jnp
+
+    st = getattr(pred, "structure", None)
+    if st is not None:
+        op = st[0]
+        subs = [translate_predicate(binding, q) for q in st[1:]]
+        if op == "not":
+            return lambda s, f=subs[0]: ~f(s)
+        if op == "and":
+            return lambda s, a=subs[0], b=subs[1]: a(s) & b(s)
+        if op == "or":
+            return lambda s, a=subs[0], b=subs[1]: a(s) | b(s)
+        if op == "implies":
+            return lambda s, a=subs[0], b=subs[1]: ~a(s) | b(s)
+    tkey = getattr(pred, "tkey", None)
+    if tkey is None:
+        raise NoTensorTwin(
+            f"predicate {pred.name!r} has no tensor translation key and "
+            "no combinator structure")
+    fn = binding.predicate(tkey)
+    if fn is None:
+        raise NoTensorTwin(
+            f"binding {binding.key} cannot translate predicate "
+            f"{pred.name!r} (tkey {tkey!r})")
+    return fn
+
+
+# -------------------------------------------------------------- settings
+
+def _addr_name(a) -> str:
+    return str(a.root_address())
+
+
+def compile_masks(binding: TwinBinding, settings):
+    """TestSettings network/timer gating -> (deliver_message fn,
+    deliver_timer fn) over twin lanes.  The delivery matrix reproduces
+    TestSettings.should_deliver's precedence exactly: link override ->
+    sender -> receiver -> network_active (testing/settings.py:138-151);
+    lookups are one-hot select-reduces, never traced-index gathers (the
+    measured ~1 GB/s pathology under the flat vmap)."""
+    import jax.numpy as jnp
+
+    idx = binding.addr_index
+    nn = len(idx)
+    names = {i: a for a, i in idx.items()}
+    mat = np.zeros((nn, nn), dtype=bool)
+    link = {(_addr_name(f), _addr_name(t)): v
+            for (f, t), v in settings._link_active.items()}
+    snd = {_addr_name(a): v for a, v in settings._sender_active.items()}
+    rcv = {_addr_name(a): v for a, v in settings._receiver_active.items()}
+    for fi in range(nn):
+        for ti in range(nn):
+            f, t = names[fi], names[ti]
+            v = link.get((f, t))
+            if v is None:
+                v = snd.get(f)
+            if v is None:
+                v = rcv.get(t)
+            if v is None:
+                v = settings._network_active
+            mat[fi, ti] = v
+    from dslabs_tpu.core.address import LocalAddress
+
+    tvec = np.array(
+        [settings.should_deliver_timer(LocalAddress(names[i]))
+         for i in range(nn)], dtype=bool)
+
+    deliver_msg = None
+    if not mat.all():
+        flat = jnp.asarray(mat.reshape(-1))
+        jnn = jnp.int32(nn)
+
+        def deliver_msg(msg, flat=flat, jnn=jnn, n2=nn * nn):
+            k = msg[1].clip(0, jnn - 1) * jnn + msg[2].clip(0, jnn - 1)
+            return jnp.sum(jnp.where(jnp.arange(n2) == k, flat, False))
+
+    deliver_tmr = None
+    if not tvec.all():
+        jt = jnp.asarray(tvec)
+
+        def deliver_tmr(node, jt=jt, nn=nn):
+            return jnp.sum(jnp.where(jnp.arange(nn) == node, jt, False))
+
+    return deliver_msg, deliver_tmr
+
+
+
+# ------------------------------------------------------------ state root
+
+def derive_root(binding: TwinBinding, search, state):
+    """Object initial state -> (tensor root pytree or None for the twin
+    initial, provenance history list).  Depth-0 canonical states map to
+    the twin initial; staged states replay their provenance history."""
+    import jax
+    import jax.numpy as jnp
+
+    from dslabs_tpu.tpu.engine import SENTINEL, flatten_state
+
+    prov = getattr(state, "_tensor_provenance", None)
+    if prov is None:
+        if state.depth != 0:
+            raise NoTensorTwin(
+                "staged search from a state with no tensor provenance "
+                "(depth {}) — only states produced by a previous "
+                "tensor-backend phase can seed a new phase".format(
+                    state.depth))
+        # Pre-search staged mutations on the pristine state (e.g.
+        # drop_pending_messages before the first bfs) are recorded on
+        # the instance and replayed like any provenance history.
+        staged = list(getattr(state, "_staged_ops", []))
+        prov = TensorProvenance(binding.key, staged)
+        if not staged:
+            return None, []
+    if prov.key != binding.key:
+        raise NoTensorTwin(
+            f"staged state's provenance {prov.key} does not match the "
+            f"current binding {binding.key}")
+    row_state = search.initial_state()
+    row = np.asarray(flatten_state(row_state))[0]
+    step = jax.jit(search._step_one)
+    p = search.p
+    o0, o1 = search._off[0], search._off[1]
+    dropped: List[np.ndarray] = []
+    for op in prov.history:
+        if op[0] in ("ev_msg", "ev_tmr"):
+            ev = _denorm_event(p, op)
+            succ, valid, _ = step(jnp.asarray(row), jnp.asarray(ev))
+            if not bool(valid):
+                raise NoTensorTwin(
+                    f"provenance replay hit undeliverable event {op!r}")
+            row = np.asarray(succ)
+        elif op[0] == "drop":
+            net = row[o0:o1].reshape(p.net_cap, p.msg_width)
+            dropped.extend(r.copy() for r in net if r[0] != SENTINEL)
+            row = row.copy()
+            row[o0:o1] = SENTINEL
+        elif op[0].startswith("undrop"):
+            net = row[o0:o1].reshape(p.net_cap, p.msg_width).copy()
+            want = (binding.addr_index[op[1]] if len(op) > 1 else None)
+            back = []
+            for r in dropped:
+                if op[0] == "undrop_from" and int(r[1]) != want:
+                    continue
+                if op[0] == "undrop_to" and int(r[2]) != want:
+                    continue
+                back.append(r)
+            have = [r for r in net if r[0] != SENTINEL]
+            merged = {tuple(r) for r in have} | {tuple(r) for r in back}
+            rows = sorted(merged)
+            if len(rows) > p.net_cap:
+                raise NoTensorTwin("undrop overflowed net capacity")
+            net[:] = SENTINEL
+            for i, r in enumerate(rows):
+                net[i] = r
+            row = row.copy()
+            row[o0:o1] = net.reshape(-1)
+        else:
+            raise NoTensorTwin(f"unknown staged op {op!r}")
+    return search.unflatten_rows(jnp.asarray(row[None])), list(prov.history)
+
+
+# ------------------------------------------------------------------- run
+
+# Capacity escalation ladder: (frontier_cap, visited_cap) per attempt,
+# with net/timer caps doubling alongside.  No hand-tuned budgets: every
+# CapacityOverflow retries one rung up, and the last failure is loud.
+_LADDER = [(1 << 14, 1 << 19), (1 << 17, 1 << 22), (1 << 19, 1 << 24)]
+
+
+def _run_tensor(binding: TwinBinding, settings, state, chunk=512):
+    import jax
+
+    from dslabs_tpu.tpu.engine import CapacityOverflow
+    from dslabs_tpu.tpu.sharded import ShardedTensorSearch, make_mesh
+
+    net_cap, timer_cap = binding.initial_caps()
+    mesh = make_mesh(len(jax.devices()))
+    last: Optional[Exception] = None
+    for attempt, (f_cap, v_cap) in enumerate(_LADDER):
+        protocol = binding.build_protocol(net_cap << attempt,
+                                          timer_cap + 2 * attempt)
+        dm, dt = compile_masks(binding, settings)
+        inv = {p.name: translate_predicate(binding, p)
+               for p in settings.invariants}
+        goals = {p.name: translate_predicate(binding, p)
+                 for p in settings.goals}
+        prunes = {p.name: translate_predicate(binding, p)
+                  for p in settings.prunes}
+        protocol = dataclasses.replace(
+            protocol, invariants=inv, goals=goals, prunes=prunes,
+            deliver_message=dm, deliver_timer=dt)
+        search = ShardedTensorSearch(
+            protocol, mesh, chunk_per_device=chunk, frontier_cap=f_cap,
+            visited_cap=v_cap, strict=True, record_trace=True)
+        root, history = derive_root(binding, search, state)
+        if settings.depth_limited():
+            rel = settings.max_depth - state.depth
+            if rel < 0:
+                raise NoTensorTwin("staged state already beyond max_depth")
+            search.max_depth = rel
+        if settings.max_time_secs is not None:
+            search.max_secs = settings.max_time_secs
+        try:
+            with jax.disable_jit(False):
+                outcome = search.run(initial=root)
+            return search, outcome, history
+        except CapacityOverflow as e:
+            last = e
+            continue
+    raise last
+
+
+def _materialize(binding, search, outcome, state, history):
+    """Tensor terminal state -> object SearchState via trace replay, with
+    provenance attached for the next staged phase."""
+    from dslabs_tpu.tpu.trace import replay_on_object
+
+    obj = replay_on_object(search, outcome, state)
+    obj._tensor_provenance = TensorProvenance(
+        binding.key, list(history) + [_norm_event(search.p, e)
+                                      for e in outcome.trace])
+    return obj
+
+
+def tensor_bfs(initial_state, settings=None):
+    """The tensor-strategy analog of search.bfs (Search.java:390-402 via
+    SURVEY §8.1): same inputs, same SearchResults contract."""
+    from dslabs_tpu.search.results import EndCondition, SearchResults
+    from dslabs_tpu.search.settings import SearchSettings
+
+    settings = settings if settings is not None else SearchSettings()
+    binding = resolve_binding(initial_state)
+    search, outcome, history = _run_tensor(binding, settings,
+                                           initial_state)
+    results = SearchResults(settings.invariants, settings.goals)
+    results.discovered_count = outcome.unique_states
+    end = outcome.end_condition
+    by_name = {p.name: p for p in (settings.invariants + settings.goals)}
+    if end == "GOAL_FOUND":
+        obj = _materialize(binding, search, outcome, initial_state,
+                           history)
+        pred = by_name[outcome.predicate_name]
+        r = pred.check(obj)
+        if not r.value:
+            raise NoTensorTwin(
+                f"twin/object divergence: tensor goal "
+                f"{outcome.predicate_name!r} does not hold on the "
+                "replayed object state")
+        results.goal_found(obj, r)
+        results.end_condition = EndCondition.GOAL_FOUND
+    elif end == "INVARIANT_VIOLATED":
+        obj = _materialize(binding, search, outcome, initial_state,
+                           history)
+        pred = by_name[outcome.predicate_name]
+        r = pred.check(obj)
+        if r.value:
+            raise NoTensorTwin(
+                f"twin/object divergence: tensor invariant violation "
+                f"{outcome.predicate_name!r} holds on the replayed "
+                "object state")
+        results.invariant_violated(obj, r)
+        results.end_condition = EndCondition.INVARIANT_VIOLATED
+    elif end == "EXCEPTION_THROWN":
+        obj = _materialize(binding, search, outcome, initial_state,
+                           history)
+        results.exception_thrown(obj)
+        results.end_condition = EndCondition.EXCEPTION_THROWN
+    elif end == "TIME_EXHAUSTED":
+        results.end_condition = EndCondition.TIME_EXHAUSTED
+    else:
+        # SPACE_EXHAUSTED, DEPTH_EXHAUSTED, CAPACITY_EXHAUSTED: the
+        # object checker treats the depth limit as a prune and reports
+        # SPACE_EXHAUSTED (Search.java:222-229).
+        results.end_condition = EndCondition.SPACE_EXHAUSTED
+    return results
+
+
+def tensor_dfs(initial_state, settings=None):
+    """Tensor strategy for dfs call sites.  The tensor engine has no
+    randomized DFS: a strict BFS under the same settings subsumes the
+    random probe's bug-finding power within the same time budget (every
+    state a random walk could reach at depth d is covered by BFS level d,
+    and the verdict vocabulary is identical), so dfs requests run the
+    BFS strategy.  RandomDFS remains the object-path default."""
+    return tensor_bfs(initial_state, settings)

@@ -62,14 +62,12 @@ HEARTBEAT_MS = 50
 CLIENT_MS = 100
 
 
-def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
-                        max_slots: int = 2, net_cap: int = 64,
-                        timer_cap: int = 8) -> TensorProtocol:
-    S = max_slots
-    NC = n_clients
-    maj = n // 2 + 1
-
-    # ---- server lane offsets
+def paxos_layout(n: int, n_clients: int, max_slots: int) -> dict:
+    """Server lane offsets of the packed node vector (see the module
+    docstring's lane table).  Shared by the twin factory and the harness
+    backend's lane predicates (tpu/adapters/paxos.py) so the two can
+    never drift."""
+    S, NC = max_slots, n_clients
     PEER = 8
     AMO = PEER + n
     PROP = AMO + NC
@@ -77,8 +75,23 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
     LOG = P2BV + S
     VOTES = LOG + 4 * S
     SW = VOTES + n * (1 + 4 * S)
-    NW = n * SW + NC                       # + one k lane per client
-    N_NODES = n + NC
+    return {"PEER": PEER, "AMO": AMO, "PROP": PROP, "P2BV": P2BV,
+            "LOG": LOG, "VOTES": VOTES, "SW": SW,
+            "NW": n * SW + NC, "N_NODES": n + NC}
+
+
+def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
+                        max_slots: int = 2, net_cap: int = 64,
+                        timer_cap: int = 8) -> TensorProtocol:
+    S = max_slots
+    NC = n_clients
+    maj = n // 2 + 1
+
+    # ---- server lane offsets (paxos_layout is the single source)
+    _L = paxos_layout(n, NC, S)
+    PEER, AMO, PROP = _L["PEER"], _L["AMO"], _L["PROP"]
+    P2BV, LOG, VOTES = _L["P2BV"], _L["LOG"], _L["VOTES"]
+    SW, NW, N_NODES = _L["SW"], _L["NW"], _L["N_NODES"]
 
     # ---- message layout: [tag, frm, to, p0..]  payload:
     #   REQ:   [client, seq]

@@ -30,7 +30,38 @@ import numpy as np
 from dslabs_tpu.testing.events import MessageEnvelope, TimerEnvelope
 from dslabs_tpu.tpu.engine import SearchOutcome, TensorSearch
 
-__all__ = ["decode_trace", "replay_on_object", "reconstruct_object_trace"]
+__all__ = ["decode_trace", "replay_on_object", "reconstruct_object_trace",
+           "MessageTemplate"]
+
+
+class MessageTemplate:
+    """A decoded message whose full payload the twin does not model (e.g.
+    a PaxosReply's application result value).  At replay time the
+    template resolves against the object state's OWN network — the object
+    execution that produced the network is the source of truth for
+    application-level values — falling back to ``fallback`` only when no
+    network message matches (e.g. the message was constructed but its
+    object counterpart was GC'd; ambiguity is a loud error, never a
+    guess)."""
+
+    def __init__(self, cls, fallback, match):
+        self.cls = cls
+        self.fallback = fallback
+        self.match = match
+
+    def resolve(self, state, frm, to):
+        cands = {m.message for m in state.network()
+                 if m.frm.root_address() == frm.root_address()
+                 and m.to.root_address() == to.root_address()
+                 and isinstance(m.message, self.cls)
+                 and self.match(m.message)}
+        if len(cands) == 1:
+            return next(iter(cands))
+        if not cands:
+            return self.fallback
+        raise ValueError(
+            f"ambiguous template resolution: {len(cands)} distinct "
+            f"{self.cls.__name__} candidates from {frm} to {to}")
 
 
 def decode_trace(search: TensorSearch,
@@ -82,6 +113,8 @@ def replay_on_object(search: TensorSearch, outcome: SearchOutcome,
     for kind, payload in decode_trace(search, outcome):
         if kind == "message":
             frm, to, msg = p.decode_message(payload[0])
+            if isinstance(msg, MessageTemplate):
+                msg = msg.resolve(state, frm, to)
             event = MessageEnvelope(frm, to, msg)
         else:
             node, rec = payload

@@ -33,6 +33,10 @@ class _GlobalSettings:
         self.results_output_file: Optional[str] = os.environ.get(
             "DSLABS_RESULTS_OUTPUT_FILE")
         self.log_level: str = os.environ.get("DSLABS_LOG_LEVEL", "WARNING")
+        # Search strategy: "object" (the Python graph checker) or
+        # "tensor" (the TPU engine via protocol twins, tpu/backend.py).
+        self.search_backend: str = os.environ.get(
+            "DSLABS_SEARCH_BACKEND", "object")
         # Temporarily-enabled error checks (@ChecksEnabled rule analog)
         self.error_checks_temporarily_enabled: bool = False
 

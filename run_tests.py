@@ -20,9 +20,6 @@ import argparse
 import os
 import sys
 
-# Lab tests are object-layer only, but keep any transitive jax import off
-# the accelerator (the bench owns the real chip).
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 LAB_TEST_MODULES = [
@@ -71,6 +68,11 @@ def _parse_args(argv):
     p.add_argument("-z", "--start-viz", action="store_true",
                    help="open the trace viewer on search-test failure")
     p.add_argument("-g", "--log-level", default=None, help="log level")
+    p.add_argument("--search-backend", choices=("object", "tensor"),
+                   default=None,
+                   help="search strategy for search tests: the object "
+                        "graph checker (default) or the TPU tensor "
+                        "engine via protocol twins (SURVEY §8.1)")
     p.add_argument("--results-file", default=None,
                    help="write JSON results to this file")
     p.add_argument("--replay-traces", action="store_true",
@@ -102,6 +104,8 @@ def _apply_flags(args) -> None:
 
         GlobalSettings.log_level = args.log_level
         logging.basicConfig(level=args.log_level.upper())
+    if args.search_backend:
+        GlobalSettings.search_backend = args.search_backend
 
 
 def _replay_traces() -> int:
@@ -175,6 +179,14 @@ def _visualize_trace(path: str) -> int:
 
 def main(argv=None) -> int:
     args = _parse_args(argv if argv is not None else sys.argv[1:])
+    # Object-backend runs keep any transitive jax import off the
+    # accelerator (the bench owns the real chip); the tensor backend —
+    # via flag or DSLABS_SEARCH_BACKEND — runs search tests ON it.  Must
+    # happen before _discover() imports anything jax-flavoured.
+    backend = args.search_backend or os.environ.get(
+        "DSLABS_SEARCH_BACKEND", "object")
+    if backend != "tensor":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
     _apply_flags(args)
 
     if args.replay_traces:

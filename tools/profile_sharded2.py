@@ -5,8 +5,11 @@ carry, then time progressively truncated variants of the genuine
 (each step consumes the previous carry) — independent-arg microbenchmarks
 lie on the axon platform.  Dev tool."""
 
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 
