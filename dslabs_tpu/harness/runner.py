@@ -180,8 +180,17 @@ def _run_one(entry: TestEntry, routers=None) -> TestResult:
 
 
 def _run_all(entries, out_router, err_router):
+    import gc
+
     results = []
     for e in entries:
+        # Inter-test isolation (BaseJUnitTest.java:111-191: GC + settle
+        # between tests): a collector pause or the previous test's
+        # late-stopping threads must not land inside the next test's
+        # wall-clock window (the lab run tests assert sub-second client
+        # wait bounds).
+        gc.collect()
+        time.sleep(0.05)
         print(SMALL_SEP)
         print(f"TEST {e.full_number}: {e.description} ({e.points}pts)")
         print(f"  START [{_now()}]...\n")

@@ -189,7 +189,8 @@ def _appends_linearizable(addresses):
         return True, None
 
     return StatePredicate(
-        "Sequence of appends to the same key is linearizable", check)
+        "Sequence of appends to the same key is linearizable", check,
+        tkey=("RESULTS_LINEARIZABLE",))
 
 
 APPENDS_LINEARIZABLE = _appends_linearizable(None)

@@ -180,7 +180,9 @@ def _all_results_same(state) -> Tuple[bool, Optional[str]]:
     return True, None
 
 
-ALL_RESULTS_SAME = StatePredicate("All clients' results same", _all_results_same)
+ALL_RESULTS_SAME = StatePredicate("All clients' results same",
+                                  _all_results_same,
+                                  tkey=("ALL_RESULTS_SAME",))
 
 
 def all_results_match(predicate: Callable[[Any], bool],

@@ -86,18 +86,16 @@ class PaxosBinding(TwinBinding):
                 "models a uniform per-client command count")
         self.w = sizes.pop()
         self.S = self.w * self.nc
-        # command object -> twin cmd id (and expected result by id)
-        self.cmd_ids: Dict[object, int] = {}
+        # command object -> twin cmd ids (clients may send EQUAL raw
+        # commands — each occurrence has its own id; has_command matches
+        # any of them, exactly the object predicate's equality)
+        self.cmd_ids: Dict[object, list] = {}
         self.cmd_objs: Dict[int, object] = {}
         self.results: Dict[int, object] = {}
         for c, plist in enumerate(pairs):
             for k, (cmd, res) in enumerate(plist, start=1):
                 cid = c * self.w + k
-                if cmd in self.cmd_ids:
-                    raise NoTensorTwin(
-                        f"duplicate workload command {cmd!r} across "
-                        "clients — command ids would be ambiguous")
-                self.cmd_ids[cmd] = cid
+                self.cmd_ids.setdefault(cmd, []).append(cid)
                 self.cmd_objs[cid] = cmd
                 if res is not None:
                     self.results[cid] = res
@@ -341,8 +339,8 @@ class PaxosBinding(TwinBinding):
         if kind == "PAXOS_HAS_COMMAND":
             i = self.server_names.index(str(tkey[1].root_address()))
             slot, cmd = tkey[2], tkey[3]
-            cid = self.cmd_ids.get(cmd)
-            if cid is None or not 1 <= slot <= S:
+            cids = self.cmd_ids.get(cmd)
+            if not cids or not 1 <= slot <= S:
                 # A command no client ever sends (or an out-of-range
                 # slot) can never be in a log: constant false, exactly
                 # the object predicate's value.
@@ -352,7 +350,10 @@ class PaxosBinding(TwinBinding):
                 cl = self._lane(s, i, 5)
                 ex = self._log(s, i, slot, 0) == 1
                 c = self._log(s, i, slot, 2)
-                return (jnp.asarray(slot) > cl) & ex & (c == cid)
+                hit = jnp.asarray(False)
+                for cid in cids:
+                    hit = hit | (c == cid)
+                return (jnp.asarray(slot) > cl) & ex & hit
             return fn
         return None
 

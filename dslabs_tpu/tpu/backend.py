@@ -274,8 +274,17 @@ def derive_root(binding: TwinBinding, search, state):
             f"current binding {binding.key}")
     row_state = search.initial_state()
     row = np.asarray(flatten_state(row_state))[0]
-    step = jax.jit(search._step_one)
-    p = search.p
+    # Replay UNMASKED: the history's events were valid under the masks
+    # of the phases that produced them, not under THIS phase's masks
+    # (e.g. a deliver_timers(False) phase 3 must still replay phase 1's
+    # election timers).  Masks only gate validity, never the transition,
+    # so unmasked replay reproduces each original successor exactly.
+    p = dataclasses.replace(search.p, deliver_message=None,
+                            deliver_timer=None)
+    from dslabs_tpu.tpu.engine import TensorSearch as _TS
+
+    replayer = _TS(p, chunk=1)
+    step = jax.jit(replayer._step_one)
     o0, o1 = search._off[0], search._off[1]
     dropped: List[np.ndarray] = []
     for op in prov.history:
@@ -350,16 +359,25 @@ def _run_tensor(binding: TwinBinding, settings, state, chunk=512):
             protocol, mesh, chunk_per_device=chunk, frontier_cap=f_cap,
             visited_cap=v_cap, strict=True, record_trace=True)
         root, history = derive_root(binding, search, state)
+        rel = None
         if settings.depth_limited():
             rel = settings.max_depth - state.depth
             if rel < 0:
                 raise NoTensorTwin("staged state already beyond max_depth")
-            search.max_depth = rel
-        if settings.max_time_secs is not None:
-            search.max_secs = settings.max_time_secs
         try:
-            with jax.disable_jit(False):
-                outcome = search.run(initial=root)
+            if settings.max_time_secs is not None and (
+                    rel is None or rel > 2):
+                # Warm-up excludes compile time from the test's time
+                # budget (the reference charges neither JIT nor class
+                # loading to maxTime; on the accelerator a cold twin
+                # compile alone can exceed a 30 s search budget).  A
+                # phase within 2 levels of its depth limit skips it —
+                # the warm-up WOULD BE the whole search.
+                search.max_depth = 2
+                search.run(initial=root, check_initial=False)
+            search.max_depth = rel
+            search.max_secs = settings.max_time_secs
+            outcome = search.run(initial=root)
             return search, outcome, history
         except CapacityOverflow as e:
             last = e

@@ -143,7 +143,15 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
     # engine's set-insert merge is O(MAX_SENDS x NET_CAP) compares per
     # (state, event) pair, so every blank pad row widens the hot loop.
     SRV_SENDS = 7 + 2 * (n - 1) + S * (n - 1) + 3 * S
-    SRV_SETS = 2
+    if n == 1:
+        # Singleton: every send_p2a call site completes the agreement
+        # inline (choose + exec_chain), so each of the up-to-(2S + 2)
+        # call sites can add S reply rows on top of the base budget.
+        SRV_SENDS += (2 * S + 2) * S
+    # n == 1: the ElectionTimer handler runs the full win cascade (self
+    # vote = majority), adding the leader's heartbeat re-arm as a third
+    # set row on the timer path.
+    SRV_SETS = 3 if n == 1 else 2
     CLI_SENDS, CLI_SETS = n, 1
     MAX_SENDS = SRV_SENDS + CLI_SENDS
     MAX_SETS = SRV_SETS + CLI_SETS
@@ -360,6 +368,18 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
         accept_p2a(st, i, ballot, slot, e[2], cond)
         _set(st, "hd", i, jnp.where(cond, 1, st["hd"][i]))
         record_own_p2b(st, i, ballot, slot, cond)
+        if n == 1:
+            # Singleton: the self-vote IS the majority — choose and
+            # execute inside the proposing transition, exactly the
+            # object's synchronous P2a -> P2b self-delivery cascade
+            # (_send_to_all, paxos.py:238-241).
+            e1 = log_get(st, i, slot)
+            ch = cond & (e1[0] == 1) & (e1[3] == 0) & (e1[1] == ballot)
+            row = st["p2bv"][i]
+            st["p2bv"] = st["p2bv"].at[i].set(jnp.where(
+                (jnp.arange(S) == slot - 1) & ch, 0, row))
+            log_set(st, i, slot, [1, e1[1], e1[2], 1], ch)
+            exec_chain(st, i, sends, ch)
 
     def heartbeat_sends(st, i, sends: Sends, cond):
         ballot = st["b"][i]
@@ -665,7 +685,13 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
                                st["log"][i].reshape(4 * S)])
         st["votes"] = st["votes"].at[i, i].set(
             jnp.where(elect, own, st["votes"][i][i]))
-        # (majority with one vote only when n == 1 — not modelled here)
+        if n == 1:
+            # Singleton group: our own vote IS the majority — the object
+            # server wins phase 1 inside the same ElectionTimer handler
+            # (_send_to_all self-delivers P1a -> P1b -> handle_P1b,
+            # paxos.py:238-241), so the twin fires the win cascade here
+            # (it arms the leader heartbeat itself).
+            _p1b_win(st, i, elect, sends, sets)
         _set(st, "hd", i, jnp.where(is_el, 0, st["hd"][i]))
         sets.add(is_el, i, T_ELECTION, ELECTION_MIN, ELECTION_MAX, 0)
 

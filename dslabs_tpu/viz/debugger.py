@@ -102,6 +102,28 @@ class EventTree:
 
     # ------------------------------------------------------------- JSON
 
+    def tree_json(self) -> dict:
+        """The whole explored tree (StateTreeCanvas.java capability):
+        one record per node, DFS-ordered so the client can lay out
+        subtrees contiguously."""
+        order: List[int] = []
+        with self._lock:
+            # Iterative DFS: preloaded traces can be thousands of events
+            # deep — recursion would overflow inside the HTTP handler.
+            stack = [0]
+            while stack:
+                nid = stack.pop()
+                order.append(nid)
+                kids = [cid for _, cid in
+                        sorted(self.nodes[nid].children.items())]
+                stack.extend(reversed(kids))
+            return {"nodes": [{
+                "id": nid,
+                "parent": self.nodes[nid].parent,
+                "depth": self.nodes[nid].depth,
+                "event": self.nodes[nid].event_repr[:80],
+            } for nid in order]}
+
     def node_json(self, node_id: int) -> dict:
         node = self.nodes[node_id]
         parent = (self.nodes[node.parent] if node.parent is not None
@@ -163,6 +185,16 @@ _APP = """<!DOCTYPE html>
  .field .k { color: #9aa7b5 }
  .changed { background: #3d3118; border-radius: 3px; }
  .small { font-size: 12px; color: #9aa7b5 }
+ #treewrap { background: #1a212b; border-radius: 6px; margin: 0 16px 12px;
+             padding: 8px; overflow: auto; max-height: 260px; }
+ #treewrap h3 { margin: 0 0 4px; color: #8ab4f8; font-size: 14px; }
+ #tree circle { cursor: pointer; fill: #2b3a4d; stroke: #56718f; }
+ #tree circle:hover { fill: #3b4f68; }
+ #tree circle.onpath { fill: #24503d; stroke: #7fd1b9; }
+ #tree circle.cur { fill: #e8c268; stroke: #e8c268; }
+ #tree line { stroke: #31404f; stroke-width: 1.2; }
+ #tree line.onpath { stroke: #7fd1b9; stroke-width: 2; }
+ #tree text { fill: #9aa7b5; font-size: 9px; pointer-events: none; }
 </style></head><body>
 <header>
  <b>dslabs debugger</b>
@@ -171,6 +203,8 @@ _APP = """<!DOCTYPE html>
  <span id="count" class="small"></span>
 </header>
 <div id="crumb"></div>
+<div id="treewrap"><h3>explored tree (click a node to jump)</h3>
+ <svg id="tree" width="100" height="100"></svg></div>
 <div class="cols">
  <div class="events"><h3>pending events (click to deliver)</h3>
    <div id="pending"></div></div>
@@ -193,10 +227,59 @@ function fields(curF, prevF) {
              ` (deleted)</div>`;
   return out;
 }
+let treeCache = null, treeCacheN = -1;
+async function drawTree(pathIds, nNodes) {
+  if (treeCacheN !== nNodes) {
+    const r = await fetch(`/tree`);
+    treeCache = await r.json();
+    treeCacheN = nNodes;
+  }
+  const d = treeCache;
+  const dx = 46, dy = 26, r0 = 7;
+  const pos = {};                       // id -> [x, y]
+  let row = 0;
+  // DFS order from the server: a node's y is its subtree's first free
+  // row; depth sets x — the classic left-to-right layered tree.
+  const seenDepth = {};
+  for (const n of d.nodes) {
+    if (n.parent === null) { pos[n.id] = [0, row]; continue; }
+    // place on parent's row if free, else next free row
+    const py = pos[n.parent][1];
+    let y = py;
+    while (seenDepth[n.depth] !== undefined && y <= seenDepth[n.depth])
+      y = seenDepth[n.depth] + 1;
+    seenDepth[n.depth] = y;
+    pos[n.id] = [n.depth, y];
+    row = Math.max(row, y);
+  }
+  const onPath = new Set(pathIds);
+  let maxX = 0, maxY = 0;
+  let edges = "", nodes = "";
+  for (const n of d.nodes) {
+    const [x, y] = pos[n.id];
+    maxX = Math.max(maxX, x); maxY = Math.max(maxY, y);
+    if (n.parent !== null) {
+      const [px, py] = pos[n.parent];
+      const cls = onPath.has(n.id) && onPath.has(n.parent) ? "onpath" : "";
+      edges += `<line class="${cls}" x1="${px*dx+16}" y1="${py*dy+16}" ` +
+               `x2="${x*dx+16}" y2="${y*dy+16}"><title></title></line>`;
+    }
+    const cls = n.id === cur ? "cur" : (onPath.has(n.id) ? "onpath" : "");
+    nodes += `<circle class="${cls}" cx="${x*dx+16}" cy="${y*dy+16}" ` +
+             `r="${r0}" onclick="load(${n.id})">` +
+             `<title>#${n.id} d${n.depth}: ${esc(n.event)}</title></circle>` +
+             `<text x="${x*dx+12}" y="${y*dy+35}">${n.id}</text>`;
+  }
+  const svg = document.getElementById("tree");
+  svg.setAttribute("width", maxX*dx+40);
+  svg.setAttribute("height", maxY*dy+44);
+  svg.innerHTML = edges + nodes;
+}
 async function load(id) {
   const r = await fetch(`/node/${id}`);
   const d = await r.json();
   cur = d.id;
+  drawTree(d.path.map(p => p.id), d.n_nodes);
   document.getElementById("pos").textContent =
     `node ${d.id} · depth ${d.depth}`;
   document.getElementById("count").textContent =
@@ -278,6 +361,8 @@ def serve_debugger(initial_state, settings=None, port: int = 0,
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif self.path == "/tree":
+                self._json(tree.tree_json())
             elif self.path.startswith("/node/"):
                 try:
                     node_id = int(self.path[len("/node/"):])

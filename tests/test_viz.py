@@ -141,3 +141,37 @@ def test_debugger_http_roundtrip():
     finally:
         server.shutdown()
         server.server_close()
+
+
+def test_debugger_tree_canvas_endpoint():
+    """The /tree endpoint (StateTreeCanvas capability): the whole
+    explored tree is served DFS-ordered with parent links, and the HTML
+    app embeds the canvas renderer."""
+    import json
+    import urllib.request
+
+    from dslabs_tpu.viz.debugger import serve_debugger
+
+    state = viz_configs()["0"](["1", "1", "ping1"])
+    server, tree = serve_debugger(state, open_browser=False, block=False)
+    try:
+        port = server.server_address[1]
+        # Explore two branches from the root.
+        tree.step(0, 0)
+        child2 = tree.step(0, 1) if len(tree.pending(0)) > 1 else None
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/tree", timeout=5) as r:
+            t = json.loads(r.read())
+        ids = [n["id"] for n in t["nodes"]]
+        assert ids[0] == 0 and 1 in ids
+        by_id = {n["id"]: n for n in t["nodes"]}
+        assert by_id[1]["parent"] == 0 and by_id[1]["depth"] == 1
+        if child2 is not None:
+            assert by_id[child2]["parent"] == 0
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/", timeout=5) as r:
+            html = r.read().decode()
+        assert "drawTree" in html and 'id="tree"' in html
+    finally:
+        server.shutdown()
+        server.server_close()
