@@ -236,3 +236,218 @@ def match_clientserver(state):
                for wk in workers.values()):
         return None
     return ClientServerBinding(state)
+
+
+class PrimaryBackupBinding(TwinBinding):
+    """Lab 2: ViewServer + NS PBServers + NC ClientWorker(PBClient)s with
+    finite KV workloads; twin node indices: viewserver 0, server{s} -> s,
+    client c -> NS + 1 + c (tpu/protocols/primarybackup.py lane table).
+    The StateTransfer's full application payload — the one field the twin
+    collapses to per-client AMO seqs — resolves from the replayed object
+    state's network, discriminated by (view_num, per-client last-executed
+    seqs), which is exact within the twin's collapse."""
+
+    def __init__(self, state):
+        workers = state.client_workers()
+        servers = [a for a in state.servers
+                   if _num_suffix(str(a), "server") is not None]
+        vs = [a for a in state.servers if str(a) not in
+              {str(s) for s in servers}]
+        if len(vs) != 1:
+            raise NoTensorTwin("expected exactly one ViewServer")
+        self.vs_name = str(vs[0])
+        servers.sort(key=lambda a: _num_suffix(str(a), "server"))
+        clients = sorted(workers,
+                         key=lambda a: _num_suffix(str(a), "client") or 0)
+        self.server_names = [str(a) for a in servers]
+        self.client_names = [str(a) for a in clients]
+        self.ns, self.nc = len(servers), len(clients)
+        self.addr_index = {self.vs_name: 0}
+        self.addr_index.update(
+            {s: 1 + i for i, s in enumerate(self.server_names)})
+        self.addr_index.update(
+            {c: 1 + self.ns + j for j, c in enumerate(self.client_names)})
+        pairs = [_workload_pairs(workers[a], a) for a in clients]
+        sizes = {len(p) for p in pairs}
+        if len(sizes) != 1:
+            raise NoTensorTwin(
+                f"per-client workload sizes differ ({sizes})")
+        self.w = sizes.pop()
+        self.pairs = pairs
+        self.key = ("primarybackup", self.vs_name,
+                    tuple(self.server_names), tuple(self.client_names),
+                    tuple(repr(c) for p in pairs for c, _ in p))
+
+    def initial_caps(self):
+        return 32, 4
+
+    def build_protocol(self, net_cap, timer_cap):
+        from dslabs_tpu.tpu.protocols.primarybackup import make_pb_protocol
+
+        p = make_pb_protocol(ns=self.ns, n_clients=self.nc, w=self.w,
+                             net_cap=net_cap, timer_cap=timer_cap)
+        return dataclasses.replace(
+            p, decode_message=self._decode_message,
+            decode_timer=self._decode_timer)
+
+    # ------------------------------------------------------------ decoders
+
+    def _addr(self, idx):
+        from dslabs_tpu.core.address import LocalAddress
+
+        names = [self.vs_name] + self.server_names + self.client_names
+        return LocalAddress(names[int(idx)])
+
+    def _view(self, vn, prim, back):
+        from dslabs_tpu.labs.primarybackup.viewserver import View
+
+        return View(int(vn),
+                    self._addr(prim) if prim else None,
+                    self._addr(back) if back else None)
+
+    def _amo(self, c, s):
+        from dslabs_tpu.core.address import LocalAddress
+        from dslabs_tpu.labs.clientserver.amo import AMOCommand
+
+        return AMOCommand(self.pairs[c][s - 1][0],
+                          LocalAddress(self.client_names[c]), s)
+
+    def _decode_message(self, rec):
+        from dslabs_tpu.labs.clientserver.amo import AMOResult
+        from dslabs_tpu.labs.primarybackup import pb as P
+        from dslabs_tpu.labs.primarybackup import viewserver as V
+        from dslabs_tpu.tpu.protocols.primarybackup import (
+            FWD, FWDACK, GETVIEW, PING, REPLY, REQ, VIEWREPLY, XFER,
+            XFERACK)
+        from dslabs_tpu.tpu.trace import MessageTemplate
+
+        r = [int(x) for x in rec]
+        tag, frm, to, p = r[0], r[1], r[2], r[3:]
+        fa, ta = self._addr(frm), self._addr(to)
+        if tag == PING:
+            return fa, ta, V.Ping(p[0])
+        if tag == GETVIEW:
+            return fa, ta, V.GetView()
+        if tag == VIEWREPLY:
+            return fa, ta, V.ViewReply(self._view(p[0], p[1], p[2]))
+        if tag == REQ:
+            return fa, ta, P.Request(self._amo(p[0], p[1]))
+        if tag == REPLY:
+            c, s = p[0], p[1]
+            fallback = P.Reply(AMOResult(self.pairs[c][s - 1][1], s))
+            return fa, ta, MessageTemplate(
+                P.Reply, fallback,
+                lambda m, s=s: m.result.sequence_num == s)
+        if tag == FWD:
+            return fa, ta, P.ForwardRequest(p[0], self._amo(p[1], p[2]))
+        if tag == FWDACK:
+            return fa, ta, P.ForwardAck(p[0], self._amo(p[1], p[2]))
+        if tag == XFER:
+            vn, amo = p[0], p[3:3 + self.nc]
+
+            def match(m, vn=vn, amo=tuple(amo)):
+                from dslabs_tpu.core.address import LocalAddress
+
+                if m.view.view_num != vn:
+                    return False
+                for c, want in enumerate(amo):
+                    got = m.app.last.get(
+                        LocalAddress(self.client_names[c]))
+                    if (got[0] if got else 0) != want:
+                        return False
+                return True
+
+            return fa, ta, MessageTemplate(P.StateTransfer, None, match)
+        if tag == XFERACK:
+            return fa, ta, P.StateTransferAck(p[0])
+        raise NoTensorTwin(f"unknown pb message tag {tag}")
+
+    def _decode_timer(self, node_idx, rec):
+        from dslabs_tpu.labs.primarybackup import pb as P
+        from dslabs_tpu.labs.primarybackup import viewserver as V
+        from dslabs_tpu.tpu.protocols.primarybackup import (
+            CLIENT_MS, PING_MS, PINGCHECK_MS, T_CLIENT, T_PING,
+            T_PINGCHECK)
+
+        tag, p0 = int(rec[0]), int(rec[3])
+        a = self._addr(node_idx)
+        if tag == T_PINGCHECK:
+            return a, V.PingCheckTimer(), PINGCHECK_MS, PINGCHECK_MS
+        if tag == T_PING:
+            return a, P.PingTimer(), PING_MS, PING_MS
+        if tag == T_CLIENT:
+            c = int(node_idx) - 1 - self.ns
+            return a, P.ClientTimer(self._amo(c, p0)), CLIENT_MS, CLIENT_MS
+        raise NoTensorTwin(f"unknown pb timer tag {tag}")
+
+    # ---------------------------------------------------------- predicates
+
+    def predicate(self, tkey):
+        import jax.numpy as jnp
+
+        from dslabs_tpu.tpu.protocols.primarybackup import make_pb_protocol  # noqa: F401
+
+        kind = tkey[0]
+        ns, nc, w = self.ns, self.nc, self.w
+        VSW = 5 + 2 * ns
+        SW = 6 + nc
+        cb = VSW + ns * SW
+
+        def k(s, c):
+            return s["nodes"][cb + c * 4]
+
+        if kind in ("RESULTS_OK", "RESULTS_LINEARIZABLE",
+                    "ALL_RESULTS_SAME"):
+            return lambda s: k(s, 0) >= 0
+        if kind == "CLIENTS_DONE":
+            def fn(s):
+                done = jnp.asarray(True)
+                for c in range(nc):
+                    done = done & (k(s, c) == w + 1)
+                return done
+            return fn
+        if kind == "NONE_DECIDED":
+            def fn(s):
+                nd = jnp.asarray(True)
+                for c in range(nc):
+                    nd = nd & (k(s, c) == 1)
+                return nd
+            return fn
+        if kind == "CLIENT_DONE":
+            c = self.client_names.index(str(tkey[1].root_address()))
+            return lambda s: k(s, c) == w + 1
+        if kind == "CLIENT_HAS_RESULTS":
+            c = self.client_names.index(str(tkey[1].root_address()))
+            return lambda s: k(s, c) >= tkey[2] + 1
+        if kind == "PB_VIEW_SYNCED":
+            vn = tkey[1]
+            pi = self.server_names.index(tkey[2]) + 1
+            bi = self.server_names.index(tkey[3]) + 1
+
+            def fn(s):
+                def srv(i, off):
+                    return s["nodes"][VSW + i * SW + off]
+                ok = jnp.asarray(True)
+                for i in range(ns):
+                    ok = ok & (srv(i, 0) == vn) & (srv(i, 3) == 1)
+                return ok & (srv(0, 1) == pi) & (srv(0, 2) == bi)
+            return fn
+        return None
+
+
+@register_adapter
+def match_primarybackup(state):
+    from dslabs_tpu.labs.primarybackup.pb import PBClient, PBServer
+    from dslabs_tpu.labs.primarybackup.viewserver import ViewServer
+
+    servers = state.servers
+    workers = state.client_workers()
+    if not servers or not workers:
+        return None
+    kinds = {type(s) for s in servers.values()}
+    if kinds != {ViewServer, PBServer}:
+        return None
+    if not all(isinstance(wk.client, PBClient)
+               for wk in workers.values()):
+        return None
+    return PrimaryBackupBinding(state)

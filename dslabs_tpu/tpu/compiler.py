@@ -1,0 +1,450 @@
+"""Protocol schema compiler: declarative bounded-state specs -> tensor
+twins (SURVEY §8.1 "Protocol IR ... schema compiler for bounded protocol
+state").
+
+The hand-written twins in ``tpu/protocols/`` are expert artifacts: lane
+layouts, one-hot muxing, send/set row budgeting, SENTINEL discipline.
+This module mechanises exactly that layer.  A :class:`ProtocolSpec`
+declares what the reference framework gets from a ``Node`` subclass —
+node kinds with bounded integer fields, message/timer types with
+payload fields, and handlers — and ``compile()`` derives the
+:class:`~dslabs_tpu.tpu.engine.TensorProtocol`:
+
+- fields -> packed node lanes (layout, offsets, init vector),
+- message/timer enums -> tags + fixed-width records,
+- handlers -> the engine's ``step_message``/``step_timer`` contract,
+  with per-(kind, instance, type) guard conditions, jnp.where field
+  merges, and exact send/set row budgets counted from the handler's
+  ``ctx.send``/``ctx.set_timer`` calls (finalize-style loud assertion,
+  never truncation).
+
+Handlers are plain Python functions written against the tiny
+:class:`Ctx` combinator API — reads, conditional writes, sends, timer
+sets, and integer arithmetic on traced scalars — NOT raw jax: the
+compiler owns every tensor-shape decision, which is what makes a new
+protocol searchable without twin-authoring expertise (the reference
+analog: any Node subclass is searchable for free,
+framework/src/dslabs/framework/Node.java:106-602 + Search.java:405-505).
+
+First-cut scope (deliberate): single-instance node kinds with scalar
+or small-array int fields, handlers without cross-node reads (exactly
+the Node contract — nodes communicate only by messages/timers).  The
+lab 0 and lab 1 specs in ``tpu/specs.py`` compile to twins that match
+the hand-written ones state-for-state (tests/test_compiler.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["Field", "MessageType", "TimerType", "NodeKind",
+           "ProtocolSpec", "Ctx"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Field:
+    """A bounded int field of a node: scalar (size 1) or a small int
+    array (size > 1).  ``init`` is an int or a per-instance callable
+    ``(instance_index) -> int | list``."""
+
+    name: str
+    size: int = 1
+    init: object = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class MessageType:
+    name: str
+    fields: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class TimerType:
+    name: str
+    fields: Tuple[str, ...] = ()
+    min_ms: int = 10
+    max_ms: int = 10
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeKind:
+    """``count`` instances of a node kind, each with the same fields.
+    Twin node indices are assigned kind-by-kind in declaration order."""
+
+    name: str
+    count: int
+    fields: Tuple[Field, ...]
+
+
+class Ctx:
+    """Handler combinator context for ONE (kind, instance) under ONE
+    guard condition.  All mutation is conditional on the guard (and any
+    ``when`` refinement): the compiler merges every branch with
+    jnp.where, exactly the hand-twin discipline."""
+
+    def __init__(self, spec, st, kind, idx, cond, sends, sets):
+        self._spec = spec
+        self._st = st
+        self._kind = kind
+        self._idx = idx
+        self._cond = cond
+        self._sends = sends
+        self._sets = sets
+
+    # ---------------------------------------------------------- accessors
+
+    def get(self, field: str):
+        """Current value of ``field`` (scalar, or [size] vector)."""
+        return self._st[(self._kind, self._idx, field)]
+
+    def put(self, field: str, value, when=True):
+        """Conditionally set ``field`` (guard & when)."""
+        import jax.numpy as jnp
+
+        key = (self._kind, self._idx, field)
+        cur = self._st[key]
+        val = jnp.asarray(value, jnp.int32)
+        self._st[key] = jnp.where(self._cond & when, val, cur).astype(
+            jnp.int32)
+
+    def get_at(self, field: str, i):
+        """Dynamic element read of an array field — one-hot select, the
+        engine's static-indexing rule (traced-index gathers are the
+        measured vmap pathology).  Size-1 array fields unpack as
+        scalars; treat them as one-element vectors."""
+        import jax.numpy as jnp
+
+        vec = jnp.atleast_1d(self.get(field))
+        oh = jnp.arange(vec.shape[0]) == i
+        return jnp.sum(jnp.where(oh, vec, 0))
+
+    def put_at(self, field: str, i, value, when=True):
+        import jax.numpy as jnp
+
+        key = (self._kind, self._idx, field)
+        cur = self._st[key]
+        vec = jnp.atleast_1d(cur)
+        oh = (jnp.arange(vec.shape[0]) == i) & self._cond & when
+        out = jnp.where(oh, jnp.asarray(value, jnp.int32), vec).astype(
+            jnp.int32)
+        self._st[key] = out if cur.ndim else out[0]
+
+    def cond(self, extra):
+        """A refined child context (guard & extra) for nested logic."""
+        return Ctx(self._spec, self._st, self._kind, self._idx,
+                   self._cond & extra, self._sends, self._sets)
+
+    # ------------------------------------------------------------ effects
+
+    def send(self, msg: str, to, when=True, **fields):
+        self._sends.append(
+            (self._spec._msg_row(msg, self.node_index(), to, fields),
+             self._cond & when))
+
+    def set_timer(self, timer: str, when=True, **fields):
+        self._sets.append(
+            (self._spec._timer_row(timer, self.node_index(), fields),
+             self._cond & when))
+
+    def node_index(self):
+        return self._spec._node_index(self._kind, self._idx)
+
+
+class ProtocolSpec:
+
+    def __init__(self, name: str,
+                 nodes: Sequence[NodeKind],
+                 messages: Sequence[MessageType],
+                 timers: Sequence[TimerType],
+                 net_cap: int = 16,
+                 timer_cap: int = 4):
+        self.name = name
+        self.nodes = list(nodes)
+        self.messages = list(messages)
+        self.timers = list(timers)
+        self.net_cap = net_cap
+        self.timer_cap = timer_cap
+        # (kind, message/timer name) -> handler(ctx, payload dict)
+        self.handlers: Dict[Tuple[str, str], Callable] = {}
+        self.timer_handlers: Dict[Tuple[str, str], Callable] = {}
+        self.initial_messages: List[tuple] = []   # (msg, frm, to, fields)
+        self.initial_timers: List[tuple] = []     # (timer, node, fields)
+        self.goals: Dict[str, Callable] = {}      # name -> fn(view)
+        self.invariants: Dict[str, Callable] = {}
+        self.decode_message: Optional[Callable] = None
+        self.decode_timer: Optional[Callable] = None
+        self._mtag = {m.name: i for i, m in enumerate(self.messages)}
+        self._mspec = {m.name: m for m in self.messages}
+        # Timer tag 0 is reserved (SENTINEL-adjacent "no tag") to keep
+        # records visibly distinct from zeroed lanes.
+        self._ttag = {t.name: 1 + i for i, t in enumerate(self.timers)}
+        self._tspec = {t.name: t for t in self.timers}
+        self._mw = 3 + max((len(m.fields) for m in self.messages),
+                           default=0)
+        self._tw = 3 + max((len(t.fields) for t in self.timers),
+                           default=0)       # [tag, min, max, fields...]
+
+    # ------------------------------------------------------------- layout
+
+    def on(self, kind: str, msg: str):
+        def reg(fn):
+            self.handlers[(kind, msg)] = fn
+            return fn
+        return reg
+
+    def on_timer(self, kind: str, timer: str):
+        def reg(fn):
+            self.timer_handlers[(kind, timer)] = fn
+            return fn
+        return reg
+
+    def _instances(self):
+        for kind in self.nodes:
+            for i in range(kind.count):
+                yield kind, i
+
+    def _node_index(self, kind_name: str, idx: int) -> int:
+        base = 0
+        for kind in self.nodes:
+            if kind.name == kind_name:
+                return base + idx
+            base += kind.count
+        raise KeyError(kind_name)
+
+    def _layout(self):
+        """(kind, idx, field) -> (offset, size); total width."""
+        off = 0
+        table = {}
+        for kind, i in self._instances():
+            for f in kind.fields:
+                table[(kind.name, i, f.name)] = (off, f.size)
+                off += f.size
+        return table, off
+
+    def _msg_row(self, name, frm, to, fields):
+        import jax.numpy as jnp
+
+        m = self._mspec[name]
+        vals = dict(fields)
+        lanes = [jnp.asarray(self._mtag[name], jnp.int32),
+                 jnp.asarray(frm, jnp.int32), jnp.asarray(to, jnp.int32)]
+        for f in m.fields:
+            lanes.append(jnp.asarray(vals.pop(f), jnp.int32))
+        assert not vals, f"{name}: unknown fields {sorted(vals)}"
+        while len(lanes) < self._mw:
+            lanes.append(jnp.zeros((), jnp.int32))
+        return jnp.stack(lanes)
+
+    def _timer_row(self, name, node, fields):
+        import jax.numpy as jnp
+
+        t = self._tspec[name]
+        vals = dict(fields)
+        lanes = [jnp.asarray(node, jnp.int32),
+                 jnp.asarray(self._ttag[name], jnp.int32),
+                 jnp.asarray(t.min_ms, jnp.int32),
+                 jnp.asarray(t.max_ms, jnp.int32)]
+        for f in t.fields:
+            lanes.append(jnp.asarray(vals.pop(f), jnp.int32))
+        assert not vals, f"{name}: unknown fields {sorted(vals)}"
+        while len(lanes) < 1 + self._tw:
+            lanes.append(jnp.zeros((), jnp.int32))
+        return jnp.stack(lanes)
+
+    # ------------------------------------------------------------ compile
+
+    def compile(self):
+        """-> TensorProtocol (the engine contract, engine.py:94-146)."""
+        import jax.numpy as jnp
+
+        from dslabs_tpu.tpu.engine import SENTINEL, TensorProtocol
+
+        table, nw = self._layout()
+        n_nodes = sum(k.count for k in self.nodes)
+        spec = self
+
+        def unpack(nodes):
+            st = {}
+            for key, (off, size) in table.items():
+                st[key] = (nodes[off] if size == 1
+                           else nodes[off:off + size])
+            return st
+
+        def repack(st):
+            parts = []
+            for key, (off, size) in table.items():
+                v = st[key]
+                parts.append(v[None] if size == 1 else v)
+            return jnp.concatenate(parts).astype(jnp.int32)
+
+        # Static send/set budgets: trace each handler once with a dummy
+        # context to COUNT its effect rows (the finalize-assert
+        # discipline of the hand twins, without the hand counting).
+        max_sends, max_sets = self._count_budgets()
+
+        def _finalize(rows, budget, width):
+            blank = jnp.full((width,), SENTINEL, jnp.int32)
+            out = []
+            for rec, cond in rows:
+                out.append(jnp.where(cond, rec, blank))
+            assert len(out) <= budget, (len(out), budget)
+            while len(out) < budget:
+                out.append(blank)
+            return jnp.stack(out) if out else jnp.zeros((0, width),
+                                                        jnp.int32)
+
+        def step_message(nodes, msg):
+            st = unpack(nodes)
+            sends, sets = [], []
+            tag, frm, to = msg[0], msg[1], msg[2]
+            for kind, i in spec._instances():
+                here = to == spec._node_index(kind.name, i)
+                for m in spec.messages:
+                    fn = spec.handlers.get((kind.name, m.name))
+                    if fn is None:
+                        continue
+                    cond = here & (tag == spec._mtag[m.name])
+                    payload = {f: msg[3 + j]
+                               for j, f in enumerate(m.fields)}
+                    payload["_from"] = frm
+                    ctx = Ctx(spec, st, kind.name, i, cond, sends, sets)
+                    fn(ctx, payload)
+            return (repack(st), _finalize(sends, max_sends, spec._mw),
+                    _finalize(sets, max_sets, 1 + spec._tw))
+
+        def step_timer(nodes, node_idx, timer):
+            st = unpack(nodes)
+            sends, sets = [], []
+            tag = timer[0]
+            for kind, i in spec._instances():
+                here = node_idx == spec._node_index(kind.name, i)
+                for t in spec.timers:
+                    fn = spec.timer_handlers.get((kind.name, t.name))
+                    if fn is None:
+                        continue
+                    cond = here & (tag == spec._ttag[t.name])
+                    payload = {f: timer[3 + j]
+                               for j, f in enumerate(t.fields)}
+                    ctx = Ctx(spec, st, kind.name, i, cond, sends, sets)
+                    fn(ctx, payload)
+            return (repack(st), _finalize(sends, max_sends, spec._mw),
+                    _finalize(sets, max_sets, 1 + spec._tw))
+
+        def init_nodes():
+            out = np.zeros((nw,), np.int32)
+            for (kind_name, i, fname), (off, size) in table.items():
+                kind = next(k for k in self.nodes if k.name == kind_name)
+                f = next(x for x in kind.fields if x.name == fname)
+                v = f.init(i) if callable(f.init) else f.init
+                out[off:off + size] = v
+            return out
+
+        def init_messages():
+            rows = []
+            for name, frm, to, fields in self.initial_messages:
+                m = self._mspec[name]
+                rec = np.zeros((self._mw,), np.int32)
+                rec[0:3] = [self._mtag[name], frm, to]
+                for j, f in enumerate(m.fields):
+                    rec[3 + j] = fields[f]
+                rows.append(rec)
+            return (np.stack(rows) if rows
+                    else np.zeros((0, self._mw), np.int32))
+
+        def init_timers():
+            rows = []
+            for name, node, fields in self.initial_timers:
+                t = self._tspec[name]
+                rec = np.zeros((1 + self._tw,), np.int32)
+                rec[0:4] = [node, self._ttag[name], t.min_ms, t.max_ms]
+                for j, f in enumerate(t.fields):
+                    rec[4 + j] = fields[f]
+                rows.append(rec)
+            return (np.stack(rows) if rows
+                    else np.zeros((0, 1 + self._tw), np.int32))
+
+        def _pred(fn):
+            def wrapped(state):
+                return fn(_View(spec, table, state["nodes"]))
+            return wrapped
+
+        return TensorProtocol(
+            name=self.name,
+            n_nodes=n_nodes,
+            node_width=nw,
+            msg_width=self._mw,
+            timer_width=self._tw,
+            net_cap=self.net_cap,
+            timer_cap=self.timer_cap,
+            max_sends=max(max_sends, 1),
+            max_sets=max(max_sets, 1),
+            init_nodes=init_nodes,
+            init_messages=init_messages,
+            init_timers=init_timers,
+            step_message=step_message,
+            step_timer=step_timer,
+            msg_dest=lambda msg: msg[2],
+            goals={k: _pred(v) for k, v in self.goals.items()},
+            invariants={k: _pred(v) for k, v in self.invariants.items()},
+            decode_message=self.decode_message,
+            decode_timer=self.decode_timer,
+        )
+
+    def _count_budgets(self) -> Tuple[int, int]:
+        """Count worst-case send/set rows by running every handler once
+        with a counting context (handlers are straight-line over the
+        combinators, so one run = its static row count).  The compiled
+        step accumulates ALL handlers' rows into one block per step
+        kind, so the budget is the larger of the message-step and
+        timer-step TOTALS."""
+        import jax.numpy as jnp
+
+        table, _ = self._layout()
+
+        def dummy_state():
+            return {key: (jnp.zeros((), jnp.int32) if size == 1
+                          else jnp.zeros((size,), jnp.int32))
+                    for key, (_, size) in table.items()}
+
+        false = jnp.asarray(False)
+        msg_sends = msg_sets = tmr_sends = tmr_sets = 0
+        for kind, i in self._instances():
+            for m in self.messages:
+                fn = self.handlers.get((kind.name, m.name))
+                if fn is None:
+                    continue
+                sends, sets = [], []
+                ctx = Ctx(self, dummy_state(), kind.name, i, false,
+                          sends, sets)
+                fn(ctx, {f: jnp.zeros((), jnp.int32)
+                         for f in m.fields} | {"_from": jnp.zeros(
+                             (), jnp.int32)})
+                msg_sends += len(sends)
+                msg_sets += len(sets)
+            for t in self.timers:
+                fn = self.timer_handlers.get((kind.name, t.name))
+                if fn is None:
+                    continue
+                sends, sets = [], []
+                ctx = Ctx(self, dummy_state(), kind.name, i, false,
+                          sends, sets)
+                fn(ctx, {f: jnp.zeros((), jnp.int32) for f in t.fields})
+                tmr_sends += len(sends)
+                tmr_sets += len(sets)
+        return (max(msg_sends, tmr_sends), max(msg_sets, tmr_sets))
+
+
+class _View:
+    """Read-only predicate view over the packed lanes of one state."""
+
+    def __init__(self, spec, table, nodes):
+        self._table = table
+        self._nodes = nodes
+
+    def get(self, kind: str, idx: int, field: str):
+        off, size = self._table[(kind, idx, field)]
+        return (self._nodes[off] if size == 1
+                else self._nodes[off:off + size])

@@ -1,0 +1,128 @@
+"""Declarative protocol specs for the schema compiler (tpu/compiler.py):
+lab 0 ping-pong and lab 1 exactly-once client/server, written as bounded
+field/message/handler declarations — no jax, no lane arithmetic — and
+compiled mechanically to TensorProtocols.
+
+These are the "schema compiler first cut" deliverable (SURVEY §8.1
+Protocol IR): the generated twins explore state spaces ISOMORPHIC to the
+hand-written twins in tpu/protocols/ (tests/test_compiler.py pins the
+unique-state counts and verdicts against both the hand twins and the
+object oracle; lane layouts differ — e.g. the compiler's uniform
+[tag, frm, to, payload] message records — which changes fingerprints
+but not the state graph)."""
+
+from __future__ import annotations
+
+from dslabs_tpu.tpu.compiler import (Field, MessageType, NodeKind,
+                                     ProtocolSpec, TimerType)
+
+__all__ = ["pingpong_spec", "clientserver_spec"]
+
+
+def pingpong_spec(workload_size: int = 2,
+                  never_done: bool = False) -> ProtocolSpec:
+    """Lab 0: a stateless echo server + one ClientWorker-collapsed
+    client walking W commands (the same state collapse as the hand twin,
+    tpu/protocols/pingpong.py: one k lane, 'waiting on command k').
+    ``never_done`` adds the NONE_DECIDED invariant (the violation-probe
+    configuration)."""
+    w = workload_size
+    spec = ProtocolSpec(
+        "pingpong-gen",
+        nodes=[NodeKind("server", 1, ()),
+               NodeKind("client", 1, (Field("k", init=1),))],
+        messages=[MessageType("REQ", ("i",)),
+                  MessageType("REPLY", ("i",))],
+        timers=[TimerType("PING", ("i",), 10, 10)],
+        net_cap=8, timer_cap=4)
+
+    @spec.on("server", "REQ")
+    def srv_req(ctx, m):
+        ctx.send("REPLY", 1, i=m["i"])
+
+    @spec.on("client", "REPLY")
+    def cli_reply(ctx, m):
+        k = ctx.get("k")
+        match = (m["i"] == k) & (k <= w)
+        ctx.put("k", k + 1, when=match)
+        k2 = ctx.get("k")
+        nxt = match & (k2 <= w)
+        ctx.send("REQ", 0, when=nxt, i=k2)
+        ctx.set_timer("PING", when=nxt, i=k2)
+
+    @spec.on_timer("client", "PING")
+    def cli_timer(ctx, t):
+        k = ctx.get("k")
+        live = (t["i"] == k) & (k <= w)
+        ctx.send("REQ", 0, when=live, i=k)
+        ctx.set_timer("PING", when=live, i=k)
+
+    spec.initial_messages.append(("REQ", 1, 0, {"i": 1}))
+    spec.initial_timers.append(("PING", 1, {"i": 1}))
+
+    def clients_done(v):
+        return v.get("client", 0, "k") == w + 1
+
+    def none_decided(v):
+        return v.get("client", 0, "k") == 1
+
+    spec.goals["CLIENTS_DONE"] = clients_done
+    if never_done:
+        spec.invariants["NONE_DECIDED"] = none_decided
+    return spec
+
+
+def clientserver_spec(n_clients: int = 1, w: int = 1) -> ProtocolSpec:
+    """Lab 1: AMO server + NC clients, the hand twin's collapse
+    (tpu/protocols/clientserver.py): server state = per-client
+    last-executed seq, client state = seq in flight."""
+    nc = n_clients
+    spec = ProtocolSpec(
+        "clientserver-gen",
+        nodes=[NodeKind("server", 1, (Field("a", size=nc),)),
+               NodeKind("client", nc, (Field("k", init=1),))],
+        messages=[MessageType("REQ", ("c", "s")),
+                  MessageType("REPLY", ("c", "s"))],
+        timers=[TimerType("RETRY", ("s",), 100, 100)],
+        net_cap=16, timer_cap=4)
+
+    @spec.on("server", "REQ")
+    def srv_req(ctx, m):
+        c, s = m["c"], m["s"]
+        a = ctx.get_at("a", c)
+        ctx.put_at("a", c, s, when=s > a)
+        # fresh -> execute + reply; s == a -> cached reply; older -> drop
+        ctx.send("REPLY", 1 + c, when=s >= a, c=c, s=s)
+
+    @spec.on("client", "REPLY")
+    def cli_reply(ctx, m):
+        c, s = m["c"], m["s"]
+        k = ctx.get("k")
+        mine = c == (ctx.node_index() - 1)
+        match = mine & (s == k) & (k <= w)
+        ctx.put("k", k + 1, when=match)
+        k2 = ctx.get("k")
+        nxt = match & (k2 <= w)
+        ctx.send("REQ", 0, when=nxt, c=c, s=k2)
+        ctx.set_timer("RETRY", when=nxt, s=k2)
+
+    @spec.on_timer("client", "RETRY")
+    def cli_timer(ctx, t):
+        k = ctx.get("k")
+        c = ctx.node_index() - 1
+        live = (t["s"] == k) & (k <= w)
+        ctx.send("REQ", 0, when=live, c=c, s=k)
+        ctx.set_timer("RETRY", when=live, s=k)
+
+    for c in range(nc):
+        spec.initial_messages.append(("REQ", 1 + c, 0, {"c": c, "s": 1}))
+        spec.initial_timers.append(("RETRY", 1 + c, {"s": 1}))
+
+    def clients_done(v):
+        done = True
+        for c in range(nc):
+            done = done & (v.get("client", c, "k") == w + 1)
+        return done
+
+    spec.goals["CLIENTS_DONE"] = clients_done
+    return spec

@@ -1,0 +1,56 @@
+"""Schema compiler (tpu/compiler.py): the generated lab 0 / lab 1 twins
+explore state spaces ISOMORPHIC to the hand-written twins — identical
+unique-state counts and verdicts at exhaustion (order-independent), with
+the object checker as the outer oracle via the parity sweep's generated
+entries (tests/test_verdict_parity_sweep.py)."""
+
+import dataclasses
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from dslabs_tpu.tpu.engine import TensorSearch
+from dslabs_tpu.tpu.specs import clientserver_spec, pingpong_spec
+
+
+def _pruned(p):
+    return dataclasses.replace(
+        p, goals={}, prunes={"DONE": p.goals["CLIENTS_DONE"]})
+
+
+def test_generated_pingpong_matches_hand_twin():
+    from dslabs_tpu.tpu.protocols.pingpong import make_pingpong_protocol
+
+    gen = TensorSearch(_pruned(pingpong_spec(2).compile()),
+                       chunk=128).run()
+    hand = TensorSearch(_pruned(make_pingpong_protocol(2)),
+                        chunk=128).run()
+    assert gen.end_condition == hand.end_condition == "SPACE_EXHAUSTED"
+    assert gen.unique_states == hand.unique_states
+    assert gen.states_explored == hand.states_explored
+
+
+def test_generated_pingpong_goal_and_violation():
+    p = pingpong_spec(2).compile()
+    out = TensorSearch(p, chunk=128).run()
+    assert out.end_condition == "GOAL_FOUND"
+    pv = pingpong_spec(2, never_done=True).compile()
+    out = TensorSearch(dataclasses.replace(pv, goals={}),
+                       chunk=128).run()
+    assert out.end_condition == "INVARIANT_VIOLATED"
+    assert out.predicate_name == "NONE_DECIDED"
+
+
+@pytest.mark.parametrize("nc,w", [(1, 2), (2, 1)])
+def test_generated_clientserver_matches_hand_twin(nc, w):
+    from dslabs_tpu.tpu.protocols.clientserver import \
+        make_clientserver_protocol
+
+    gen = TensorSearch(_pruned(clientserver_spec(nc, w).compile()),
+                       chunk=256).run()
+    hand = TensorSearch(_pruned(make_clientserver_protocol(nc, w)),
+                        chunk=256).run()
+    assert gen.end_condition == hand.end_condition == "SPACE_EXHAUSTED"
+    assert gen.unique_states == hand.unique_states
+    assert gen.states_explored == hand.states_explored

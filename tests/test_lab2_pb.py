@@ -242,7 +242,9 @@ def test18_two_client_appends_linearizable_search():
                 and s2.view.view_num == 2 and s2.synced)
 
     stage1 = (SearchSettings()
-              .add_goal(StatePredicate("view 2 formed and synced", view2_synced)))
+              .add_goal(StatePredicate("view 2 formed and synced", view2_synced,
+                                       tkey=("PB_VIEW_SYNCED", 2,
+                                             "server1", "server2"))))
     stage1.max_time(60)
     stage1.sender_active(client(1), False).sender_active(client(2), False)
     stage1.deliver_timers(client(1), False).deliver_timers(client(2), False)

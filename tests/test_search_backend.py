@@ -213,3 +213,29 @@ def test_lab3_singleton_goal_parity(tensor_backend):
     obj = bfs(mk(), settings)
     assert obj.end_condition == EndCondition.GOAL_FOUND
     assert obj.goal_matching_state.depth == res.goal_matching_state.depth
+
+
+def test_lab2_single_server_verdicts(tensor_backend):
+    """test16-shaped lab2 search through the tensor backend: the
+    ViewServer + PBServer + client stack reaches CLIENTS_DONE with the
+    object checker's goal depth."""
+    import tests.test_lab2_pb as L2
+    from dslabs_tpu.testing.predicates import (CLIENTS_DONE, RESULTS_OK)
+
+    def mk():
+        workload = L2.kv_workload(["PUT:foo:bar", "GET:foo"],
+                                  ["PutOk", "bar"])
+        state = L2.make_search_state(workload)
+        state.add_server(L2.server(1))
+        state.add_client_worker(L2.client(1))
+        return state
+
+    settings = (SearchSettings().add_invariant(RESULTS_OK)
+                .add_goal(CLIENTS_DONE).max_time(90))
+    res = bfs(mk(), settings)
+    assert res.end_condition == EndCondition.GOAL_FOUND
+
+    GlobalSettings.search_backend = "object"
+    obj = bfs(mk(), settings)
+    assert obj.end_condition == EndCondition.GOAL_FOUND
+    assert obj.goal_matching_state.depth == res.goal_matching_state.depth

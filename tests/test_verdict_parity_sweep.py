@@ -192,7 +192,34 @@ def _shardstore_tx_depth():
     return obj, ten, True
 
 
+def _pingpong_gen_exhaust():
+    """The schema-compiled lab0 twin (tpu/specs.py) against the object
+    oracle — the compiler's generated twin runs alongside the
+    hand-written entries (SURVEY §8.1 Protocol IR first cut)."""
+    import tests.test_tpu_engine as te
+    from dslabs_tpu.tpu.specs import pingpong_spec
+
+    obj = te.object_search(2, prune_done=True)
+    p = pingpong_spec(2).compile()
+    p = dataclasses.replace(p, goals={},
+                            prunes={"DONE": p.goals["CLIENTS_DONE"]})
+    return obj, TensorSearch(p, chunk=256).run(), True
+
+
+def _clientserver_gen_exhaust():
+    import tests.test_tpu_engine as te
+    from dslabs_tpu.tpu.specs import clientserver_spec
+
+    obj = te._clientserver_object_search(1, 1, prune_done=True)
+    p = clientserver_spec(n_clients=1, w=1).compile()
+    p = dataclasses.replace(p, goals={},
+                            prunes={"DONE": p.goals["CLIENTS_DONE"]})
+    return obj, TensorSearch(p, chunk=256).run(), True
+
+
 REGISTRY = {
+    "lab0-pingpong-gen-exhaust": _pingpong_gen_exhaust,
+    "lab1-clientserver-gen-exhaust": _clientserver_gen_exhaust,
     "lab0-pingpong-goal": _pingpong_goal,
     "lab0-pingpong-exhaust": _pingpong_exhaust,
     "lab0-pingpong-violation": _pingpong_violation,
