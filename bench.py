@@ -9,13 +9,22 @@ the frontier + visited set in HBM with one scalar sync per level.  All
 device arithmetic is int32/uint32 (round 1 crashed the TPU worker inside
 x64-emulated fingerprints; x64 is banned from device code).
 
-Each ladder rung runs in a SUBPROCESS: a TPU worker crash on an oversized
-config kills only that rung's process — the parent falls through to the
-next rung instead of inheriting a dead TPU client (the round-1 failure
-mode where rung 1's crash poisoned every retry).  Rungs run strict=False:
-routing/frontier capacity drops truncate expansion beam-style and are
-reported, while semantic overflow (net/timer caps, visited shard) still
-aborts the rung.
+Round-4 structure (the round-3 verdict's ordering):
+
+1. **Calibration** — a shallow full-grid strict prefix measures the
+   per-kind valid-event occupancy (max deliverable messages/timers per
+   state) and derives the ev_budget with headroom: no hand-tuned budget
+   constants.  Any state past the budget WINDOW-SPILLS (strict) — the
+   budget is a throughput knob, never a correctness bound.
+2. **The headline is the STRICT rate** — a drop-free exact BFS
+   (dropped=0 enforced fatally; Search.java:405-505 semantics: BFS never
+   silently narrows) to depth 10, count-only final level.
+3. The beam rate (strict=False: routing/frontier-cap drops truncate
+   coverage beam-style and are REPORTED) is secondary, in ``beam``.
+
+Each phase runs in a SUBPROCESS: a TPU worker crash on an oversized
+config kills only that phase's process — the parent falls through
+instead of inheriting a dead TPU client (the round-1 failure mode).
 
 Always prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -29,31 +38,21 @@ import traceback
 
 BASELINE_STATES_PER_MIN = 1e8
 
-# (chunk_per_device, frontier_cap, visited_cap) — per device.  Round-3
-# measured config: occupancy-compacted split event grids (EV_BUDGET
-# below), packed P1B payloads, row-native expand, tail-compacted visited
-# probe -> 4.00M unique states/min on one v5e chip at the lead rung
-# (compile ~2-3 min cold, cached thereafter).
+# (chunk_per_device, frontier_cap, visited_cap) — per device.  Beam
+# ladder: round-3 measured config (occupancy-compacted split event
+# grids, packed P1B payloads, row-native expand, tail-compacted visited
+# probe -> 4.0M unique states/min on one v5e chip at the lead rung).
 LADDER = [
-    (8192, 1 << 19, 1 << 24),  # lead: ~495 ms/chunk steady; visited 16M
-                               # keys/device (256 MB) reaches ~51% full
-                               # at the end of the 120 s budget
+    (8192, 1 << 19, 1 << 24),  # lead: ~495 ms/chunk steady at (40, 8)
     (1024, 1 << 18, 1 << 23),  # fallback if the big rung OOMs
     (64, 1 << 12, 1 << 18),
 ]
-UPGRADE_LADDER = [
-]
 RUNG_TIMEOUT_SECS = 540.0
-UPGRADE_TIMEOUT_SECS = 780.0
-# Message/timer pair-slot budgets (ev_budget): covers the measured max
-# valid events through depth ~17 (msgs p99 ~40 of net_cap 64, timers
-# max 8 of 30); overflow truncates coverage beam-style and is counted
-# in `dropped` like any frontier-cap drop.
-EV_BUDGET = (40, 8)
-# Strict budget: slightly wider message window; events past it WINDOW-
-# SPILL (the chunk re-steps at the next window) instead of dropping, so
-# this is a throughput knob, not a correctness bound.
-EV_BUDGET_STRICT = (48, 8)
+STRICT_TIMEOUT_SECS = 780.0
+CALIBRATE_TIMEOUT_SECS = 420.0
+# Fallback budgets if the calibration subprocess dies (its own crash
+# must not zero the whole bench); values = the round-3 measured ones.
+FALLBACK_EV_BUDGET = (40, 8)
 
 
 def _bench_protocol():
@@ -70,31 +69,86 @@ def _bench_protocol():
     return dataclasses.replace(protocol, goals={})
 
 
-def _run_rung(chunk_per_device: int, frontier_cap: int, visited_cap: int,
-              max_secs: float) -> dict:
+def _persistent_cache():
     import jax
 
-    # Persistent compile cache: the expand program takes minutes to build;
-    # repeat bench invocations on the same machine skip straight to run.
     jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def _calibrate(max_depth: int = 7) -> dict:
+    """Measure per-state valid-event occupancy on a shallow full-grid
+    strict prefix; budgets = measured max + headroom (growth continues
+    past the calibration depth — the spill covers the tail for strict,
+    and beam counts the drops as before)."""
+    import jax
+    import jax.numpy as jnp
+
+    _persistent_cache()
+
+    from dslabs_tpu.tpu.engine import SENTINEL, timer_deliverable_mask
+    from dslabs_tpu.tpu.sharded import ShardedTensorSearch, make_mesh
+
+    protocol = _bench_protocol()
+    mesh = make_mesh(len(jax.devices()))
+    search = ShardedTensorSearch(
+        protocol, mesh, chunk_per_device=1024, frontier_cap=1 << 17,
+        visited_cap=1 << 22, max_depth=1, strict=True)
+
+    def stats(carry):
+        cur, cur_n = carry["cur"], carry["cur_n"][0]
+        states = search.unflatten_rows(cur)
+        valid = jnp.arange(cur.shape[0]) < cur_n
+        msgs = jnp.sum(states["net"][:, :, 0] != SENTINEL, axis=1)
+        tmrs = jnp.sum(jax.vmap(jax.vmap(timer_deliverable_mask))(
+            states["timers"]), axis=(1, 2))
+        return (jnp.max(jnp.where(valid, msgs, 0)),
+                jnp.max(jnp.where(valid, tmrs, 0)))
+
+    jstats = jax.jit(stats)
+    bm = bt = 1
+    with mesh:
+        carry = search._init_carry(search.initial_state())
+        max_n, depth, t0 = 1, 0, time.time()
+        while max_n > 0 and depth < max_depth:
+            depth += 1
+            n_chunks = -(-(max_n + search.n_devices - 1) // search.cpd)
+            for _ in range(n_chunks):
+                carry = search._chunk_step(carry)
+            _, _, _, _, max_n, _ = search._sync_checks(carry, depth, t0)
+            carry = search._finish_level(carry)
+            m, t = (int(x) for x in jax.tree.map(jnp.asarray,
+                                                 jstats(carry)))
+            bm, bt = max(bm, m), max(bt, t)
+    p = search.p
+    # Headroom: message occupancy keeps growing past the calibration
+    # depth (~1/level); timers are structurally bounded by the retry
+    # re-arm pattern.  Budgets clamp to the full grid.
+    return {"bm": min(bm + bm // 2 + 4, p.net_cap),
+            "bt": min(bt + 2, p.n_nodes * p.timer_cap),
+            "measured": [bm, bt], "depth": depth}
+
+
+def _run_rung(chunk_per_device: int, frontier_cap: int, visited_cap: int,
+              max_secs: float, ev_budget) -> dict:
+    import jax
+
+    _persistent_cache()
 
     from dslabs_tpu.tpu.sharded import ShardedTensorSearch, make_mesh
 
     mesh = make_mesh(len(jax.devices()))
-    # NO checkpointing inside the measured window: dumping the multi-GB
-    # carry through the device tunnel costs minutes (measured: a
-    # checkpoint_every=4 rung spent 300 s saving and recorded 140
-    # states/min), which is the whole budget.  Kill-resume is exercised
-    # by tests/test_tpu_sharded.py and available to long strict
-    # searches; a crashed rung here restarts fresh on the retry.
+    # NO checkpointing inside the measured window by default (the async
+    # incremental dump is cheap, but the headline stays unencumbered;
+    # test_tpu_sharded.py covers kill-resume and the strict probe can
+    # demonstrate checkpoint overhead via DSLABS_BENCH_CKPT=1).
     # Warm-up depth 2, not 1: the final depth-limited level skips the
     # frontier promotion (count-only), so a depth-1 run would leave
     # _finish_level uncompiled and charge its compile to the window.
     search = ShardedTensorSearch(
         _bench_protocol(), mesh, chunk_per_device=chunk_per_device,
         frontier_cap=frontier_cap, visited_cap=visited_cap, max_depth=2,
-        strict=False, ev_budget=EV_BUDGET)
+        strict=False, ev_budget=ev_budget)
     search.run()  # warm-up: compiles the chunk/finish programs
     search.max_depth = 64
     search.max_secs = max_secs
@@ -111,33 +165,34 @@ def _run_rung(chunk_per_device: int, frontier_cap: int, visited_cap: int,
     }
 
 
-def _run_strict() -> dict:
-    """The drop-free headline number: a strict (exact, nothing
+def _run_strict(ev_budget) -> dict:
+    """The drop-free HEADLINE number: a strict (exact, nothing
     truncated) BFS of the bench protocol to depth 10 — every valid event
-    of every reachable state expanded, dropped=0 enforced fatally by the
-    engine (Search.java:405-505 semantics: BFS never silently narrows).
+    of every reachable state expanded, dropped=0 enforced fatally.
 
-    Round-4 config: chunk 8192 (the beam rung's chunk — on one device
-    the routing bucket holds the whole batch, so strict skips the
-    in-chunk prefilter too), ev_budget (48, 8) with WINDOW SPILL (a
-    state with more valid events re-steps its chunk at the next window —
-    a perf knob, never a coverage cut), and the final level counts
-    fresh states without building the ~4x-over-cap depth-10 frontier
-    (count-only last level; the reference BFS likewise never queues
-    states at the cutoff depth).  A warm-up run keeps compile time out
-    of the measured window."""
+    Config notes: chunk 8192 (on one device the routing bucket holds the
+    whole batch, so strict skips the in-chunk prefilter too); the
+    calibrated ev_budget WINDOW-SPILLS (a state with more valid events
+    re-steps its chunk at the next window — never a coverage cut); the
+    final level counts fresh states without building the ~4x-over-cap
+    depth-10 frontier.  A warm-up run keeps compile out of the window.
+    DSLABS_BENCH_CKPT=1 additionally runs async incremental checkpoints
+    every 2 levels (the overhead-demonstration mode)."""
     import jax
 
-    jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    _persistent_cache()
 
     from dslabs_tpu.tpu.sharded import ShardedTensorSearch, make_mesh
 
     mesh = make_mesh(len(jax.devices()))
+    ckpt = {}
+    if os.environ.get("DSLABS_BENCH_CKPT"):
+        ckpt = {"checkpoint_path": "/tmp/bench_strict.ckpt",
+                "checkpoint_every": 2}
     search = ShardedTensorSearch(
         _bench_protocol(), mesh, chunk_per_device=8192,
         frontier_cap=(1 << 20) + (1 << 18), visited_cap=1 << 24,
-        max_depth=2, strict=True, ev_budget=EV_BUDGET_STRICT)
+        max_depth=2, strict=True, ev_budget=ev_budget, **ckpt)
     search.run()  # warm-up: compiles chunk/finish/stats programs
     search.max_depth = 10
     t0 = time.time()
@@ -156,7 +211,7 @@ def _run_strict() -> dict:
 
 def _probe_platform() -> tuple:
     """Platform + device count WITHOUT initialising jax in this process —
-    the accelerator must stay free for the rung subprocesses."""
+    the accelerator must stay free for the phase subprocesses."""
     try:
         out = subprocess.run(
             [sys.executable, "-c",
@@ -168,104 +223,102 @@ def _probe_platform() -> tuple:
         return ("unknown", 0)
 
 
-def _try_rung(chunk, f_cap, v_cap, max_secs, timeout=RUNG_TIMEOUT_SECS):
-    """Run one ladder rung in a subprocess; (result dict, None) on
-    success, (None, error string) otherwise."""
+def _sub(args, timeout):
+    """Run a bench phase in a subprocess; (parsed dict, None) on success,
+    (None, error string) otherwise."""
     try:
         proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--rung",
-             str(chunk), str(f_cap), str(v_cap), str(max_secs)],
+            [sys.executable, os.path.abspath(__file__)] + args,
             capture_output=True, text=True, timeout=timeout,
             cwd=os.path.dirname(os.path.abspath(__file__)))
         if proc.returncode == 0:
             return json.loads(proc.stdout.strip().splitlines()[-1]), None
         tail = (proc.stderr or proc.stdout).strip().splitlines()
         return None, (tail[-1][:300] if tail
-                      else f"rung chunk={chunk} exited rc={proc.returncode} "
-                           "with no output")
+                      else f"{args[0]} exited rc={proc.returncode}")
     except subprocess.TimeoutExpired:
-        return None, f"rung chunk={chunk} timed out after {timeout}s"
+        return None, f"{args[0]} timed out after {timeout}s"
     except Exception:
         return None, traceback.format_exc(
             limit=2).strip().splitlines()[-1][:300]
 
 
-def _try_strict(timeout=UPGRADE_TIMEOUT_SECS):
-    """Best-effort strict probe in its own subprocess (a crash or
-    timeout must never cost the headline number)."""
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--strict"],
-            capture_output=True, text=True, timeout=timeout,
-            cwd=os.path.dirname(os.path.abspath(__file__)))
-        if proc.returncode == 0:
-            return json.loads(proc.stdout.strip().splitlines()[-1])
-    except Exception:
-        pass
-    return None
-
-
 def main() -> None:
     platform, n_dev = _probe_platform()
     max_secs = 120.0 if platform != "cpu" else 45.0
-    best, err = None, None
-    # The lead rung gets TWO attempts (a crash falls through to a fresh
-    # retry before degrading).  CPU runs are a smoke test — only the
-    # smallest rung is viable there.
-    attempts = ([LADDER[0]] + LADDER if platform != "cpu"
-                else [LADDER[-1]])
+    on_cpu = platform == "cpu"
+
+    # ---- phase 1: measured budgets (no hand-tuned constants)
+    cal, cal_err = (None, "skipped on cpu") if on_cpu else _sub(
+        ["--calibrate"], CALIBRATE_TIMEOUT_SECS)
+    ev = ((cal["bm"], cal["bt"]) if cal else FALLBACK_EV_BUDGET)
+
+    # ---- phase 2: the strict drop-free headline (two attempts)
+    strict, strict_err = None, None
+    if not on_cpu:
+        for _ in range(2):
+            strict, strict_err = _sub(
+                ["--strict", str(ev[0]), str(ev[1])], STRICT_TIMEOUT_SECS)
+            if strict is not None:
+                break
+
+    # ---- phase 3: the beam throughput rate (secondary)
+    beam, beam_err = None, None
+    attempts = ([LADDER[0]] + LADDER if not on_cpu else [LADDER[-1]])
     for chunk, f_cap, v_cap in attempts:
-        best, err = _try_rung(chunk, f_cap, v_cap, max_secs)
-        if best is not None:
+        beam, beam_err = _sub(
+            ["--rung", str(chunk), str(f_cap), str(v_cap), str(max_secs),
+             str(ev[0]), str(ev[1])], RUNG_TIMEOUT_SECS)
+        if beam is not None:
             break
-    if best is not None and platform != "cpu":
-        # A safe number is in hand — attempt the bigger-chunk upgrade and
-        # keep whichever measured higher.
-        for chunk, f_cap, v_cap in UPGRADE_LADDER:
-            up, _ = _try_rung(chunk, f_cap, v_cap, max_secs,
-                              timeout=UPGRADE_TIMEOUT_SECS)
-            if up is not None and up["value"] > best["value"]:
-                best = up
-    value = best["value"] if best else 0.0
+
+    lead = strict or beam
+    value = lead["value"] if lead else 0.0
+    kind = "strict BFS" if strict else "BFS (beam)"
     result = {
-        "metric": ("lab3-paxos BFS unique states/min "
+        "metric": (f"lab3-paxos {kind} unique states/min "
                    f"(sharded tensor backend, {platform} x{n_dev})"),
         "value": round(value, 1),
         "unit": "states/min",
         "vs_baseline": round(value / BASELINE_STATES_PER_MIN, 6),
+        "ev_budget": list(ev),
     }
-    if best:
-        result["detail"] = {k: best[k] for k in
-                            ("unique", "explored", "depth", "end",
-                             "dropped", "elapsed", "resumed")
-                            if k in best}
-    if err is not None and not best:
-        result["error"] = err
-    if best is not None and platform != "cpu":
-        # The drop-free fidelity probe: an exact BFS (dropped=0) at
-        # scale, reported alongside the beam rate (round-2 verdict: "the
-        # north-star metric says unique states/min OF A REAL SEARCH").
-        strict = _try_strict()
-        if strict is not None:
-            result["strict"] = strict
+    if cal:
+        result["calibration"] = cal
+    if strict:
+        result["strict"] = strict
+    if beam:
+        result["beam"] = beam
+    errs = [e for e in (cal_err, strict_err, beam_err)
+            if e and e != "skipped on cpu"]
+    if errs and not lead:
+        result["error"] = "; ".join(errs)
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
     if len(sys.argv) >= 2 and sys.argv[1] == "--rung":
         chunk, f_cap, v_cap = map(int, sys.argv[2:5])
+        ev = ((int(sys.argv[6]), int(sys.argv[7]))
+              if len(sys.argv) > 7 else FALLBACK_EV_BUDGET)
         print(json.dumps(_run_rung(chunk, f_cap, v_cap,
-                                   float(sys.argv[5]))))
+                                   float(sys.argv[5]), ev)))
         sys.exit(0)
     if len(sys.argv) >= 2 and sys.argv[1] == "--strict":
-        print(json.dumps(_run_strict()))
+        ev = ((int(sys.argv[2]), int(sys.argv[3]))
+              if len(sys.argv) > 3 else FALLBACK_EV_BUDGET)
+        print(json.dumps(_run_strict(ev)))
+        sys.exit(0)
+    if len(sys.argv) >= 2 and sys.argv[1] == "--calibrate":
+        print(json.dumps(_calibrate()))
         sys.exit(0)
     try:
         main()
     except Exception:
         tb = traceback.format_exc(limit=3)
         print(json.dumps({
-            "metric": "lab3-paxos BFS unique states/min (tensor backend)",
+            "metric": "lab3-paxos strict BFS unique states/min "
+                      "(tensor backend)",
             "value": 0.0, "unit": "states/min", "vs_baseline": 0.0,
             "error": tb.strip().splitlines()[-1][:300],
         }))

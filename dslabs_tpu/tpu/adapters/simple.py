@@ -79,6 +79,18 @@ class PingPongBinding(TwinBinding):
         return (LocalAddress(self.client_name), PingTimer(self.cmds[i - 1]),
                 PING_MS, PING_MS)
 
+    def msg_mask_fn(self):
+        # Record layout [tag, i]: REQ rides client(1) -> server(0),
+        # REPLY the reverse — no frm/to lanes to read.
+        from dslabs_tpu.tpu.protocols.pingpong import REQ
+
+        def fn(msg, marr):
+            import jax.numpy as jnp
+
+            k = jnp.where(msg[0] == REQ, 1 * 2 + 0, 0 * 2 + 1)
+            return jnp.sum(jnp.where(jnp.arange(4) == k, marr, False))
+        return fn
+
     def predicate(self, tkey):
         kind = tkey[0]
         w = self.w
@@ -169,6 +181,22 @@ class ClientServerBinding(TwinBinding):
         c, s = int(node_idx) - 1, int(rec[3])
         return (LocalAddress(self.client_names[c]),
                 ClientTimer(self._amo(c, s)), CLIENT_MS, CLIENT_MS)
+
+    def msg_mask_fn(self):
+        # Record layout [tag, c, s]: REQ rides client(1+c) -> server(0),
+        # REPLY the reverse — frm/to derive from (tag, c).
+        from dslabs_tpu.tpu.protocols.clientserver import REQ
+
+        nn = 1 + self.nc
+
+        def fn(msg, marr, nn=nn):
+            import jax.numpy as jnp
+
+            c = msg[1].clip(0, nn - 2)
+            k = jnp.where(msg[0] == REQ, (1 + c) * nn + 0, 0 * nn + 1 + c)
+            return jnp.sum(jnp.where(jnp.arange(nn * nn) == k, marr,
+                                     False))
+        return fn
 
     def predicate(self, tkey):
         import jax.numpy as jnp

@@ -120,6 +120,31 @@ class TwinBinding:
     def predicate(self, tkey) -> Callable:
         raise NotImplementedError
 
+    def msg_mask_fn(self) -> Callable:
+        """fn(msg_record, [NN*NN] link matrix) -> deliverable, for the
+        default [tag, frm, to, ...] record layout; bindings whose twins
+        do not carry frm/to lanes (e.g. lab 1's [tag, c, s]) override
+        with their own lane mapping."""
+        nn = len(self.addr_index)
+
+        def fn(msg, marr, nn=nn):
+            import jax.numpy as jnp
+
+            k = (msg[1].clip(0, nn - 1) * nn
+                 + msg[2].clip(0, nn - 1))
+            return jnp.sum(jnp.where(jnp.arange(nn * nn) == k, marr,
+                                     False))
+        return fn
+
+    @staticmethod
+    def tmr_mask_fn(nn: int) -> Callable:
+        def fn(node, tarr, nn=nn):
+            import jax.numpy as jnp
+
+            return jnp.sum(jnp.where(jnp.arange(nn) == node, tarr,
+                                     False))
+        return fn
+
 
 _ADAPTERS: List[Callable] = []
 
@@ -189,14 +214,14 @@ def _addr_name(a) -> str:
 
 
 def compile_masks(binding: TwinBinding, settings):
-    """TestSettings network/timer gating -> (deliver_message fn,
-    deliver_timer fn) over twin lanes.  The delivery matrix reproduces
+    """TestSettings network/timer gating -> ([NN*NN] link matrix,
+    [NN] timer vector) bool arrays.  The matrix reproduces
     TestSettings.should_deliver's precedence exactly: link override ->
-    sender -> receiver -> network_active (testing/settings.py:138-151);
-    lookups are one-hot select-reduces, never traced-index gathers (the
-    measured ~1 GB/s pathology under the flat vmap)."""
-    import jax.numpy as jnp
-
+    sender -> receiver -> network_active (testing/settings.py:138-151).
+    The arrays are passed to the jitted programs as RUNTIME arguments
+    (engine deliver_*_rt) so staged phases never recompile; lookups are
+    one-hot select-reduces, never traced-index gathers (the measured
+    ~1 GB/s pathology under the flat vmap)."""
     idx = binding.addr_index
     nn = len(idx)
     names = {i: a for a, i in idx.items()}
@@ -221,24 +246,7 @@ def compile_masks(binding: TwinBinding, settings):
     tvec = np.array(
         [settings.should_deliver_timer(LocalAddress(names[i]))
          for i in range(nn)], dtype=bool)
-
-    deliver_msg = None
-    if not mat.all():
-        flat = jnp.asarray(mat.reshape(-1))
-        jnn = jnp.int32(nn)
-
-        def deliver_msg(msg, flat=flat, jnn=jnn, n2=nn * nn):
-            k = msg[1].clip(0, jnn - 1) * jnn + msg[2].clip(0, jnn - 1)
-            return jnp.sum(jnp.where(jnp.arange(n2) == k, flat, False))
-
-    deliver_tmr = None
-    if not tvec.all():
-        jt = jnp.asarray(tvec)
-
-        def deliver_tmr(node, jt=jt, nn=nn):
-            return jnp.sum(jnp.where(jnp.arange(nn) == node, jt, False))
-
-    return deliver_msg, deliver_tmr
+    return mat.reshape(-1), tvec
 
 
 
@@ -345,7 +353,7 @@ def _run_tensor(binding: TwinBinding, settings, state, chunk=512):
     for attempt, (f_cap, v_cap) in enumerate(_LADDER):
         protocol = binding.build_protocol(net_cap << attempt,
                                           timer_cap + 2 * attempt)
-        dm, dt = compile_masks(binding, settings)
+        marr, tarr = compile_masks(binding, settings)
         inv = {p.name: translate_predicate(binding, p)
                for p in settings.invariants}
         goals = {p.name: translate_predicate(binding, p)
@@ -354,10 +362,12 @@ def _run_tensor(binding: TwinBinding, settings, state, chunk=512):
                   for p in settings.prunes}
         protocol = dataclasses.replace(
             protocol, invariants=inv, goals=goals, prunes=prunes,
-            deliver_message=dm, deliver_timer=dt)
+            deliver_message_rt=binding.msg_mask_fn(),
+            deliver_timer_rt=TwinBinding.tmr_mask_fn(len(tarr)))
         search = ShardedTensorSearch(
             protocol, mesh, chunk_per_device=chunk, frontier_cap=f_cap,
             visited_cap=v_cap, strict=True, record_trace=True)
+        search.set_runtime_masks(marr, tarr)
         root, history = derive_root(binding, search, state)
         rel = None
         if settings.depth_limited():

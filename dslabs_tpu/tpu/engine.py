@@ -130,6 +130,16 @@ class TensorProtocol:
     # optional masks: deliver_message(msg)->bool, deliver_timer(node)->bool
     deliver_message: Optional[Callable] = None
     deliver_timer: Optional[Callable] = None
+    # RUNTIME-mask variants: fn(msg, marr)->bool / fn(node, tarr)->bool
+    # where marr/tarr are device arrays passed per run (TensorSearch
+    # .set_runtime_masks), NOT trace-time constants.  The harness search
+    # backend uses these so every staged phase of a lab test (different
+    # partitions/timer gating, same protocol shape) shares ONE compiled
+    # expand program instead of recompiling per mask (settings gate
+    # events, never shapes — SURVEY §7.7).  Applied in _event_tables
+    # (the single validity source for the expand pipeline).
+    deliver_message_rt: Optional[Callable] = None
+    deliver_timer_rt: Optional[Callable] = None
     # Max SIMULTANEOUS valid send rows any single transition can emit.
     # ``max_sends`` is the static row budget summed over all (mutually
     # exclusive) handler branches; the live count is far smaller (lab3:
@@ -863,8 +873,16 @@ class TensorSearch:
                             & (pos >= budget + offset)).astype(jnp.int32)
         return ids, remaining
 
+    def set_runtime_masks(self, marr, tarr) -> None:
+        """Install per-run delivery masks (device arrays consumed by the
+        protocol's deliver_*_rt fns).  They ride the jitted programs as
+        ARGUMENTS, so changing masks never recompiles."""
+        import jax.numpy as jnp
+
+        self._rt_masks = (jnp.asarray(marr), jnp.asarray(tarr))
+
     def _event_tables(self, chunk_rows: jnp.ndarray,
-                      chunk_valid: jnp.ndarray, ev_pass=0):
+                      chunk_valid: jnp.ndarray, ev_pass=0, masks=None):
         """[C, lanes] chunk -> (msg_ids [C, Bm] net-slot indices, tmr_ids
         [C, Bt] timer grid indices, ev_remaining): each state's VALID
         events (occupied network rows + deliverable timers, masked by the
@@ -882,10 +900,20 @@ class TensorSearch:
         if p.deliver_message is not None:
             msg_ok = msg_ok & jax.vmap(jax.vmap(p.deliver_message))(
                 chunk_state["net"])
+        if p.deliver_message_rt is not None and masks is not None:
+            marr = masks[0]
+            msg_ok = msg_ok & jax.vmap(jax.vmap(
+                lambda m: p.deliver_message_rt(m, marr)))(
+                chunk_state["net"])
         tmask = jax.vmap(jax.vmap(timer_deliverable_mask))(
             chunk_state["timers"])                         # [C, NN, T_CAP]
         if p.deliver_timer is not None:
             dt = jax.vmap(p.deliver_timer)(jnp.arange(p.n_nodes))
+            tmask = tmask & dt[None, :, None]
+        if p.deliver_timer_rt is not None and masks is not None:
+            tarr = masks[1]
+            dt = jax.vmap(lambda nd: p.deliver_timer_rt(nd, tarr))(
+                jnp.arange(p.n_nodes))
             tmask = tmask & dt[None, :, None]
         msg_ids, m_rem = self._compact_ids(
             msg_ok & chunk_valid[:, None], self._ev_msg,
@@ -896,7 +924,7 @@ class TensorSearch:
         return msg_ids, tmr_ids, m_rem + t_rem
 
     def _expand_chunk(self, chunk_rows: jnp.ndarray,
-                      chunk_valid: jnp.ndarray, ev_pass=0):
+                      chunk_valid: jnp.ndarray, ev_pass=0, masks=None):
         """[C, lanes] chunk rows -> successor rows + fingerprints + masks
         + flags.
 
@@ -934,7 +962,7 @@ class TensorSearch:
 
         msg_ids, tmr_ids, ev_drops = self._event_tables(chunk_rows,
                                                         chunk_valid,
-                                                        ev_pass)
+                                                        ev_pass, masks)
         if stop == "events":
             return _cut(msg_ids, tmr_ids)
         # TWO flat vmaps — one per event kind, each running only its own
@@ -1162,8 +1190,11 @@ class TensorSearch:
                     if pad else frontier[start:end])
                 chunk_valid = jnp.concatenate(
                     [jnp.ones(c, bool), jnp.zeros(pad, bool)])
+                rt = getattr(self, "_rt_masks", None)
                 (rows_d, valids, fp, unique, overflow, ev_drops, event_ids,
-                 flags) = self._expand(chunk_rows, chunk_valid)
+                 flags) = (self._expand(chunk_rows, chunk_valid, 0, rt)
+                           if rt is not None
+                           else self._expand(chunk_rows, chunk_valid))
                 if int(overflow):
                     raise CapacityOverflow(
                         f"{self.p.name}: net_cap={self.p.net_cap}, "

@@ -238,7 +238,7 @@ class ShardedTensorSearch(TensorSearch):
             out["j"] = carry["j"] + 1
             return out
 
-        def local(carry):
+        def local(carry, masks=None):
             # The chunk index lives IN the carry (device-resident,
             # self-incrementing): passing it as a per-call jnp scalar cost
             # a fresh host->device transfer per chunk step, which on the
@@ -251,7 +251,7 @@ class ShardedTensorSearch(TensorSearch):
             valid = (start + jnp.arange(C)) < cur_n
             ev_pass = carry["evp"][0]
             (rows, valids, fp, unique, overflow, ev_rem, event_ids,
-             flags) = self._expand_chunk(rows_chunk, valid, ev_pass)
+             flags) = self._expand_chunk(rows_chunk, valid, ev_pass, masks)
             # Spill: valid events past this pass's window mean the SAME
             # chunk must re-step at the next window before j advances
             # (run() re-dispatches until every device's j reaches its
@@ -549,9 +549,25 @@ class ShardedTensorSearch(TensorSearch):
             return out
 
         spec = self._carry_specs()
-        return shard_map(local, mesh=self.mesh,
+        if (p.deliver_message_rt is not None
+                or p.deliver_timer_rt is not None):
+            # Runtime delivery masks ride as a replicated ARGUMENT: every
+            # staged phase (different partition/timer gating, same
+            # protocol shape) shares one compiled program.
+            return shard_map(local, mesh=self.mesh,
+                             in_specs=(spec, (P(), P())), out_specs=spec,
+                             check_rep=False)
+        return shard_map(lambda c: local(c), mesh=self.mesh,
                          in_specs=(spec,), out_specs=spec,
                          check_rep=False)
+
+    def _step(self, carry):
+        """Dispatch one chunk step, passing the runtime masks when the
+        protocol declares them."""
+        rt = getattr(self, "_rt_masks", None)
+        if rt is not None:
+            return self._chunk_step(carry, rt)
+        return self._chunk_step(carry)
 
     def _build_finish(self):
         """Promote nxt -> cur between levels, REBALANCING the frontier
@@ -953,7 +969,7 @@ class ShardedTensorSearch(TensorSearch):
                 n_chunks = -(-(max_n + self.n_devices - 1) // self.cpd)
                 t_disp = time.time()
                 for j in range(n_chunks):
-                    carry = self._chunk_step(carry)
+                    carry = self._step(carry)
                     # Respect the time budget inside long levels too.  The
                     # partial level runs the same overflow/terminal-flag
                     # checks as a full level before reporting, so a
@@ -995,7 +1011,7 @@ class ShardedTensorSearch(TensorSearch):
                         return self._limit_outcome("TIME_EXHAUSTED",
                                                    carry, depth, t0)
                     for _ in range(n_chunks - j_done):
-                        carry = self._chunk_step(carry)
+                        carry = self._step(carry)
                 if _LEVEL_TIMING:
                     dt = time.time() - t_lvl
                     print(f"[level {depth}] chunks={n_chunks} "
