@@ -14,7 +14,7 @@ and the controller gated exactly like ShardStoreBaseTest.java:209-220):
 
 measured 2026-07-31 (tools-free repro: /tmp-style driver in this file's
 git history; the object run takes ~10 min for depth 4):
-    depth 1 -> 10    depth 2 -> 69    depth 3 -> 392
+    depth 1 -> 10    depth 2 -> 69    depth 3 -> 392    depth 4 -> 1985
 
 The twin starts from the equivalent staged state by construction
 (init_* in the twin factory mirror the object staging: two pending
@@ -33,7 +33,7 @@ from dslabs_tpu.tpu.protocols.shardstore_multi import \
 
 SLOW = not os.environ.get("DSLABS_SLOW_TESTS")
 
-ORACLE = {1: 10, 2: 69, 3: 392}
+ORACLE = {1: 10, 2: 69, 3: 392, 4: 1985}
 
 
 @pytest.mark.skipif(SLOW, reason="multi-group twin compile is minutes on "
