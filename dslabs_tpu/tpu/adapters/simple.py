@@ -447,18 +447,40 @@ class PrimaryBackupBinding(TwinBinding):
         if kind == "CLIENT_HAS_RESULTS":
             c = self.client_names.index(str(tkey[1].root_address()))
             return lambda s: k(s, c) >= tkey[2] + 1
-        if kind == "PB_VIEW_SYNCED":
-            vn = tkey[1]
-            pi = self.server_names.index(tkey[2]) + 1
-            bi = self.server_names.index(tkey[3]) + 1
+        if kind == "PB_PROMOTED":
+            # A named server serves a view with itself primary, no
+            # backup, synced (the failover goal, test19).
+            pi = self.server_names.index(tkey[1]) + 1
 
             def fn(s):
                 def srv(i, off):
                     return s["nodes"][VSW + i * SW + off]
-                ok = jnp.asarray(True)
-                for i in range(ns):
-                    ok = ok & (srv(i, 0) == vn) & (srv(i, 3) == 1)
-                return ok & (srv(0, 1) == pi) & (srv(0, 2) == bi)
+                return ((srv(pi - 1, 1) == pi) & (srv(pi - 1, 2) == 0)
+                        & (srv(pi - 1, 3) == 1)
+                        & (srv(pi - 1, 0) > 0))
+            return fn
+        if kind == "PB_VIEW_SYNCED":
+            # The lab tests' staged goal: the NAMED primary reports view
+            # vn with (primary, backup) and synced, and the named backup
+            # reports vn synced — other servers (often gated off) are
+            # not constrained (tests/test_lab2_pb.py view2_synced).
+            vn = tkey[1]
+            pi = self.server_names.index(tkey[2]) + 1
+            bi = self.server_names.index(tkey[3]) + 1
+
+            want_acked = len(tkey) > 4 and tkey[4] == "acked"
+
+            def fn(s):
+                def srv(i, off):
+                    return s["nodes"][VSW + i * SW + off]
+                ok = ((srv(pi - 1, 0) == vn) & (srv(pi - 1, 1) == pi)
+                      & (srv(pi - 1, 2) == bi) & (srv(pi - 1, 3) == 1)
+                      & (srv(bi - 1, 0) == vn) & (srv(bi - 1, 3) == 1))
+                if want_acked:
+                    # ViewServer acked flag (lane 3 of the master block,
+                    # tpu/protocols/primarybackup.py _unpack).
+                    ok = ok & (s["nodes"][3] == 1)
+                return ok
             return fn
         return None
 

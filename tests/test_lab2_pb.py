@@ -209,11 +209,16 @@ def test16_single_client_search():
     results = bfs(state, settings)
     assert results.end_condition == EndCondition.GOAL_FOUND, results
 
-    # The done-pruned subspace never violates RESULTS_OK.
+    # The done-pruned subspace never violates RESULTS_OK.  (The state
+    # is rebuilt with the SAME topology — an earlier port slip searched
+    # a ViewServer-only state here, which exhausts vacuously.)
     settings2 = (SearchSettings().add_invariant(RESULTS_OK)
                  .add_prune(CLIENTS_DONE))
     settings2.max_time(60).set_max_depth(22)
-    results2 = bfs(make_search_state(workload), settings2)
+    state2 = make_search_state(workload)
+    state2.add_server(server(1))
+    state2.add_client_worker(client(1))
+    results2 = bfs(state2, settings2)
     assert results2.end_condition in (EndCondition.SPACE_EXHAUSTED,
                                       EndCondition.TIME_EXHAUSTED), results2
 
@@ -534,7 +539,9 @@ def test17_single_client_multi_server_search():
     init_settings.node_active(server(3), False)
     init_settings.deliver_timers(client(1), False)
     init_settings.deliver_timers(server(3), False)
-    init_settings.add_goal(StatePredicate("view 2 synced", view2_synced))
+    init_settings.add_goal(StatePredicate(
+        "view 2 synced", view2_synced,
+        tkey=("PB_VIEW_SYNCED", 2, "server1", "server2")))
     results = bfs(state, init_settings)
     assert results.end_condition == EndCondition.GOAL_FOUND, results
     view_ready = results.goal_matching_state
@@ -586,21 +593,21 @@ def test19_multiple_failures_search():
     init_settings = SearchSettings().max_time(60)
     init_settings.node_active(client(1), False)
     init_settings.deliver_timers(client(1), False)
-    init_settings.add_goal(StatePredicate("view 2 synced", view2_synced))
+    init_settings.add_goal(StatePredicate(
+        "view 2 synced", view2_synced,
+        tkey=("PB_VIEW_SYNCED", 2, "server1", "server2", "acked")))
     results = bfs(state, init_settings)
     assert results.end_condition == EndCondition.GOAL_FOUND, results
     view_ready = results.goal_matching_state
 
     # Find a state where the first write is acknowledged.
-    def put_acked(s):
-        w = s.client_workers()[client(1)]
-        return len(w.results) >= 1
+    from dslabs_tpu.testing.predicates import client_has_results
 
     s2 = SearchSettings().max_time(120)
     s2.add_invariant(RESULTS_OK)
     s2.deliver_timers(VSA, False)
     s2.deliver_timers(server(1), False).deliver_timers(server(2), False)
-    s2.add_goal(StatePredicate("first write acked", put_acked))
+    s2.add_goal(client_has_results(client(1), 1))
     results = bfs(view_ready, s2)
     assert results.end_condition == EndCondition.GOAL_FOUND, results
     acked = results.goal_matching_state
@@ -622,7 +629,8 @@ def test19_multiple_failures_search():
     s3.node_active(client(1), False).deliver_timers(client(1), False)
     s3.deliver_timers(server(1), False)   # dead primary's timers are noise
     s3.set_max_depth(acked.depth + 10)    # promotion takes ~8 events
-    s3.add_goal(StatePredicate("backup promoted", promoted))
+    s3.add_goal(StatePredicate("backup promoted", promoted,
+                               tkey=("PB_PROMOTED", "server2")))
     results = bfs(acked, s3)
     assert results.end_condition == EndCondition.GOAL_FOUND, results
     failed_over = results.goal_matching_state
