@@ -11,7 +11,9 @@ the paxos adapter (tpu/adapters/paxos.py docstring)."""
 
 from __future__ import annotations
 
+import copy
 import dataclasses
+import uuid
 from typing import Dict, Optional
 
 from dslabs_tpu.tpu.adapters.paxos import _num_suffix, _workload_pairs
@@ -110,9 +112,26 @@ class PingPongBinding(TwinBinding):
         return None
 
 
+class _NoDecodePairs:
+    """Command lookup for INFINITE workloads.  The twin models commands
+    opaquely by (client, seq), so SEARCH verdicts never need the
+    objects — but a replayed infinite stream drawn from the GLOBAL rng
+    cannot reproduce what the object clients actually sent, so decode
+    is a loud refusal rather than a silently-wrong reconstruction."""
+
+    def __getitem__(self, i):
+        raise NoTensorTwin(
+            "random infinite-workload commands are not reconstructible "
+            "— terminal-state decode and staged reuse are unavailable "
+            "for this binding (search verdicts are unaffected)")
+
+
 class ClientServerBinding(TwinBinding):
-    """One SimpleServer + NC ClientWorker(SimpleClient)s with finite KV
-    workloads; twin node indices: server 0, client c -> 1 + c."""
+    """One SimpleServer + NC ClientWorker(SimpleClient)s with finite OR
+    infinite KV workloads; twin node indices: server 0, client c ->
+    1 + c.  Infinite workloads bind with an unreachable done bound (the
+    per-client seq lanes are unbounded int32 either way) and lazy
+    command decode."""
 
     def __init__(self, state):
         workers = state.client_workers()
@@ -124,16 +143,30 @@ class ClientServerBinding(TwinBinding):
         self.addr_index = {self.server_name: 0}
         self.addr_index.update(
             {c: 1 + j for j, c in enumerate(self.client_names)})
-        pairs = [_workload_pairs(workers[a], a) for a in clients]
-        sizes = {len(p) for p in pairs}
-        if len(sizes) != 1:
-            raise NoTensorTwin(
-                f"per-client workload sizes differ ({sizes})")
-        self.w = sizes.pop()
-        self.pairs = pairs
-        self.key = ("clientserver", self.server_name,
-                    tuple(self.client_names),
-                    tuple(repr(c) for p in pairs for c, _ in p))
+        infinite = [workers[a].workload.infinite() for a in clients]
+        if all(infinite):
+            self.w = 1 << 20        # done (k == w + 1) is unreachable
+            self.pairs = [_NoDecodePairs() for _ in clients]
+            # Per-binding nonce: two bindings over random streams are
+            # never interchangeable, so a staged state from one phase is
+            # loudly refused by the next phase's provenance-key check
+            # (backend.derive_root) instead of replaying wrong commands.
+            self.key = ("clientserver", self.server_name,
+                        tuple(self.client_names), "infinite",
+                        uuid.uuid4().hex)
+        elif any(infinite):
+            raise NoTensorTwin("mixed finite/infinite workloads")
+        else:
+            pairs = [_workload_pairs(workers[a], a) for a in clients]
+            sizes = {len(p) for p in pairs}
+            if len(sizes) != 1:
+                raise NoTensorTwin(
+                    f"per-client workload sizes differ ({sizes})")
+            self.w = sizes.pop()
+            self.pairs = pairs
+            self.key = ("clientserver", self.server_name,
+                        tuple(self.client_names),
+                        tuple(repr(c) for p in pairs for c, _ in p))
 
     def initial_caps(self):
         return 16, 4

@@ -70,7 +70,11 @@ def test_random_dfs_depth_limited():
                 .set_max_depth(100))
     settings.max_time(5)
     results = dfs(state, settings)
-    assert results.end_condition == EndCondition.TIME_EXHAUSTED
+    # The object RandomDFS restarts probes until the clock runs out; the
+    # tensor strategy (dfs -> strict BFS) may instead PROVE the bounded
+    # space clean first — a strictly stronger pass.
+    assert results.end_condition in (EndCondition.TIME_EXHAUSTED,
+                                     EndCondition.SPACE_EXHAUSTED)
     assert results.invariant_violating_state is None
 
 
