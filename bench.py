@@ -9,24 +9,28 @@ the frontier + visited set in HBM with one scalar sync per level.  All
 device arithmetic is int32/uint32 (round 1 crashed the TPU worker inside
 x64-emulated fingerprints; x64 is banned from device code).
 
-Round-4 structure (the round-3 verdict's ordering):
+Round-5 structure — BOUNDED and DIAGNOSABLE (the round-4 bench hit the
+driver timeout with an empty tail; VERDICT r4 item 1):
 
-1. **Calibration** — a shallow full-grid strict prefix measures the
-   per-kind valid-event occupancy (max deliverable messages/timers per
-   state) and derives the ev_budget with headroom: no hand-tuned budget
-   constants.  Any state past the budget WINDOW-SPILLS (strict) — the
-   budget is a throughput knob, never a correctness bound.
-2. **The headline is the STRICT rate** — a drop-free exact BFS
-   (dropped=0 enforced fatally; Search.java:405-505 semantics: BFS never
-   silently narrows) to depth 10, count-only final level.
-3. The beam rate (strict=False: routing/frontier-cap drops truncate
-   coverage beam-style and are REPORTED) is secondary, in ``beam``.
-
-Each phase runs in a SUBPROCESS: a TPU worker crash on an oversized
-config kills only that phase's process — the parent falls through
-instead of inheriting a dead TPU client (the round-1 failure mode).
-
-Always prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+* A **hard global deadline** (DSLABS_BENCH_DEADLINE_SECS, default 480 s):
+  every phase gets min(its own cap, time remaining); when the deadline
+  expires the parent prints the best-so-far JSON line and exits 0 — a
+  partial result with an attributable error beats a silent rc=124.
+* A **pre-flight** subprocess (tiny matmul) distinguishes a wedged
+  accelerator runtime from a slow compile: if 256x256 @ 256x256 cannot
+  finish in its window, the bench reports "TPU runtime wedged" instead
+  of hanging (the round-4 judging failure mode).
+* **Heartbeats on stderr**: phase start/end lines here plus per-level
+  lines from the search children (DSLABS_LEVEL_TIMING) — stderr passes
+  straight through, stdout carries exactly one JSON line.
+* **compile_secs** is measured (the warm-up run) and reported per phase.
+* **Calibration is cached** (/tmp/dslabs_bench_cal.json, keyed by the
+  protocol signature) so re-runs spend their window on the measurement.
+* The **strict drop-free rate is the headline** (Search.java:405-505
+  semantics: BFS never silently narrows; dropped=0 enforced fatally),
+  one attempt, child-side time bound (a slow run returns a partial rate,
+  TIME_EXHAUSTED, instead of a parent kill).  Beam runs only with time
+  left and is reported under "beam".
 """
 
 import json
@@ -38,21 +42,40 @@ import traceback
 
 BASELINE_STATES_PER_MIN = 1e8
 
-# (chunk_per_device, frontier_cap, visited_cap) — per device.  Beam
-# ladder: round-3 measured config (occupancy-compacted split event
-# grids, packed P1B payloads, row-native expand, tail-compacted visited
-# probe -> 4.0M unique states/min on one v5e chip at the lead rung).
-LADDER = [
-    (8192, 1 << 19, 1 << 24),  # lead: ~495 ms/chunk steady at (40, 8)
-    (1024, 1 << 18, 1 << 23),  # fallback if the big rung OOMs
+DEADLINE_SECS = float(os.environ.get("DSLABS_BENCH_DEADLINE_SECS", 480.0))
+PREFLIGHT_CAP_SECS = 150.0   # import+client init+first tiny compile
+CALIBRATE_CAP_SECS = 240.0
+STRICT_CAP_SECS = 420.0      # child budget cap; parent adds kill slack
+BEAM_CAP_SECS = 300.0
+# Parent backstop beyond the child's budget.  Generous on purpose: the
+# child's time checks are level-granular (a slow level can overrun
+# max_secs by ~30 s, sharded.py round-3 note), the strict child floors
+# its search at 45 s even when compile ate the budget, and teardown over
+# the tunnel costs seconds — a kill here loses the phase's number
+# entirely, so the slack must cover the worst honest overrun.
+KILL_SLACK_SECS = 150.0
+# Fallback budgets if calibration is unavailable (round-3 measured).
+FALLBACK_EV_BUDGET = (40, 8)
+CAL_CACHE = "/tmp/dslabs_bench_cal.json"
+# Beam ladder (chunk/device, frontier, visited): lead rung = the round-3
+# measured config; the smaller rungs are OOM fallbacks so a worker crash
+# on the big config still lands a beam number.
+BEAM_LADDER = [
+    (8192, 1 << 19, 1 << 24),
+    (1024, 1 << 18, 1 << 23),
     (64, 1 << 12, 1 << 18),
 ]
-RUNG_TIMEOUT_SECS = 540.0
-STRICT_TIMEOUT_SECS = 780.0
-CALIBRATE_TIMEOUT_SECS = 420.0
-# Fallback budgets if the calibration subprocess dies (its own crash
-# must not zero the whole bench); values = the round-3 measured ones.
-FALLBACK_EV_BUDGET = (40, 8)
+
+_T0 = time.time()
+
+
+def _remaining() -> float:
+    return DEADLINE_SECS - (time.time() - _T0)
+
+
+def _hb(msg: str) -> None:
+    print(f"[bench +{time.time() - _T0:6.1f}s] {msg}",
+          file=sys.stderr, flush=True)
 
 
 def _bench_protocol():
@@ -69,11 +92,42 @@ def _bench_protocol():
     return dataclasses.replace(protocol, goals={})
 
 
+_PROTO_SIG = "paxos-n3-c2-w1-s3-net64-t6-v5"
+
+
 def _persistent_cache():
     import jax
 
-    jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
+    if os.environ.get("DSLABS_FORCE_CPU"):
+        # The axon plugin pins jax_platforms at registration, so the
+        # JAX_PLATFORMS env var alone cannot select CPU — re-pin via
+        # config (same trick as tests/conftest.py).  CI and local
+        # structure-validation runs use this.
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_compilation_cache_dir",
+                          "/tmp/jaxcache-cpu")
+    else:
+        jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+# --------------------------------------------------------------- children
+
+def _preflight() -> dict:
+    """Tiny matmul on the accelerator: proves the runtime is alive and
+    reports platform/device count.  A wedge hangs HERE, in a bounded
+    subprocess, not inside a 400 s search phase."""
+    import jax
+    import jax.numpy as jnp
+
+    _persistent_cache()
+    t0 = time.time()
+    devs = jax.devices()
+    x = jnp.ones((256, 256), jnp.float32)
+    y = (x @ x).block_until_ready()
+    assert float(y[0, 0]) == 256.0
+    return {"platform": devs[0].platform, "n_devices": len(devs),
+            "secs": round(time.time() - t0, 1)}
 
 
 def _calibrate(max_depth: int = 7) -> dict:
@@ -138,10 +192,6 @@ def _run_rung(chunk_per_device: int, frontier_cap: int, visited_cap: int,
     from dslabs_tpu.tpu.sharded import ShardedTensorSearch, make_mesh
 
     mesh = make_mesh(len(jax.devices()))
-    # NO checkpointing inside the measured window by default (the async
-    # incremental dump is cheap, but the headline stays unencumbered;
-    # test_tpu_sharded.py covers kill-resume and the strict probe can
-    # demonstrate checkpoint overhead via DSLABS_BENCH_CKPT=1).
     # Warm-up depth 2, not 1: the final depth-limited level skips the
     # frontier promotion (count-only), so a depth-1 run would leave
     # _finish_level uncompiled and charge its compile to the window.
@@ -149,7 +199,9 @@ def _run_rung(chunk_per_device: int, frontier_cap: int, visited_cap: int,
         _bench_protocol(), mesh, chunk_per_device=chunk_per_device,
         frontier_cap=frontier_cap, visited_cap=visited_cap, max_depth=2,
         strict=False, ev_budget=ev_budget)
+    t_c = time.time()
     search.run()  # warm-up: compiles the chunk/finish programs
+    compile_secs = time.time() - t_c
     search.max_depth = 64
     search.max_secs = max_secs
     outcome = search.run()
@@ -162,28 +214,34 @@ def _run_rung(chunk_per_device: int, frontier_cap: int, visited_cap: int,
         "end": outcome.end_condition,
         "dropped": outcome.dropped,
         "elapsed": elapsed,
+        "compile_secs": round(compile_secs, 1),
     }
 
 
-def _run_strict(ev_budget) -> dict:
+def _run_strict(ev_budget, budget_secs: float) -> dict:
     """The drop-free HEADLINE number: a strict (exact, nothing
     truncated) BFS of the bench protocol to depth 10 — every valid event
     of every reachable state expanded, dropped=0 enforced fatally.
+
+    ``budget_secs`` bounds the whole phase CHILD-SIDE: whatever the
+    warm-up compile leaves is handed to search.max_secs, so a slow run
+    lands a partial rate (TIME_EXHAUSTED) instead of dying to the
+    parent's kill with nothing on stdout.
 
     Config notes: chunk 8192 (on one device the routing bucket holds the
     whole batch, so strict skips the in-chunk prefilter too); the
     calibrated ev_budget WINDOW-SPILLS (a state with more valid events
     re-steps its chunk at the next window — never a coverage cut); the
     final level counts fresh states without building the ~4x-over-cap
-    depth-10 frontier.  A warm-up run keeps compile out of the window.
-    DSLABS_BENCH_CKPT=1 additionally runs async incremental checkpoints
-    every 2 levels (the overhead-demonstration mode)."""
+    depth-10 frontier.  DSLABS_BENCH_CKPT=1 additionally runs async
+    incremental checkpoints every 2 levels (overhead-demonstration)."""
     import jax
 
     _persistent_cache()
 
     from dslabs_tpu.tpu.sharded import ShardedTensorSearch, make_mesh
 
+    t_phase = time.time()
     mesh = make_mesh(len(jax.devices()))
     ckpt = {}
     if os.environ.get("DSLABS_BENCH_CKPT"):
@@ -193,8 +251,11 @@ def _run_strict(ev_budget) -> dict:
         _bench_protocol(), mesh, chunk_per_device=8192,
         frontier_cap=(1 << 20) + (1 << 18), visited_cap=1 << 24,
         max_depth=2, strict=True, ev_budget=ev_budget, **ckpt)
+    t_c = time.time()
     search.run()  # warm-up: compiles chunk/finish/stats programs
+    compile_secs = time.time() - t_c
     search.max_depth = 10
+    search.max_secs = max(45.0, budget_secs - (time.time() - t_phase))
     t0 = time.time()
     outcome = search.run()
     return {
@@ -206,94 +267,216 @@ def _run_strict(ev_budget) -> dict:
         "end": outcome.end_condition,
         "dropped": outcome.dropped,
         "elapsed": time.time() - t0,
+        "compile_secs": round(compile_secs, 1),
     }
 
 
-def _probe_platform() -> tuple:
-    """Platform + device count WITHOUT initialising jax in this process —
-    the accelerator must stay free for the phase subprocesses."""
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c",
-             "import jax, json; d = jax.devices(); "
-             "print(json.dumps([d[0].platform, len(d)]))"],
-            capture_output=True, text=True, timeout=180.0)
-        return tuple(json.loads(out.stdout.strip().splitlines()[-1]))
-    except Exception:
-        return ("unknown", 0)
+# ----------------------------------------------------------------- parent
 
+def _sub(args, child_budget: float, label: str):
+    """Run a bench phase subprocess.  The child's stderr is TEE'd line
+    by line to this process's stderr (live heartbeats in the driver
+    tail) while the last lines are buffered so a failure's JSON error
+    stays attributable; stdout's last line is the phase JSON.  Returns
+    (parsed dict, None) or (None, error string)."""
+    import threading
 
-def _sub(args, timeout):
-    """Run a bench phase in a subprocess; (parsed dict, None) on success,
-    (None, error string) otherwise."""
+    timeout = child_budget + KILL_SLACK_SECS
+    _hb(f"phase {label}: start (budget {child_budget:.0f}s, "
+        f"kill at {timeout:.0f}s, deadline in {_remaining():.0f}s)")
+    t0 = time.time()
+    err_tail: list = []
+
+    def _tee(pipe):
+        for line in pipe:
+            sys.stderr.write(line)
+            sys.stderr.flush()
+            err_tail.append(line.rstrip()[:300])
+            del err_tail[:-5]
+
     try:
-        proc = subprocess.run(
+        env = dict(os.environ, DSLABS_LEVEL_TIMING="1")
+        proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__)] + args,
-            capture_output=True, text=True, timeout=timeout,
-            cwd=os.path.dirname(os.path.abspath(__file__)))
-        if proc.returncode == 0:
-            return json.loads(proc.stdout.strip().splitlines()[-1]), None
-        tail = (proc.stderr or proc.stdout).strip().splitlines()
-        return None, (tail[-1][:300] if tail
-                      else f"{args[0]} exited rc={proc.returncode}")
-    except subprocess.TimeoutExpired:
-        return None, f"{args[0]} timed out after {timeout}s"
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__)))
+        t = threading.Thread(target=_tee, args=(proc.stderr,),
+                             daemon=True)
+        t.start()
+        # wait() + read() instead of communicate(): communicate would
+        # spawn its OWN stderr drain thread and race the tee for lines.
+        # The child's stdout is one small JSON line printed at exit, so
+        # reading it after wait() cannot deadlock on a full pipe.
+        try:
+            proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+            err = (f"{label} killed at {timeout:.0f}s "
+                   "(accelerator hang or compile overrun; last stderr: "
+                   f"{' | '.join(err_tail[-2:])})")
+            _hb(f"phase {label}: TIMEOUT ({err})")
+            return None, err
+        stdout = proc.stdout.read()
+        t.join(timeout=5.0)
+        if proc.returncode == 0 and stdout.strip():
+            out = json.loads(stdout.strip().splitlines()[-1])
+            _hb(f"phase {label}: ok in {time.time() - t0:.0f}s")
+            return out, None
+        err = f"{label} exited rc={proc.returncode}"
+        if err_tail:
+            err += f" last-stderr={err_tail[-1]}"
+        _hb(f"phase {label}: FAILED ({err})")
+        return None, err
     except Exception:
-        return None, traceback.format_exc(
-            limit=2).strip().splitlines()[-1][:300]
+        err = traceback.format_exc(limit=2).strip().splitlines()[-1][:300]
+        _hb(f"phase {label}: ERROR ({err})")
+        return None, err
+
+
+def _load_cal_cache():
+    try:
+        with open(CAL_CACHE) as f:
+            data = json.load(f)
+        if data.get("sig") == _PROTO_SIG:
+            return data["cal"]
+    except Exception:
+        pass
+    return None
+
+
+def _store_cal_cache(cal) -> None:
+    try:
+        with open(CAL_CACHE, "w") as f:
+            json.dump({"sig": _PROTO_SIG, "cal": cal}, f)
+    except Exception:
+        pass
+
+
+def _emit(result: dict) -> None:
+    print(json.dumps(result))
+    sys.stdout.flush()
 
 
 def main() -> None:
-    platform, n_dev = _probe_platform()
-    max_secs = 120.0 if platform != "cpu" else 45.0
+    result = {
+        "metric": ("lab3-paxos strict BFS unique states/min "
+                   "(sharded tensor backend)"),
+        "value": 0.0, "unit": "states/min", "vs_baseline": 0.0,
+        "deadline_secs": DEADLINE_SECS,
+    }
+
+    # ---- phase 0: pre-flight (wedge detection + platform probe)
+    pf, pf_err = _sub(["--preflight"],
+                      min(PREFLIGHT_CAP_SECS, max(_remaining() - 30, 30)),
+                      "preflight")
+    if pf is None:
+        result["error"] = (
+            "TPU runtime wedged or unreachable: pre-flight 256x256 "
+            f"matmul failed ({pf_err})")
+        _emit(result)
+        return
+    platform, n_dev = pf["platform"], pf["n_devices"]
     on_cpu = platform == "cpu"
+    result["metric"] = (f"lab3-paxos strict BFS unique states/min "
+                        f"(sharded tensor backend, {platform} x{n_dev})")
+    result["preflight_secs"] = pf["secs"]
 
-    # ---- phase 1: measured budgets (no hand-tuned constants)
-    cal, cal_err = (None, "skipped on cpu") if on_cpu else _sub(
-        ["--calibrate"], CALIBRATE_TIMEOUT_SECS)
-    ev = ((cal["bm"], cal["bt"]) if cal else FALLBACK_EV_BUDGET)
-
-    # ---- phase 2: the strict drop-free headline (two attempts)
-    strict, strict_err = None, None
-    if not on_cpu:
-        for _ in range(2):
-            strict, strict_err = _sub(
-                ["--strict", str(ev[0]), str(ev[1])], STRICT_TIMEOUT_SECS)
-            if strict is not None:
-                break
-
-    # ---- phase 3: the beam throughput rate (secondary)
-    beam, beam_err = None, None
-    attempts = ([LADDER[0]] + LADDER if not on_cpu else [LADDER[-1]])
-    for chunk, f_cap, v_cap in attempts:
+    if on_cpu:
+        # CI / smoke shape: one small beam rung, no calibration.
         beam, beam_err = _sub(
-            ["--rung", str(chunk), str(f_cap), str(v_cap), str(max_secs),
-             str(ev[0]), str(ev[1])], RUNG_TIMEOUT_SECS)
+            ["--rung", "64", str(1 << 12), str(1 << 18), "30.0",
+             str(FALLBACK_EV_BUDGET[0]), str(FALLBACK_EV_BUDGET[1])],
+            min(BEAM_CAP_SECS, max(_remaining() - 15, 45)), "beam-cpu")
+        if beam:
+            result["metric"] = (
+                f"lab3-paxos BFS (beam) unique states/min "
+                f"(sharded tensor backend, {platform} x{n_dev})")
+            result["value"] = round(beam["value"], 1)
+            result["vs_baseline"] = round(
+                beam["value"] / BASELINE_STATES_PER_MIN, 6)
+            result["beam"] = beam
+        else:
+            result["error"] = beam_err
+        _emit(result)
+        return
+
+    # ---- phase 1: measured budgets (cached across runs)
+    cal = _load_cal_cache()
+    if cal is not None:
+        _hb(f"calibration: cache hit {cal}")
+        result["calibration"] = dict(cal, cached=True)
+    elif _remaining() > (STRICT_CAP_SECS + CALIBRATE_CAP_SECS
+                         + 2 * KILL_SLACK_SECS):
+        # Cold calibration only when it cannot starve the strict phase
+        # (raise DSLABS_BENCH_DEADLINE_SECS for the fully-calibrated
+        # run); otherwise the round-3 measured fallback budgets hold.
+        cal, cal_err = _sub(["--calibrate"], CALIBRATE_CAP_SECS,
+                            "calibrate")
+        if cal is not None:
+            _store_cal_cache(cal)
+            result["calibration"] = cal
+        else:
+            result["calibration_error"] = cal_err
+    else:
+        _hb("calibration: skipped (deadline reserves the window for "
+            "strict; fallback ev budgets apply)")
+    ev = (cal["bm"], cal["bt"]) if cal else FALLBACK_EV_BUDGET
+    result["ev_budget"] = list(ev)
+
+    # ---- phase 2: the strict drop-free headline (ONE attempt,
+    # child-side budget so a slow run still lands a partial rate).  The
+    # kill slack is reserved OUT of the remaining deadline so a floored
+    # child (compile ate the budget, 45 s search minimum) still emits
+    # its JSON before both the parent kill and the global deadline.
+    strict, strict_err = None, None
+    budget = min(STRICT_CAP_SECS, _remaining() - KILL_SLACK_SECS - 10)
+    if budget > 60:
+        strict, strict_err = _sub(
+            ["--strict", str(ev[0]), str(ev[1]), str(budget)],
+            budget, "strict")
+        if strict is not None:
+            result["strict"] = strict
+            result["value"] = round(strict["value"], 1)
+            result["vs_baseline"] = round(
+                strict["value"] / BASELINE_STATES_PER_MIN, 6)
+            result["compile_secs"] = strict.get("compile_secs")
+        else:
+            result["strict_error"] = strict_err
+    else:
+        result["strict_error"] = "skipped: deadline nearly exhausted"
+
+    # ---- phase 3: the beam throughput rate (only with time remaining;
+    # smaller fallback rungs catch an OOM on the lead config)
+    beam = beam_err = None
+    for chunk, f_cap, v_cap in BEAM_LADDER:
+        budget = min(BEAM_CAP_SECS, _remaining() - KILL_SLACK_SECS - 10)
+        if budget <= 60:
+            _hb("beam: skipped (deadline)")
+            break
+        run_secs = max(30.0, min(120.0, budget - 150.0))
+        beam, beam_err = _sub(
+            ["--rung", str(chunk), str(f_cap), str(v_cap),
+             str(run_secs), str(ev[0]), str(ev[1])], budget,
+            f"beam-{chunk}")
         if beam is not None:
             break
-
-    lead = strict or beam
-    value = lead["value"] if lead else 0.0
-    kind = "strict BFS" if strict else "BFS (beam)"
-    result = {
-        "metric": (f"lab3-paxos {kind} unique states/min "
-                   f"(sharded tensor backend, {platform} x{n_dev})"),
-        "value": round(value, 1),
-        "unit": "states/min",
-        "vs_baseline": round(value / BASELINE_STATES_PER_MIN, 6),
-        "ev_budget": list(ev),
-    }
-    if cal:
-        result["calibration"] = cal
-    if strict:
-        result["strict"] = strict
-    if beam:
+    if beam is not None:
         result["beam"] = beam
-    errs = [e for e in (cal_err, strict_err, beam_err)
-            if e and e != "skipped on cpu"]
-    if errs and not lead:
-        result["error"] = "; ".join(errs)
-    print(json.dumps(result))
+        if strict is None:
+            result["metric"] = (
+                f"lab3-paxos BFS (beam) unique states/min "
+                f"(sharded tensor backend, {platform} x{n_dev})")
+            result["value"] = round(beam["value"], 1)
+            result["vs_baseline"] = round(
+                beam["value"] / BASELINE_STATES_PER_MIN, 6)
+            result["compile_secs"] = beam.get("compile_secs")
+    elif strict is None:
+        result["error"] = "; ".join(
+            str(e) for e in (strict_err, beam_err) if e)
+
+    result["total_secs"] = round(time.time() - _T0, 1)
+    _emit(result)
 
 
 if __name__ == "__main__":
@@ -305,12 +488,16 @@ if __name__ == "__main__":
                                    float(sys.argv[5]), ev)))
         sys.exit(0)
     if len(sys.argv) >= 2 and sys.argv[1] == "--strict":
-        ev = ((int(sys.argv[2]), int(sys.argv[3]))
-              if len(sys.argv) > 3 else FALLBACK_EV_BUDGET)
-        print(json.dumps(_run_strict(ev)))
+        ev = (int(sys.argv[2]), int(sys.argv[3]))
+        budget = (float(sys.argv[4]) if len(sys.argv) > 4
+                  else STRICT_CAP_SECS)
+        print(json.dumps(_run_strict(ev, budget)))
         sys.exit(0)
     if len(sys.argv) >= 2 and sys.argv[1] == "--calibrate":
         print(json.dumps(_calibrate()))
+        sys.exit(0)
+    if len(sys.argv) >= 2 and sys.argv[1] == "--preflight":
+        print(json.dumps(_preflight()))
         sys.exit(0)
     try:
         main()

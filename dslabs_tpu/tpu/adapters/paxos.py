@@ -279,7 +279,15 @@ class PaxosBinding(TwinBinding):
 
         if kind in ("RESULTS_OK", "RESULTS_LINEARIZABLE",
                     "ALL_RESULTS_SAME", "PAXOS_MARKERS_VALID"):
-            return const_true
+            # value_level marks predicates the twin cannot falsify — the
+            # backend re-checks them object-side on sampled deepest
+            # states before trusting an exhaust verdict
+            # (backend.tensor_bfs).  Marked ONLY here, not on the shared
+            # const_true closure: the out-of-range structural uses below
+            # are true on both twins by construction and need no replay.
+            fn = lambda s: const_true(s)     # noqa: E731
+            fn.value_level = True
+            return fn
         if kind == "CLIENTS_DONE":
             def fn(s):
                 done = jnp.asarray(True)

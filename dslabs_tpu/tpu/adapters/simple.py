@@ -102,7 +102,9 @@ class PingPongBinding(TwinBinding):
 
         if kind in ("RESULTS_OK", "RESULTS_LINEARIZABLE",
                     "ALL_RESULTS_SAME"):
-            return lambda s: k(s) >= 0
+            fn = lambda s: k(s) >= 0    # noqa: E731
+            fn.value_level = True       # object-side re-check on exhaust
+            return fn
         if kind in ("CLIENTS_DONE", "CLIENT_DONE"):
             return lambda s: k(s) == w + 1
         if kind == "NONE_DECIDED":
@@ -242,7 +244,9 @@ class ClientServerBinding(TwinBinding):
 
         if kind in ("RESULTS_OK", "RESULTS_LINEARIZABLE",
                     "ALL_RESULTS_SAME"):
-            return lambda s: k(s, 0) >= 0
+            fn = lambda s: k(s, 0) >= 0  # noqa: E731
+            fn.value_level = True        # object-side re-check on exhaust
+            return fn
         if kind == "CLIENTS_DONE":
             def fn(s):
                 done = jnp.asarray(True)
@@ -459,7 +463,9 @@ class PrimaryBackupBinding(TwinBinding):
 
         if kind in ("RESULTS_OK", "RESULTS_LINEARIZABLE",
                     "ALL_RESULTS_SAME"):
-            return lambda s: k(s, 0) >= 0
+            fn = lambda s: k(s, 0) >= 0  # noqa: E731
+            fn.value_level = True        # object-side re-check on exhaust
+            return fn
         if kind == "CLIENTS_DONE":
             def fn(s):
                 done = jnp.asarray(True)

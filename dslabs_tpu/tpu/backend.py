@@ -87,12 +87,22 @@ def _norm_event(p, ev: int) -> tuple:
 
 
 def _denorm_event(p, op: tuple) -> int:
+    # Capacity misses here are ladder-retryable, not twin-missing: a
+    # history recorded by a phase that escalated the capacity ladder can
+    # reference slots beyond a lower rung's caps (ADVICE r4).  Lazy
+    # import (like every jax-adjacent import in this module) so the
+    # object-only path never pays the engine import.
+    from dslabs_tpu.tpu.engine import CapacityOverflow
+
     if op[0] == "ev_msg":
         if op[1] >= p.net_cap:
-            raise NoTensorTwin("provenance slot beyond net_cap")
+            raise CapacityOverflow(
+                f"provenance slot {op[1]} beyond net_cap {p.net_cap}")
         return op[1]
     if op[2] >= p.timer_cap:
-        raise NoTensorTwin("provenance timer slot beyond timer_cap")
+        raise CapacityOverflow(
+            f"provenance timer slot {op[2]} beyond timer_cap "
+            f"{p.timer_cap}")
     return p.net_cap + op[1] * p.timer_cap + op[2]
 
 
@@ -119,6 +129,20 @@ class TwinBinding:
 
     def predicate(self, tkey) -> Callable:
         raise NotImplementedError
+
+    def check_settings(self, settings) -> None:
+        """Hook: raise NoTensorTwin when the settings demand events the
+        twin does not model (e.g. live timers on an unmodeled node).
+        Bindings whose twins model every node's full event surface can
+        keep the default no-op."""
+
+    def derive_root(self, search, state):
+        """Hook: object initial/staged state -> (tensor root pytree or
+        None for the twin initial, provenance history).  Default = the
+        module-level provenance replay; bindings whose twin initial
+        state BAKES IN a staged prefix (lab 4's joined root) override
+        with validation-based mapping."""
+        return derive_root(self, search, state)
 
     def msg_mask_fn(self) -> Callable:
         """fn(msg_record, [NN*NN] link matrix) -> deliverable, for the
@@ -159,6 +183,7 @@ def _load_adapters() -> None:
     # Import for registration side effects; lazy to avoid jax import cost
     # on the object path.
     from dslabs_tpu.tpu.adapters import paxos as _p  # noqa: F401
+    from dslabs_tpu.tpu.adapters import shardstore as _ss  # noqa: F401
     from dslabs_tpu.tpu.adapters import simple as _s  # noqa: F401
 
 
@@ -259,7 +284,8 @@ def derive_root(binding: TwinBinding, search, state):
     import jax
     import jax.numpy as jnp
 
-    from dslabs_tpu.tpu.engine import SENTINEL, flatten_state
+    from dslabs_tpu.tpu.engine import (CapacityOverflow, SENTINEL,
+                                       flatten_state)
 
     prov = getattr(state, "_tensor_provenance", None)
     if prov is None:
@@ -298,7 +324,14 @@ def derive_root(binding: TwinBinding, search, state):
     for op in prov.history:
         if op[0] in ("ev_msg", "ev_tmr"):
             ev = _denorm_event(p, op)
-            succ, valid, _ = step(jnp.asarray(row), jnp.asarray(ev))
+            succ, valid, over = step(jnp.asarray(row), jnp.asarray(ev))
+            if int(over):
+                # The replayed transition itself overflowed this rung's
+                # net/timer caps — a truncated root would corrupt every
+                # downstream verdict, so escalate the ladder instead.
+                raise CapacityOverflow(
+                    f"provenance replay of {op!r} overflowed caps "
+                    f"(net_cap={p.net_cap}, timer_cap={p.timer_cap})")
             if not bool(valid):
                 raise NoTensorTwin(
                     f"provenance replay hit undeliverable event {op!r}")
@@ -322,7 +355,9 @@ def derive_root(binding: TwinBinding, search, state):
             merged = {tuple(r) for r in have} | {tuple(r) for r in back}
             rows = sorted(merged)
             if len(rows) > p.net_cap:
-                raise NoTensorTwin("undrop overflowed net capacity")
+                raise CapacityOverflow(
+                    f"undrop needs {len(rows)} net slots > cap "
+                    f"{p.net_cap}")
             net[:] = SENTINEL
             for i, r in enumerate(rows):
                 net[i] = r
@@ -350,6 +385,11 @@ def _run_tensor(binding: TwinBinding, settings, state, chunk=512):
     net_cap, timer_cap = binding.initial_caps()
     mesh = make_mesh(len(jax.devices()))
     last: Optional[Exception] = None
+    # check_settings BEFORE build_protocol: bindings bind settings-
+    # dependent modelling flags there (lab4's live-master-timer /
+    # controller-debris surface) and the protocol shape must reflect
+    # them on the FIRST attempt, not after a capacity retry.
+    binding.check_settings(settings)
     for attempt, (f_cap, v_cap) in enumerate(_LADDER):
         protocol = binding.build_protocol(net_cap << attempt,
                                           timer_cap + 2 * attempt)
@@ -368,13 +408,16 @@ def _run_tensor(binding: TwinBinding, settings, state, chunk=512):
             protocol, mesh, chunk_per_device=chunk, frontier_cap=f_cap,
             visited_cap=v_cap, strict=True, record_trace=True)
         search.set_runtime_masks(marr, tarr)
-        root, history = derive_root(binding, search, state)
         rel = None
         if settings.depth_limited():
             rel = settings.max_depth - state.depth
             if rel < 0:
                 raise NoTensorTwin("staged state already beyond max_depth")
         try:
+            # Inside the attempt: a root recorded by a phase that ran at
+            # a higher ladder rung can overflow this rung's caps, and
+            # must escalate rather than fail the test (ADVICE r4).
+            root, history = binding.derive_root(search, state)
             if settings.max_time_secs is not None and (
                     rel is None or rel > 2):
                 # Warm-up excludes compile time from the test's time
@@ -405,6 +448,32 @@ def _materialize(binding, search, outcome, state, history):
         binding.key, list(history) + [_norm_event(search.p, e)
                                       for e in outcome.trace])
     return obj
+
+
+def _sampled_value_recheck(binding, search, outcome, settings, state):
+    """Value-level invariants (RESULTS_OK and friends) collapse to
+    constant-true lane predicates on the twin, so the tensor search can
+    never falsify them mid-run; before an exhaust verdict is trusted,
+    replay the outcome's sampled deepest states on the OBJECT twin and
+    check every value-level invariant there (ADVICE r4).  Returns the
+    first violated ``(object_state, predicate, result)`` or ``None``."""
+    if not outcome.samples:
+        return None
+    value_preds = [p for p in settings.invariants
+                   if getattr(translate_predicate(binding, p),
+                              "value_level", False)]
+    if not value_preds:
+        return None
+    from dslabs_tpu.tpu.trace import replay_on_object
+
+    for tr in outcome.samples:
+        shim = dataclasses.replace(outcome, trace=list(tr))
+        obj = replay_on_object(search, shim, state)
+        for p in value_preds:
+            r = p.check(obj)
+            if not r.value:
+                return obj, p, r
+    return None
 
 
 def tensor_bfs(initial_state, settings=None):
@@ -450,21 +519,36 @@ def tensor_bfs(initial_state, settings=None):
                            history)
         results.exception_thrown(obj)
         results.end_condition = EndCondition.EXCEPTION_THROWN
-    elif end == "TIME_EXHAUSTED":
-        results.end_condition = EndCondition.TIME_EXHAUSTED
     else:
-        # SPACE_EXHAUSTED, DEPTH_EXHAUSTED, CAPACITY_EXHAUSTED: the
-        # object checker treats the depth limit as a prune and reports
-        # SPACE_EXHAUSTED (Search.java:222-229).
-        results.end_condition = EndCondition.SPACE_EXHAUSTED
+        hit = _sampled_value_recheck(binding, search, outcome, settings,
+                                     initial_state)
+        if hit is not None:
+            obj, pred, r = hit
+            results.invariant_violated(obj, r)
+            results.end_condition = EndCondition.INVARIANT_VIOLATED
+        elif end == "TIME_EXHAUSTED":
+            results.end_condition = EndCondition.TIME_EXHAUSTED
+        else:
+            # SPACE_EXHAUSTED, DEPTH_EXHAUSTED, CAPACITY_EXHAUSTED: the
+            # object checker treats the depth limit as a prune and
+            # reports SPACE_EXHAUSTED (Search.java:222-229).
+            results.end_condition = EndCondition.SPACE_EXHAUSTED
     return results
 
 
 def tensor_dfs(initial_state, settings=None):
-    """Tensor strategy for dfs call sites.  The tensor engine has no
-    randomized DFS: a strict BFS under the same settings subsumes the
-    random probe's bug-finding power within the same time budget (every
-    state a random walk could reach at depth d is covered by BFS level d,
-    and the verdict vocabulary is identical), so dfs requests run the
-    BFS strategy.  RandomDFS remains the object-path default."""
+    """Tensor strategy for dfs call sites: a strict BFS under the same
+    settings.
+
+    KNOWN COVERAGE DIFFERENCE (ADVICE r4): this is NOT an exact
+    substitute for RandomDFS under a *time* budget — a random walk
+    reaches depth-d states in O(d) steps while BFS must exhaust every
+    shallower level first, so a deep, narrow violation can fall outside
+    the BFS time horizon that a lucky probe would hit.  In exchange BFS
+    is exhaustive at every depth it completes (no luck involved) and its
+    violations are minimal-depth.  Call sites that specifically need
+    deep probes keep the object RandomDFS (the default strategy for
+    dfs when no twin is bound); the depth-limited lab searches — every
+    dfs use in the reference suites has maxDepth set — are exactly the
+    budget shape where BFS dominates."""
     return tensor_bfs(initial_state, settings)

@@ -172,6 +172,11 @@ class SearchOutcome:
     exception_code: int = 0
     trace: Optional[list] = None     # [(parent event id, ...)] — see trace.py
     dropped: int = 0                 # beam-truncation drops (strict=False)
+    # Trace-mode exhaust verdicts carry a few deepest-state traces so the
+    # caller can re-check value-level invariants (which collapse to
+    # constant-true lane predicates on the twin) on replayed OBJECT
+    # states before trusting the exhaustion (ADVICE r4).
+    samples: Optional[list] = None   # [root-first event-id list, ...]
 
 
 # ----------------------------------------------------------------- hashing

@@ -1,0 +1,195 @@
+"""Tensorised twin of lab 4's JOIN phase: one shard master (a lone
+PaxosServer running the ShardMaster application) + the config controller
+(a PaxosClient ClientWorker) driving G sequential Join commands, with
+every store server cut off (ShardStoreBaseTest.java:209-220 narrows the
+partition to {CCA, shard masters} and suppresses store-server timers —
+tests/test_lab4_shardstore.py _joined_state mirrors it).
+
+Why the state collapses (labs/paxos/paxos.py):
+
+* A ONE-server Paxos group decides synchronously: ``init`` self-elects
+  immediately (paxos.py:201-205), ``_send_to_all`` delivers the leader's
+  own P1a/P2a/P2b locally, majority = 1 — so a fresh PaxosRequest is
+  proposed, chosen, executed, and GC'd inside the handler call.  The
+  replicated log is empty in every reachable state; what remains is the
+  decided-slot COUNT, the per-client AMO high-water mark, and the
+  ``heard_from_leader`` flag (set by the self-delivered P2a on every
+  fresh proposal, cleared by ElectionTimer; paxos.py:261-265 never
+  re-elects a leader whose ballot is its own, so the ballot from the
+  init self-election is CONSTANT).
+
+* ``on_HeartbeatTimer`` for a lone server is a pure re-arm:
+  ``_send_heartbeats`` broadcasts to peers only (paxos.py:412-414) and
+  every slot is already chosen, so the P2a retransmit loop is empty.
+
+* The client (PaxosClient, paxos.py:490-520) broadcasts the pending
+  command to its single master and retries on ClientTimer; Join results
+  are Ok() for distinct groups — value-collapsed like every app result
+  (the adapter re-checks RESULTS_OK object-side via the backend's
+  sampled exhaust re-check).
+
+Node lanes (flat): [mc, amo, heard, k]
+  mc     master decided-slot count
+  amo    master's AMO high-water mark for the controller
+  heard  master heard_from_leader
+  k      controller workload index (W+1 = done)
+Message lanes [tag, seq]: REQ = PaxosRequest(AMOCommand(Join_seq, cca,
+seq)), REP = PaxosReply(AMOResult(Ok, seq)).
+Timer lanes [tag, mn, mx, p0]: ELECTION / HEARTBEAT (master),
+CLIENT(seq) (controller).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from dslabs_tpu.tpu.engine import SENTINEL, TensorProtocol
+
+__all__ = ["make_join_protocol", "REQ", "REP", "T_ELECTION",
+           "T_HEARTBEAT", "T_CLIENT", "CLIENT_MS", "ELECTION_MIN",
+           "ELECTION_MAX", "HEARTBEAT_MS"]
+
+REQ, REP = 0, 1
+T_CLIENT, T_ELECTION, T_HEARTBEAT = 1, 2, 3
+
+CLIENT_MS = 100                         # paxos.py CLIENT_RETRY_MILLIS
+ELECTION_MIN, ELECTION_MAX = 150, 300   # paxos.py ELECTION_MILLIS_*
+HEARTBEAT_MS = 50
+
+
+def make_join_protocol(n_joins: int, net_cap: int = 12,
+                       timer_cap: int = 4) -> TensorProtocol:
+    W = n_joins
+    MC, AMO, HEARD, K = range(4)
+    MASTER, CLIENT = 0, 1
+    MW, TW = 2, 4
+
+    def msg_row(cond, tag, seq):
+        rec = jnp.stack([jnp.asarray(x, jnp.int32) for x in (tag, seq)])
+        return jnp.where(cond, rec,
+                         jnp.full((MW,), SENTINEL, jnp.int32))[None]
+
+    def timer_row(cond, node, tag, mn, mx, p0):
+        rec = jnp.stack([jnp.asarray(x, jnp.int32)
+                         for x in (node, tag, mn, mx, p0)])
+        return jnp.where(cond, rec,
+                         jnp.full((1 + TW,), SENTINEL, jnp.int32))[None]
+
+    blank_msg = jnp.full((1, MW), SENTINEL, jnp.int32)
+    blank_set = jnp.full((1, 1 + TW), SENTINEL, jnp.int32)
+
+    def step_message(nodes, msg):
+        tag, seq = msg[0], msg[1]
+        sends = []
+        tsets = []
+
+        # ---- REQ -> master (paxos.py handle_PaxosRequest; n=1: a fresh
+        # command is chosen+executed+GC'd inline, and the self-delivered
+        # P2a sets heard_from_leader)
+        is_req = tag == REQ
+        last = nodes[AMO]
+        fresh = is_req & (seq > last)
+        nodes = nodes.at[AMO].set(
+            jnp.where(fresh, seq, last).astype(jnp.int32))
+        nodes = nodes.at[MC].set(
+            jnp.where(fresh, nodes[MC] + 1, nodes[MC]).astype(jnp.int32))
+        nodes = nodes.at[HEARD].set(
+            jnp.where(fresh, 1, nodes[HEARD]).astype(jnp.int32))
+        # reply for fresh or exactly-cached seq (AMO re-reply)
+        sends.append(msg_row(is_req & (seq >= last), REP, seq))
+
+        # ---- REP -> controller (ClientWorker pumps the next Join)
+        k = nodes[K]
+        match = (tag == REP) & (seq == k) & (k <= W)
+        k2 = jnp.where(match, k + 1, k)
+        nodes = nodes.at[K].set(k2.astype(jnp.int32))
+        has_next = match & (k2 <= W)
+        sends.append(msg_row(has_next, REQ, k2))
+        tsets.append(timer_row(has_next, CLIENT, T_CLIENT,
+                               CLIENT_MS, CLIENT_MS, k2))
+
+        sends = jnp.concatenate(
+            sends + [blank_msg] * (MAX_SENDS - len(sends)))
+        tsets = jnp.concatenate(
+            tsets + [blank_set] * (MAX_SETS - len(tsets)))
+        return nodes, sends[:MAX_SENDS], tsets[:MAX_SETS]
+
+    def step_timer(nodes, node_idx, timer):
+        tag, p0 = timer[0], timer[3]
+        sends = []
+        tsets = []
+
+        # ---- ElectionTimer (paxos.py:261-265): the lone master is its
+        # own decided leader, so only heard resets; always re-arms.
+        is_el = (node_idx == MASTER) & (tag == T_ELECTION)
+        nodes = nodes.at[HEARD].set(
+            jnp.where(is_el, 0, nodes[HEARD]).astype(jnp.int32))
+        tsets.append(timer_row(is_el, MASTER, T_ELECTION,
+                               ELECTION_MIN, ELECTION_MAX, 0))
+
+        # ---- HeartbeatTimer: no peers, nothing in flight — pure re-arm.
+        is_hb = (node_idx == MASTER) & (tag == T_HEARTBEAT)
+        tsets.append(timer_row(is_hb, MASTER, T_HEARTBEAT,
+                               HEARTBEAT_MS, HEARTBEAT_MS, 0))
+
+        # ---- ClientTimer (paxos.py:505-520): re-broadcast the pending
+        # request and re-arm while it is still outstanding.
+        k = nodes[K]
+        live = ((node_idx == CLIENT) & (tag == T_CLIENT) & (p0 == k)
+                & (k <= W))
+        sends.append(msg_row(live, REQ, k))
+        tsets.append(timer_row(live, CLIENT, T_CLIENT,
+                               CLIENT_MS, CLIENT_MS, k))
+
+        sends = jnp.concatenate(
+            sends + [blank_msg] * (MAX_SENDS - len(sends)))
+        tsets = jnp.concatenate(
+            tsets + [blank_set] * (MAX_SETS - len(tsets)))
+        return nodes, sends[:MAX_SENDS], tsets[:MAX_SETS]
+
+    MAX_SENDS = 2
+    MAX_SETS = 3
+
+    def init_nodes():
+        # Master self-elected at init (heard still False — handle_P1a/P1b
+        # do not touch heard_from_leader); the controller's first Join is
+        # in flight.
+        nodes = np.zeros((4,), np.int32)
+        nodes[K] = 1
+        return nodes
+
+    def init_messages():
+        return np.array([[REQ, 1]], np.int32)
+
+    def init_timers():
+        return np.array([
+            [MASTER, T_ELECTION, ELECTION_MIN, ELECTION_MAX, 0],
+            [MASTER, T_HEARTBEAT, HEARTBEAT_MS, HEARTBEAT_MS, 0],
+            [CLIENT, T_CLIENT, CLIENT_MS, CLIENT_MS, 1],
+        ], np.int32)
+
+    def msg_dest(msg):
+        return jnp.where(msg[0] == REQ, MASTER, CLIENT).astype(jnp.int32)
+
+    def clients_done(state):
+        return state["nodes"][K] == W + 1
+
+    return TensorProtocol(
+        name=f"shardmaster-join-w{W}",
+        n_nodes=2,
+        node_width=4,
+        msg_width=MW,
+        timer_width=TW,
+        net_cap=net_cap,
+        timer_cap=timer_cap,
+        max_sends=MAX_SENDS,
+        max_sets=MAX_SETS,
+        init_nodes=init_nodes,
+        init_messages=init_messages,
+        init_timers=init_timers,
+        step_message=step_message,
+        step_timer=step_timer,
+        msg_dest=msg_dest,
+        goals={"CLIENTS_DONE": clients_done},
+    )

@@ -1,7 +1,7 @@
 """Tensorised twin of lab 4's sharded KV store for the search-test
-configurations (ShardStorePart1Test.test10-12 shape): G groups of ONE
-server each, one shard master, one client, the config controller and
-master timers frozen (tests/test_lab4_shardstore.py test10-12 mirror
+configurations (ShardStorePart1Test.test10-12 shapes): G groups of ONE
+server each, one shard master, NC clients, the config controller and
+master timers frozen (tests/test_lab4_shardstore.py test10-13 mirror
 these settings from ShardStoreBaseTest.java:209-220).
 
 Why the state collapses (all against the object implementations in
@@ -29,41 +29,42 @@ dslabs_tpu/labs/shardedstore/shardstore.py and labs/paxos/paxos.py):
   (shardstore.py _apply_new_config).  Installing cfg1 at group 1 stores
   a SNAPSHOT of the lost shards' kv + the full AMO map in ``outgoing``;
   every later QueryTimer re-sends the SAME stored ShardMove, so the
-  move's content is one integer: group 1's last-executed client seq at
-  install time.  Group 2 proposes InstallShards on a matching move
-  (owned |= shards, AMO merged as a per-client max), acks, and group 1's
-  MoveDone clears outgoing.  While a handoff is pending,
-  ``_reconfig_done`` gates further queries (on_QueryTimer) and config
-  installs.
+  move's content is the per-client executed-seq vector at install time.
+  Group 2 proposes InstallShards on a matching move (owned |= shards,
+  AMO merged as a per-client max), acks, and group 1's MoveDone clears
+  outgoing.  While a handoff is pending, ``_reconfig_done`` gates
+  further queries (on_QueryTimer) and config installs.
 
-* The client always queries with arg -1, so it only ever learns the
-  LATEST config — one has-config bit — and routes commands by that
+* Every client queries with arg -1, so it only ever learns the LATEST
+  config — one has-config bit per client — and routes commands by that
   final mapping; a group that does not yet cover a command's shard
   answers WrongGroup (config current, shard not mine) or stays silent
   (shard mine but still in flight), both mirrored per scfg/in_flag.
 
-Node lanes (node order: 0 = master, 1..G = group servers, G+1 = client):
-  master  [mc, mamo_c, mamo_s1..mamo_sG]   decided count + AMO per source
-  server g [scfg, samo, scount, sh, sq, out_flag, out_samo, in_flag]
+Node lanes (node order: 0 = master, 1..G = group servers,
+G+1..G+NC = clients); NC = number of clients:
+  master  [mc, mamo_c1..cNC, mamo_s1..sG]  decided count + AMO per source
+  server g [scfg, scnt, sh, sq, out_flag, in_flag,
+            samo_c1..cNC, osamo_c1..cNC]
     scfg: 0 = no config, i+1 = configs[i] installed
-  client  [k, cfg, cq]                     workload index (W+1 = done),
+  client c [k, cfg, cq]                    workload index (W_c+1 = done),
                                            latest config known, query seq
 
-Message lanes [tag, a, b, c]:
-  QRY   [src, seq, arg]      PaxosRequest(AMOCommand(Query(arg), src, seq))
-                             src: 0 = client, g = server g
-  QREP  [dst, seq, kind]     PaxosReply(AMOResult(configs[kind], seq))
-  SSREQ [k, 0, 0]            ShardStoreRequest(AMOCommand(cmd_k, client, k))
-  SSREP [k, 0, 0]            ShardStoreReply(AMOResult(result_k, k))
-  WG    [k, 0, 0]            WrongGroup(k)
-  SM    [to_g, samo, 0]      ShardMove(cfg1, from g1, shards, snapshot)
-  SMACK [to_g, 0, 0]         ShardMoveAck(cfg1, shards)
+Message lanes [tag, a, b, c, ...] (MW = max(4, 2 + NC)):
+  QRY   [src, seq, arg]    PaxosRequest(AMOCommand(Query(arg), src, seq))
+                           src: c in [0, NC) = client c, NC+g-1 = server g
+  QREP  [dst, seq, kind]   PaxosReply(AMOResult(configs[kind], seq))
+  SSREQ [c, k]             ShardStoreRequest(AMOCommand(cmd, client_c, k))
+  SSREP [c, k]             ShardStoreReply(AMOResult(result, k))
+  WG    [c, k]             WrongGroup(k)
+  SM    [to_g, samo_1..NC] ShardMove(cfg1, from g1, shards, snapshot)
+  SMACK [to_g]             ShardMoveAck(cfg1, shards)
 Timer lanes [tag, min, max, p0]: CLIENT(seq) / QUERY / ELECTION / HEARTBEAT.
 """
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import List
 
 import jax.numpy as jnp
 import numpy as np
@@ -72,7 +73,7 @@ from dslabs_tpu.tpu.engine import SENTINEL, TensorProtocol
 
 __all__ = ["make_shardstore_protocol"]
 
-QRY, QREP, SSREQ, SSREP, WG, SM, SMACK = range(7)
+QRY, QREP, SSREQ, SSREP, WG, SM, SMACK, JREQ, JREP = range(9)
 T_CLIENT, T_QUERY, T_ELECTION, T_HEARTBEAT = 1, 2, 3, 4
 
 CLIENT_MS = 100     # shardstore.py CLIENT_RETRY_MILLIS
@@ -81,48 +82,85 @@ ELECTION_MIN, ELECTION_MAX = 150, 300   # paxos.py
 HEARTBEAT_MS = 50
 
 
-def make_shardstore_protocol(groups_of: Sequence[int],
+def make_shardstore_protocol(groups_of,
                              net_cap: int = 48,
-                             timer_cap: int = 6) -> TensorProtocol:
-    """``groups_of[k-1]`` = the group (1-based) owning workload command
-    k's key under the FINAL config — precomputed on the host with the
-    same ShardMaster rebalance the object system runs (see
-    tests/test_tpu_lab4.py).  G = max(groups_of); with G = 2 the config
-    walk and the g1 -> g2 handoff are modelled (groups are built by
-    successive Joins, so every shard a 2-group config assigns to g2 was
-    g1's under cfg0)."""
-    W = len(groups_of)
-    G = max(groups_of)
-    assert min(groups_of) >= 1
+                             timer_cap: int = 6,
+                             model_master_timers: bool = False,
+                             model_ctl: bool = False) -> TensorProtocol:
+    """``groups_of``: per-client, per-command owning group (1-based)
+    under the FINAL config — ``groups_of[c][k-1]`` for client c's k-th
+    command; a flat int list means one client (the original test10/11
+    shape).  Precomputed on the host with the same ShardMaster rebalance
+    the object system runs (see tests/test_tpu_lab4.py).
+    G = max over all; with G = 2 the config walk and the g1 -> g2
+    handoff are modelled (groups are built by successive Joins, so every
+    shard a 2-group config assigns to g2 was g1's under cfg0)."""
+    # ``model_master_timers``: the master's election/heartbeat timers are
+    # live (test13's random search narrows nothing) — one extra heard
+    # lane toggled exactly like the group servers'.  ``model_ctl``: the
+    # config controller node and its join-phase debris are deliverable —
+    # G pending ClientTimers (stale: delivery consumes, no re-arm,
+    # paxos.py:505-520 with pending=None) and the 2G join REQ/REP
+    # messages (REQ(G) re-replies the CACHED identical REP, every other
+    # delivery is a no-op self-loop).  Both default off: the test10-12
+    # settings suppress these events, and the runtime masks make them
+    # invalid anyway — modelling them would only widen the grids.
+    if groups_of and isinstance(groups_of[0], int):
+        groups_of = [list(groups_of)]
+    per_client: List[List[int]] = [list(g) for g in groups_of]
+    NC = len(per_client)
+    Ws = [len(g) for g in per_client]
+    G = max(max(g) for g in per_client)
+    assert all(min(g) >= 1 for g in per_client)
     assert G <= 2, "3+-group configs need multi-hop handoff modelling"
     N_CFG = G                       # one config per staged Join
-    MW, TW = 4, 4
-    NW = (2 + G) + 8 * G + 3
-    N_NODES = 1 + G + 1
-    CLIENT = G + 1
+    MW = max(4, 2 + NC)
+    TW = 4
+    SB = 6 + 2 * NC                 # server block width
+    NW = (2 + NC + G) + SB * G + 3 * NC
+    # CCA rides as a last node only when its debris is deliverable (its
+    # only mutable state is the timer queue the engine already models).
+    N_NODES = 1 + G + NC + (1 if model_ctl else 0)
+    CCA = 1 + G + NC
 
     # lane offsets
-    M_MC, M_AMOC, M_AMOS = 0, 1, 2            # master (M_AMOS + g-1)
-    SRV = 2 + G                               # server g base: SRV + 8*(g-1)
-    C_K = SRV + 8 * G
-    C_CFG, C_CQ = C_K + 1, C_K + 2
+    M_MC = 0
+    M_H = 1                                   # heard_from_leader
+    M_AMOC = 2                                # + c
+    M_AMOS = 2 + NC                           # + g-1
+    SRV = 2 + NC + G                          # server g: SRV + SB*(g-1)
+    CLI = SRV + SB * G                        # client c: CLI + 3*c
     # server lane offsets within a block
-    S_CFG, S_AMO, S_CNT, S_H, S_Q, S_OUT, S_OSAMO, S_IN = range(8)
+    S_CFG, S_CNT, S_H, S_Q, S_OUT, S_IN = range(6)
+    S_AMO = 6                                 # + c
+    S_OSAMO = 6 + NC                          # + c
 
     def srv(g, off):
-        return SRV + 8 * (g - 1) + off
+        return SRV + SB * (g - 1) + off
 
-    def grp_of(k):
-        """Traced workload index -> owning group under the final config
-        (static where-chain)."""
-        out = jnp.asarray(groups_of[0], jnp.int32)
-        for kk in range(2, W + 1):
-            out = jnp.where(k == kk, groups_of[kk - 1], out)
+    def cli(c, off):
+        return CLI + 3 * c + off
+
+    def node_of(c):
+        return G + 1 + c
+
+    def grp_of(c, k):
+        """Traced (client, workload index) -> owning group under the
+        final config (static where-chain)."""
+        out = jnp.asarray(per_client[0][0], jnp.int32)
+        for cs in range(NC):
+            for kk in range(1, Ws[cs] + 1):
+                if (cs, kk) == (0, 1):
+                    continue
+                out = jnp.where((c == cs) & (k == kk),
+                                per_client[cs][kk - 1], out)
         return out
 
-    def msg_row(cond, tag, a, b=0, c=0):
-        rec = jnp.stack([jnp.asarray(x, jnp.int32) for x in (tag, a, b, c)])
-        return jnp.where(cond, rec, jnp.full((MW,), SENTINEL, jnp.int32))[None]
+    def msg_row(cond, tag, *payload):
+        vals = [tag, *payload] + [0] * (MW - 1 - len(payload))
+        rec = jnp.stack([jnp.asarray(x, jnp.int32) for x in vals])
+        return jnp.where(cond, rec,
+                         jnp.full((MW,), SENTINEL, jnp.int32))[None]
 
     def timer_row(cond, node, tag, mn, mx, p0):
         rec = jnp.stack([jnp.asarray(x, jnp.int32)
@@ -140,12 +178,11 @@ def make_shardstore_protocol(groups_of: Sequence[int],
         kind = jnp.where((arg < 0) | (arg >= N_CFG), latest, arg)
         return kind.astype(jnp.int32)
 
-    # Does group g own command k's shard under configs[idx] (0-based)?
-    # cfg0 assigns everything to group 1; the final config follows
-    # groups_of.  "mine" = the config's assignment; "owned" additionally
-    # needs the handoff to have completed (S_IN == 0 for gained shards).
-    def cfg_mine(g, cfg_idx, k):
-        under_final = grp_of(k) == g
+    # Does group g own command (c, k)'s shard under configs[idx]
+    # (0-based)?  cfg0 assigns everything to group 1; the final config
+    # follows groups_of.
+    def cfg_mine(g, cfg_idx, c, k):
+        under_final = grp_of(c, k) == g
         if g == 1:
             return jnp.where(cfg_idx == 0, True, under_final)
         return jnp.where(cfg_idx == 0, False, under_final)
@@ -153,16 +190,18 @@ def make_shardstore_protocol(groups_of: Sequence[int],
     # ------------------------------------------------------------- handlers
 
     def step_message(nodes, msg):
-        tag, a, b, c = msg[0], msg[1], msg[2], msg[3]
+        tag, a, b = msg[0], msg[1], msg[2]
         sends = []
         tsets = []
 
         # ---- QRY -> master (paxos.py handle_PaxosRequest; n=1: fresh
-        # commands decide+execute+GC inline)
+        # commands decide+execute+GC inline).  Sources: clients 0..NC-1,
+        # servers NC..NC+G-1.
         is_qry = tag == QRY
-        src, seq, arg = a, b, c
-        for sidx in range(0, G + 1):
-            lane = M_AMOC if sidx == 0 else M_AMOS + sidx - 1
+        src, seq, arg = a, b, msg[3]
+        for sidx in range(0, NC + G):
+            lane = (M_AMOC + sidx if sidx < NC
+                    else M_AMOS + sidx - NC)
             here = is_qry & (src == sidx)
             last = nodes[lane]
             fresh = here & (seq > last)
@@ -171,28 +210,34 @@ def make_shardstore_protocol(groups_of: Sequence[int],
             nodes = nodes.at[M_MC].set(
                 jnp.where(fresh, nodes[M_MC] + 1,
                           nodes[M_MC]).astype(jnp.int32))
+            # A fresh proposal's self-delivered P2a sets the master's
+            # heard_from_leader (paxos.py:367) — observable only when
+            # its ElectionTimer is live (M_H is frozen at 1 otherwise).
+            nodes = nodes.at[M_H].set(
+                jnp.where(fresh, 1, nodes[M_H]).astype(jnp.int32))
             # reply for fresh or exactly-cached seq; payload = the served
             # config (dup deliveries carry the same arg, so recomputing
             # the kind from the message matches the cached result)
             sends.append(msg_row(here & (seq >= last), QREP, src, seq,
                                  served_kind(arg)))
 
-        # ---- QREP -> client: adopt the (always latest) config if newer,
-        # then send the pending command (shardstore.py client
+        # ---- QREP -> client c: adopt the (always latest) config if
+        # newer, then send the pending command (shardstore.py client
         # handle_PaxosReply + _send_pending)
-        is_qrep_c = (tag == QREP) & (a == 0)
-        k = nodes[C_K]
-        adopt = is_qrep_c & (nodes[C_CFG] == 0)
-        nodes = nodes.at[C_CFG].set(
-            jnp.where(adopt, 1, nodes[C_CFG]).astype(jnp.int32))
-        sends.append(msg_row(adopt & (k <= W), SSREQ, k))
+        for c in range(NC):
+            here = (tag == QREP) & (a == c)
+            k = nodes[cli(c, 0)]
+            adopt = here & (nodes[cli(c, 1)] == 0)
+            nodes = nodes.at[cli(c, 1)].set(
+                jnp.where(adopt, 1, nodes[cli(c, 1)]).astype(jnp.int32))
+            sends.append(msg_row(adopt & (k <= Ws[c]), SSREQ, c, k))
 
         # ---- QREP -> server g: propose NewConfig iff the carried config
         # is exactly _next_config_num() and reconfig is done
         # (shardstore.py handle_PaxosReply + _apply_new_config)
         for g in range(1, G + 1):
-            here = (tag == QREP) & (a == g)
-            kind = c                                  # configs[kind]
+            here = (tag == QREP) & (a == NC + g - 1)
+            kind = msg[3]                             # configs[kind]
             scfg = nodes[srv(g, S_CFG)]
             done = ((nodes[srv(g, S_OUT)] == 0)
                     & (nodes[srv(g, S_IN)] == 0))
@@ -205,12 +250,15 @@ def make_shardstore_protocol(groups_of: Sequence[int],
                 nodes = nodes.at[srv(g, S_OUT)].set(
                     jnp.where(is_final, 1,
                               nodes[srv(g, S_OUT)]).astype(jnp.int32))
-                nodes = nodes.at[srv(g, S_OSAMO)].set(
-                    jnp.where(is_final, nodes[srv(g, S_AMO)],
-                              nodes[srv(g, S_OSAMO)]).astype(jnp.int32))
+                for c in range(NC):
+                    nodes = nodes.at[srv(g, S_OSAMO + c)].set(
+                        jnp.where(is_final, nodes[srv(g, S_AMO + c)],
+                                  nodes[srv(g, S_OSAMO + c)]
+                                  ).astype(jnp.int32))
                 # leader installs -> _send_moves inline
-                sends.append(msg_row(is_final, SM, 2,
-                                     nodes[srv(g, S_AMO)]))
+                sends.append(msg_row(
+                    is_final, SM, 2,
+                    *[nodes[srv(g, S_AMO + c)] for c in range(NC)]))
             elif g == 2:
                 nodes = nodes.at[srv(g, S_IN)].set(
                     jnp.where(is_final, 1,
@@ -222,15 +270,16 @@ def make_shardstore_protocol(groups_of: Sequence[int],
                 jnp.where(install, nodes[srv(g, S_CNT)] + 1,
                           nodes[srv(g, S_CNT)]).astype(jnp.int32))
             nodes = nodes.at[srv(g, S_H)].set(
-                jnp.where(install, 1, nodes[srv(g, S_H)]).astype(jnp.int32))
+                jnp.where(install, 1,
+                          nodes[srv(g, S_H)]).astype(jnp.int32))
 
-        # ---- SSREQ -> server grp_of(k): ALWAYS proposes (relay-mode
+        # ---- SSREQ -> server grp_of(c, k): ALWAYS proposes (relay-mode
         # chosen entries are not deduped, paxos.py:349-355) -> count+1,
         # heard; execution is gated by config coverage and ownership
         # (shardstore.py _execute_client_command)
         is_ss = tag == SSREQ
-        kk = a
-        kg = grp_of(kk)
+        cc, kk = a, b
+        kg = grp_of(cc, kk)
         for g in range(1, G + 1):
             here = is_ss & (kg == g)
             nodes = nodes.at[srv(g, S_CNT)].set(
@@ -240,37 +289,55 @@ def make_shardstore_protocol(groups_of: Sequence[int],
                 jnp.where(here, 1, nodes[srv(g, S_H)]).astype(jnp.int32))
             scfg = nodes[srv(g, S_CFG)]
             has_cfg = scfg >= 1
-            mine = cfg_mine(g, (scfg - 1).clip(0, N_CFG - 1), kk) & has_cfg
+            mine = (cfg_mine(g, (scfg - 1).clip(0, N_CFG - 1), cc, kk)
+                    & has_cfg)
             # wrong group: current config exists but shard is not mine
-            sends.append(msg_row(here & has_cfg & ~mine, WG, kk))
+            sends.append(msg_row(here & has_cfg & ~mine, WG, cc, kk))
             # mine but still incoming -> silent (client retries); only
             # group 2 ever gains shards, in one block per handoff
             if g == 2 and G > 1:
                 owned = mine & (nodes[srv(g, S_IN)] == 0)
             else:
                 owned = mine
+            # per-client AMO high-water (static select over c)
             samo = nodes[srv(g, S_AMO)]
+            for c in range(1, NC):
+                samo = jnp.where(cc == c, nodes[srv(g, S_AMO + c)], samo)
             execd = here & owned & (kk > samo)        # owned ⊆ mine
-            nodes = nodes.at[srv(g, S_AMO)].set(
-                jnp.where(execd, kk, samo).astype(jnp.int32))
-            sends.append(msg_row(here & owned & (kk >= samo), SSREP, kk))
+            for c in range(NC):
+                nodes = nodes.at[srv(g, S_AMO + c)].set(
+                    jnp.where(execd & (cc == c), kk,
+                              nodes[srv(g, S_AMO + c)]).astype(jnp.int32))
+            sends.append(msg_row(here & owned & (kk >= samo),
+                                 SSREP, cc, kk))
 
         # ---- SSREP -> client (ClientWorker pumps the next command)
         is_rep = tag == SSREP
-        match = is_rep & (a == k) & (k <= W)
-        k2 = jnp.where(match, k + 1, k)
-        nodes = nodes.at[C_K].set(k2.astype(jnp.int32))
-        has_next = match & (k2 <= W)
-        sends.append(msg_row(has_next, SSREQ, k2))
-        tsets.append(timer_row(has_next, CLIENT, T_CLIENT,
-                               CLIENT_MS, CLIENT_MS, k2))
+        for c in range(NC):
+            k = nodes[cli(c, 0)]
+            match = is_rep & (a == c) & (b == k) & (k <= Ws[c])
+            k2 = jnp.where(match, k + 1, k)
+            nodes = nodes.at[cli(c, 0)].set(k2.astype(jnp.int32))
+            has_next = match & (k2 <= Ws[c])
+            sends.append(msg_row(has_next, SSREQ, c, k2))
+            tsets.append(timer_row(has_next, node_of(c), T_CLIENT,
+                                   CLIENT_MS, CLIENT_MS, k2))
 
         # ---- WG -> client: re-query (shardstore.py handle_WrongGroup)
-        is_wg = (tag == WG) & (a == k) & (k <= W)
-        cq = nodes[C_CQ]
-        nodes = nodes.at[C_CQ].set(
-            jnp.where(is_wg, cq + 1, cq).astype(jnp.int32))
-        sends.append(msg_row(is_wg, QRY, 0, cq + 1, -1))
+        for c in range(NC):
+            k = nodes[cli(c, 0)]
+            is_wg = ((tag == WG) & (a == c) & (b == k) & (k <= Ws[c]))
+            cq = nodes[cli(c, 2)]
+            nodes = nodes.at[cli(c, 2)].set(
+                jnp.where(is_wg, cq + 1, cq).astype(jnp.int32))
+            sends.append(msg_row(is_wg, QRY, c, cq + 1, -1))
+
+        # ---- join-phase debris (model_ctl): REQ(G) re-replies the
+        # cached result — an IDENTICAL row the network set dedupes, so
+        # every debris delivery is a self-loop (paxos.py:326-344 with
+        # seq <= amo; PaxosClient.handle_PaxosReply with pending=None).
+        if model_ctl:
+            sends.append(msg_row((tag == JREQ) & (a == G), JREP, G))
 
         # ---- SM -> group 2: propose InstallShards when at the final
         # config with the shards still incoming; re-ack when already
@@ -287,10 +354,11 @@ def make_shardstore_protocol(groups_of: Sequence[int],
             nodes = nodes.at[srv(2, S_H)].set(
                 jnp.where(inst, 1, nodes[srv(2, S_H)]).astype(jnp.int32))
             # AMO merge: per-client max of own and the snapshot's
-            samo2 = nodes[srv(2, S_AMO)]
-            nodes = nodes.at[srv(2, S_AMO)].set(
-                jnp.where(inst, jnp.maximum(samo2, b),
-                          samo2).astype(jnp.int32))
+            for c in range(NC):
+                samo2 = nodes[srv(2, S_AMO + c)]
+                nodes = nodes.at[srv(2, S_AMO + c)].set(
+                    jnp.where(inst, jnp.maximum(samo2, msg[2 + c]),
+                              samo2).astype(jnp.int32))
             nodes = nodes.at[srv(2, S_IN)].set(
                 jnp.where(inst, 0, nodes[srv(2, S_IN)]).astype(jnp.int32))
             sends.append(msg_row(inst | reack, SMACK, 1))
@@ -307,8 +375,10 @@ def make_shardstore_protocol(groups_of: Sequence[int],
             nodes = nodes.at[srv(1, S_OUT)].set(
                 jnp.where(fin, 0, nodes[srv(1, S_OUT)]).astype(jnp.int32))
 
-        sends = jnp.concatenate(sends + [blank_msg] * (MAX_SENDS - len(sends)))
-        tsets = jnp.concatenate(tsets + [blank_set] * (MAX_SETS - len(tsets)))
+        sends = jnp.concatenate(
+            sends + [blank_msg] * (MAX_SENDS - len(sends)))
+        tsets = jnp.concatenate(
+            tsets + [blank_set] * (MAX_SETS - len(tsets)))
         return nodes, sends[:MAX_SENDS], tsets[:MAX_SETS]
 
     def step_timer(nodes, node_idx, timer):
@@ -319,19 +389,21 @@ def make_shardstore_protocol(groups_of: Sequence[int],
         # ---- ClientTimer (shardstore.py on_ClientTimer): re-query (+1
         # more query when there is no config yet — _send_pending falls
         # back to _query_config) and re-send the pending command.
-        k = nodes[C_K]
-        live = ((node_idx == CLIENT) & (tag == T_CLIENT) & (p0 == k)
-                & (k <= W))
-        cq = nodes[C_CQ]
-        has_cfg = nodes[C_CFG] == 1
-        cq2 = jnp.where(live, jnp.where(has_cfg, cq + 1, cq + 2), cq)
-        nodes = nodes.at[C_CQ].set(cq2.astype(jnp.int32))
-        sends.append(msg_row(live, QRY, 0, cq + 1, -1))
-        sends.append(jnp.where(has_cfg,
-                               msg_row(live, SSREQ, k)[0],
-                               msg_row(live, QRY, 0, cq + 2, -1)[0])[None])
-        tsets.append(timer_row(live, CLIENT, T_CLIENT,
-                               CLIENT_MS, CLIENT_MS, k))
+        for c in range(NC):
+            k = nodes[cli(c, 0)]
+            live = ((node_idx == node_of(c)) & (tag == T_CLIENT)
+                    & (p0 == k) & (k <= Ws[c]))
+            cq = nodes[cli(c, 2)]
+            has_cfg = nodes[cli(c, 1)] == 1
+            cq2 = jnp.where(live, jnp.where(has_cfg, cq + 1, cq + 2), cq)
+            nodes = nodes.at[cli(c, 2)].set(cq2.astype(jnp.int32))
+            sends.append(msg_row(live, QRY, c, cq + 1, -1))
+            sends.append(jnp.where(
+                has_cfg,
+                msg_row(live, SSREQ, c, k)[0],
+                msg_row(live, QRY, c, cq + 2, -1)[0])[None])
+            tsets.append(timer_row(live, node_of(c), T_CLIENT,
+                                   CLIENT_MS, CLIENT_MS, k))
 
         for g in range(1, G + 1):
             here = node_idx == g
@@ -345,12 +417,14 @@ def make_shardstore_protocol(groups_of: Sequence[int],
             sq = nodes[srv(g, S_Q)]
             nodes = nodes.at[srv(g, S_Q)].set(
                 jnp.where(ask, sq + 1, sq).astype(jnp.int32))
-            sends.append(msg_row(ask, QRY, g, sq + 1,
+            sends.append(msg_row(ask, QRY, NC + g - 1, sq + 1,
                                  nodes[srv(g, S_CFG)]))
             if g == 1 and G > 1:
-                sends.append(msg_row(is_q & (nodes[srv(1, S_OUT)] == 1),
-                                     SM, 2, nodes[srv(1, S_OSAMO)]))
-            tsets.append(timer_row(is_q, g, T_QUERY, QUERY_MS, QUERY_MS, 0))
+                sends.append(msg_row(
+                    is_q & (nodes[srv(1, S_OUT)] == 1), SM, 2,
+                    *[nodes[srv(1, S_OSAMO + c)] for c in range(NC)]))
+            tsets.append(timer_row(is_q, g, T_QUERY,
+                                   QUERY_MS, QUERY_MS, 0))
 
             # ---- ElectionTimer (paxos.py on_ElectionTimer): the lone
             # server is its own decided leader; only heard resets.
@@ -366,60 +440,106 @@ def make_shardstore_protocol(groups_of: Sequence[int],
             tsets.append(timer_row(is_hb, g, T_HEARTBEAT,
                                    HEARTBEAT_MS, HEARTBEAT_MS, 0))
 
-        sends = jnp.concatenate(sends + [blank_msg] * (MAX_SENDS - len(sends)))
-        tsets = jnp.concatenate(tsets + [blank_set] * (MAX_SETS - len(tsets)))
+        # ---- master ElectionTimer/HeartbeatTimer (model_master_timers):
+        # the lone master is its own decided leader — heard resets on
+        # election, heartbeat is a pure re-arm (paxos.py:261-265,
+        # 412-427), exactly the group-server pattern.
+        if model_master_timers:
+            m_el = (node_idx == 0) & (tag == T_ELECTION)
+            nodes = nodes.at[M_H].set(
+                jnp.where(m_el, 0, nodes[M_H]).astype(jnp.int32))
+            tsets.append(timer_row(m_el, 0, T_ELECTION,
+                                   ELECTION_MIN, ELECTION_MAX, 0))
+            m_hb = (node_idx == 0) & (tag == T_HEARTBEAT)
+            tsets.append(timer_row(m_hb, 0, T_HEARTBEAT,
+                                   HEARTBEAT_MS, HEARTBEAT_MS, 0))
+
+        # ---- the controller's stale ClientTimers (model_ctl): pending
+        # is None after the joins, so delivery only consumes the timer
+        # (no re-arm, no sends) — the state change IS the queue pop.
+
+        sends = jnp.concatenate(
+            sends + [blank_msg] * (MAX_SENDS - len(sends)))
+        tsets = jnp.concatenate(
+            tsets + [blank_set] * (MAX_SETS - len(tsets)))
         return nodes, sends[:MAX_SENDS], tsets[:MAX_SETS]
 
     # Row budgets = the TOTAL rows each step function appends (rows are
     # individually condition-masked; the pad/slice below must never
-    # truncate a real row).  step_message: (G+1) QREP + 1 client SSREQ +
-    # G-block QREP rows (1 SM for g1 when G>1) + 2G SSREQ rows (WG +
-    # SSREP per g) + 1 pumped SSREQ + CT + 1 WG-requery + (SMACK) rows.
-    MAX_SENDS = (G + 1) + 1 + (1 if G > 1 else 0) + 2 * G + 1 + 1 + (
-        1 if G > 1 else 0)
-    MAX_SETS = 1 + 3 * G        # client CT + per-server query/election/hb
+    # truncate a real row).
+    MSG_SENDS = ((NC + G)               # QRY -> QREP per source
+                 + NC                   # QREP-client adopt SSREQ
+                 + (1 if G > 1 else 0)  # g1 install SM
+                 + 2 * G                # SSREQ: WG + SSREP per g
+                 + NC                   # SSREP pump per client
+                 + NC                   # WG re-query per client
+                 + (1 if G > 1 else 0)  # SM -> SMACK
+                 + (1 if model_ctl else 0))   # JREQ re-reply
+    TMR_SENDS = 2 * NC + G + (1 if G > 1 else 0)
+    MAX_SENDS = max(MSG_SENDS, TMR_SENDS)
+    MAX_SETS = max(NC, NC + 3 * G
+                   + (2 if model_master_timers else 0))
 
     # ------------------------------------------------------------- initials
 
     def init_nodes():
         nodes = np.zeros((NW,), np.int32)
         nodes[M_MC] = G          # one decided Join per group
-        nodes[C_K] = 1           # first command pending
-        # init() queries once; send_command -> _send_pending with no
-        # config falls back to _query_config and queries AGAIN
-        # (shardstore.py:624-650), so two queries are already in flight.
-        nodes[C_CQ] = 2
+        nodes[M_H] = 1           # the final fresh Join's self-P2a
+        for c in range(NC):
+            nodes[cli(c, 0)] = 1     # first command pending
+            # init() queries once; send_command -> _send_pending with no
+            # config falls back to _query_config and queries AGAIN
+            # (shardstore.py:624-650), so two queries are in flight.
+            nodes[cli(c, 2)] = 2
         return nodes
 
     def init_messages():
-        return np.array([[QRY, 0, 1, -1], [QRY, 0, 2, -1]], np.int32)
+        rows = [[QRY, c, s, -1] + [0] * (MW - 4)
+                for c in range(NC) for s in (1, 2)]
+        if model_ctl:
+            for j in range(1, G + 1):
+                rows.append([JREQ, j] + [0] * (MW - 2))
+                rows.append([JREP, j] + [0] * (MW - 2))
+        return np.array(rows, np.int32)
 
     def init_timers():
         rows = []
+        if model_master_timers:
+            rows.append([0, T_ELECTION, ELECTION_MIN, ELECTION_MAX, 0])
+            rows.append([0, T_HEARTBEAT, HEARTBEAT_MS, HEARTBEAT_MS, 0])
+        if model_ctl:
+            for j in range(1, G + 1):
+                rows.append([CCA, T_CLIENT, CLIENT_MS, CLIENT_MS, j])
         for g in range(1, G + 1):
             # ShardStoreServer.init: paxos.init (Election, then the
             # immediate self-election arms Heartbeat), then QueryTimer.
             rows.append([g, T_ELECTION, ELECTION_MIN, ELECTION_MAX, 0])
             rows.append([g, T_HEARTBEAT, HEARTBEAT_MS, HEARTBEAT_MS, 0])
             rows.append([g, T_QUERY, QUERY_MS, QUERY_MS, 0])
-        rows.append([CLIENT, T_CLIENT, CLIENT_MS, CLIENT_MS, 1])
+        for c in range(NC):
+            rows.append([node_of(c), T_CLIENT, CLIENT_MS, CLIENT_MS, 1])
         return np.array(rows, np.int32)
 
     def msg_dest(msg):
         tag, a = msg[0], msg[1]
         dest = jnp.asarray(0, jnp.int32)                      # QRY -> master
         dest = jnp.where(tag == QREP,
-                         jnp.where(a == 0, CLIENT, a), dest)
-        dest = jnp.where(tag == SSREQ, grp_of(msg[1]), dest)
-        dest = jnp.where((tag == SSREP) | (tag == WG), CLIENT, dest)
+                         jnp.where(a < NC, G + 1 + a, a - NC + 1), dest)
+        dest = jnp.where(tag == SSREQ, grp_of(a, msg[2]), dest)
+        dest = jnp.where((tag == SSREP) | (tag == WG), G + 1 + a, dest)
         dest = jnp.where((tag == SM) | (tag == SMACK), a, dest)
+        dest = jnp.where(tag == JREP, CCA, dest)     # JREQ stays 0
         return dest
 
     def clients_done(state):
-        return state["nodes"][C_K] == W + 1
+        done = jnp.asarray(True)
+        for c in range(NC):
+            done = done & (state["nodes"][cli(c, 0)] == Ws[c] + 1)
+        return done
 
     return TensorProtocol(
-        name=f"shardstore-g{G}-w{W}",
+        name=f"shardstore-g{G}-c{NC}-w{sum(Ws)}",
         n_nodes=N_NODES,
         node_width=NW,
         msg_width=MW,

@@ -907,6 +907,7 @@ class ShardedTensorSearch(TensorSearch):
         # Root of this run's trace (tpu/trace.py replays from here).
         self._trace_root = jax.tree.map(np.asarray, state)
         self._fp_map = {}
+        self._deep_samples = None
         self._root_fp = tuple(np.asarray(
             state_fingerprints(state), np.uint32)[0].tolist())
         if check_initial:
@@ -1013,12 +1014,13 @@ class ShardedTensorSearch(TensorSearch):
                     for _ in range(n_chunks - j_done):
                         carry = self._step(carry)
                 if _LEVEL_TIMING:
+                    import sys as _sys
                     dt = time.time() - t_lvl
                     print(f"[level {depth}] chunks={n_chunks} "
                           f"dt={dt:.2f}s chunk={dt/max(n_chunks,1)*1e3:.1f}ms "
                           f"dispatch={t_disp:.2f}s "
                           f"explored={explored} unique={vis_total} "
-                          f"next={max_n}", flush=True)
+                          f"next={max_n}", flush=True, file=_sys.stderr)
                 if noapp_level:
                     # max_n counted the final level's would-be appends:
                     # zero means the space ended exactly at the depth
@@ -1028,7 +1030,8 @@ class ShardedTensorSearch(TensorSearch):
                         "DEPTH_EXHAUSTED" if max_n > 0
                         else "SPACE_EXHAUSTED",
                         explored, vis_total, depth,
-                        time.time() - t0, dropped=drops)
+                        time.time() - t0, dropped=drops,
+                        samples=getattr(self, "_deep_samples", None))
                 if self.record_trace:
                     self._spill_tmeta(carry)
                 carry = self._finish_level(carry)
@@ -1039,7 +1042,8 @@ class ShardedTensorSearch(TensorSearch):
 
             return SearchOutcome(
                 "SPACE_EXHAUSTED", explored, vis_total, depth,
-                time.time() - t0, dropped=drops)
+                time.time() - t0, dropped=drops,
+                samples=getattr(self, "_deep_samples", None))
 
     def _spill_tmeta(self, carry) -> None:
         """Fold this level's appended (child_fp, parent_fp, event) rows
@@ -1066,6 +1070,20 @@ class ShardedTensorSearch(TensorSearch):
                        zip(reversed(parents), reversed(events))))
         new.update(self._fp_map)
         self._fp_map = new
+        # Sample a few of this level's children (spread across the batch)
+        # and keep their root-first traces; at an exhaust verdict these
+        # are the deepest states available for the object-side
+        # value-invariant re-check (ADVICE r4).  The rows are already on
+        # the host — only K short chain walks per level.
+        k = min(3, len(rows))
+        picks = {0, len(rows) // 2, len(rows) - 1}
+        samples = []
+        for i in sorted(picks)[:k]:
+            tr = self._walk_fp_chain(parents[i], int(events[i]))
+            if tr is not None:
+                samples.append(tr)
+        if samples:
+            self._deep_samples = samples
 
     def _walk_fp_chain(self, parent_fp, event_id) -> Optional[list]:
         """flag_meta (parent fp, event) -> grid event ids root-first, by
@@ -1130,4 +1148,5 @@ class ShardedTensorSearch(TensorSearch):
             int(np.asarray(carry["explored"]).sum()),
             int(np.asarray(carry["vis_n"]).sum()),
             depth, time.time() - t0,
-            dropped=int(np.asarray(carry["drops"]).sum()))
+            dropped=int(np.asarray(carry["drops"]).sum()),
+            samples=getattr(self, "_deep_samples", None))

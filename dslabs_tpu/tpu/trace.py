@@ -58,6 +58,16 @@ class MessageTemplate:
         if len(cands) == 1:
             return next(iter(cands))
         if not cands:
+            if self.fallback is None:
+                # A None fallback means the binding has no way to build
+                # this message without an object-side candidate — failing
+                # here keeps "ambiguity is a loud error, never a guess"
+                # (a None message would fail far away with an obscure
+                # handler error; ADVICE r4).
+                raise ValueError(
+                    f"template resolution found no {self.cls.__name__} "
+                    f"candidate from {frm} to {to} in the object network "
+                    "and the binding provides no fallback")
             return self.fallback
         raise ValueError(
             f"ambiguous template resolution: {len(cands)} distinct "

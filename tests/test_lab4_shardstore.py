@@ -47,7 +47,7 @@ def group(g, n=3):
 
 # --------------------------------------------------------- unit: txkvstore
 
-@lab_test("4", 12, "TransactionalKVStore semantics", part=3, categories=(RUN_TESTS,))
+@lab_test("4", 40, "TransactionalKVStore semantics", part=3, categories=(RUN_TESTS,))
 def test_txkvstore_semantics():
     kv = TransactionalKVStore()
     assert kv.execute(MultiPut({"a": "1", "b": "2"})) == MultiPutOk()
@@ -844,6 +844,109 @@ def test09_single_client_multi_group_tx_search():
     settings.deliver_timers(shard_master(1), False)
     results = bfs(joined, settings)
     assert results.end_condition == EndCondition.GOAL_FOUND, results
+
+
+@lab_test("4", 10, "Multi-client, multi-group; MultiPut, Swap, MultiGet", points=20, part=3, categories=(SEARCH_TESTS,))
+def test10_multi_client_multi_group_tx_search():
+    """ShardStorePart2Test.java:255 test10MultiClientMultiGroupSearch:
+    client1 runs MultiPut{foo-1: X, foo-2: Y} then Swap(foo-1, foo-2)
+    across both groups while client2's MultiGet must observe the swapped
+    pair atomically ({foo-1: Y, foo-2: X} under the expected-results
+    serialization)."""
+    from dslabs_tpu.search.search import bfs
+    from dslabs_tpu.search.results import EndCondition
+    from dslabs_tpu.search.settings import SearchSettings
+    from dslabs_tpu.testing.workload import Workload
+
+    import os as _os
+
+    state = make_search(2, 1, 1, 2)
+    joined = _joined_state(state, 2)
+    joined.add_client_worker(
+        LocalAddress("client1"),
+        Workload(commands=[MultiPut({"foo-1": "X", "foo-2": "Y"}),
+                           Swap("foo-1", "foo-2")],
+                 results=[MultiPutOk(), SwapOk()]))
+    joined.add_client_worker(
+        LocalAddress("client2"),
+        Workload(commands=[MultiGet({"foo-1", "foo-2"})],
+                 results=[MultiGetResult({"foo-1": "Y", "foo-2": "X"})]))
+
+    settings = SearchSettings()
+    settings.add_invariant(RESULTS_OK)
+    settings.node_active(CCA, False)
+    settings.deliver_timers(CCA, False)
+    settings.deliver_timers(shard_master(1), False)
+    if _os.environ.get("DSLABS_SLOW_TESTS"):
+        settings.max_time(900).add_goal(CLIENTS_DONE)
+        results = bfs(joined, settings)
+        assert results.end_condition == EndCondition.GOAL_FOUND, results
+    else:
+        # Bounded-depth safety of the same space on the fast path (the
+        # goal lies beyond the Python oracle's ungated budget, exactly
+        # like test11/test12 of Part 1).
+        settings.max_time(120).set_max_depth(joined.depth + 5)
+        results = bfs(joined, settings)
+        assert results.end_condition in (EndCondition.SPACE_EXHAUSTED,
+                                         EndCondition.TIME_EXHAUSTED), results
+
+
+def _tx_random_search(servers_per_group, max_secs=20):
+    """ShardStorePart2Test.java:275-334 randomSearch: the Join, Join,
+    Leave(1) reconfiguration happens DURING the search (no staged join),
+    transactional clients race it, and the MultiGet-atomicity invariant
+    pins that client2 sees either both puts or neither — a torn
+    {X, KEY_NOT_FOUND} read is the classic non-atomic-commit bug."""
+    from dslabs_tpu.search.search import dfs
+    from dslabs_tpu.search.settings import SearchSettings
+    from dslabs_tpu.testing.predicates import StatePredicate
+    from dslabs_tpu.testing.workload import Workload
+
+    state = make_search(2, servers_per_group, 1, 2)
+    cmds = [Join(1, group(1, servers_per_group)),
+            Join(2, group(2, servers_per_group)),
+            Leave(1)]
+    state.add_client_worker(CCA, Workload(commands=cmds,
+                                          results=[Ok()] * len(cmds)))
+    state.add_client_worker(
+        LocalAddress("client1"),
+        Workload(commands=[MultiPut({"foo-1": "X", "foo-2": "Y"})],
+                 results=[MultiPutOk()]))
+    state.add_client_worker(
+        LocalAddress("client2"),
+        Workload(commands=[MultiGet({"foo-1", "foo-2"})]))
+
+    ok_full = MultiGetResult({"foo-1": "X", "foo-2": "Y"})
+    ok_none = MultiGetResult({"foo-1": KEY_NOT_FOUND,
+                              "foo-2": KEY_NOT_FOUND})
+
+    def multi_get_atomic(s):
+        results = s.client_workers()[LocalAddress("client2")].results
+        if not results:
+            return True
+        if len(results) > 1:
+            return False, "client2 received multiple MultiGetResults"
+        r = results[0]
+        if r != ok_full and r != ok_none:
+            return False, (f"{r} matches neither {ok_none} nor "
+                           f"{ok_full}")
+        return True
+
+    settings = SearchSettings()
+    settings.set_max_depth(1000).max_time(max_secs)
+    settings.add_invariant(StatePredicate(
+        "MultiGet returns correct results", multi_get_atomic))
+    settings.add_invariant(RESULTS_OK)
+    settings.add_prune(CLIENTS_DONE)
+    results = dfs(state, settings)
+    assert not results.terminal_found(), results
+
+
+@lab_test("4", 12, "Multiple servers per group random search", points=20, part=3, categories=(SEARCH_TESTS,))
+def test12_multi_server_tx_random_search():
+    """ShardStorePart2Test.java:346 test12MultiServerRandomSearch: the
+    randomSearch shape with REAL 3-server Paxos groups."""
+    _tx_random_search(3)
 
 
 @lab_test("4", 11, "One server per group random search", points=20, part=3, categories=(SEARCH_TESTS,))

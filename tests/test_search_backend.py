@@ -239,3 +239,53 @@ def test_lab2_single_server_verdicts(tensor_backend):
     obj = bfs(mk(), settings)
     assert obj.end_condition == EndCondition.GOAL_FOUND
     assert obj.goal_matching_state.depth == res.goal_matching_state.depth
+
+
+def test_lab4_two_phase_tensor(tensor_backend):
+    """The ShardStorePart1Test.test10 flow end-to-end on the tensor
+    strategy: the JOIN phase runs on the join twin, its goal state
+    materialises as a real object state, and the MAIN phase validates
+    that state as the canonical joined root of the shardstore twin
+    (ShardStoreBinding.derive_root) — goal found, then the done-pruned
+    depth-limited space matches the object checker's count exactly."""
+    from dslabs_tpu.labs.clientserver.kv_workload import kv_workload
+    from dslabs_tpu.testing.predicates import (CLIENTS_DONE, RESULTS_OK,
+                                               client_done)
+    import tests.test_lab4_shardstore as lab4
+
+    def staged():
+        state = lab4.make_search(1, 1, 1, 10)
+        joined = lab4._joined_state(state, 1)
+        joined.add_client_worker(
+            LocalAddress("client1"),
+            kv_workload(["PUT:foo:bar", "GET:foo"], ["PutOk", "bar"]))
+        return joined
+
+    # Phase 1 (inside _joined_state) already ran on the tensor backend;
+    # the staged state must carry join-twin provenance.
+    joined = staged()
+    assert getattr(joined, "_tensor_provenance", None) is not None
+    assert joined._tensor_provenance.key[0] == "ss-join"
+    assert client_done(lab4.CCA).check(joined).value
+
+    settings = SearchSettings().max_time(240)
+    settings.add_invariant(RESULTS_OK).add_goal(CLIENTS_DONE)
+    settings.node_active(lab4.CCA, False)
+    settings.deliver_timers(lab4.CCA, False)
+    settings.deliver_timers(lab4.shard_master(1), False)
+    res = bfs(joined, settings)
+    assert res.end_condition == EndCondition.GOAL_FOUND
+    goal = res.goal_matching_state
+    assert CLIENTS_DONE.check(goal).value
+
+    # Done-pruned depth-limited exhaust: exact count parity vs object.
+    settings.clear_goals().add_prune(CLIENTS_DONE)
+    settings.set_max_depth(joined.depth + 4)
+    res2 = bfs(joined, settings)
+    assert res2.end_condition == EndCondition.SPACE_EXHAUSTED
+
+    GlobalSettings.search_backend = "object"
+    joined_obj = staged()
+    obj = bfs(joined_obj, settings)
+    assert obj.end_condition == EndCondition.SPACE_EXHAUSTED
+    assert obj.discovered_count == res2.discovered_count

@@ -99,6 +99,149 @@ def test_lab4_deep_depth_sweep():
                 f"{ten.unique_states} != object {obj.discovered_count}")
 
 
+# ------------------------------------------------- multi-client (test12)
+
+def _object_joined_multi(max_levels):
+    """test12's shape: two clients appending to keys owned by different
+    groups (foo-1 -> shard 1 -> g1, foo-2 -> shard 2 -> g2 under the
+    2-shard rebalance of Join(1), Join(2))."""
+    state = lab4.make_search(2, 1, 1, 2)
+    joined = lab4._joined_state(state, 2)
+    joined.add_client_worker(LocalAddress("client1"),
+                             kv_workload(["APPEND:foo-1:X1"], ["X1"]))
+    joined.add_client_worker(LocalAddress("client2"),
+                             kv_workload(["APPEND:foo-2:Y2"], ["Y2"]))
+    settings = SearchSettings().max_time(600)
+    settings.add_invariant(RESULTS_OK)
+    settings.node_active(lab4.CCA, False)
+    settings.deliver_timers(lab4.CCA, False)
+    settings.deliver_timers(lab4.shard_master(1), False)
+    settings.set_max_depth(joined.depth + max_levels)
+    return BFS(settings).run(joined)
+
+
+def test_lab4_multi_client_depth_parity():
+    """Two-client, two-group twin parity (multi-client lanes: per-client
+    AMO vectors, per-client query/config state, vector ShardMove
+    snapshots).  CI checks depth 3 unconditionally."""
+    from dslabs_tpu.labs.shardedstore.shardstore import key_to_shard
+
+    # Pin the key->group mapping assumption of the fixture.
+    assert key_to_shard("foo-1", 2) == 1
+    assert key_to_shard("foo-2", 2) == 2
+    obj = _object_joined_multi(3)
+    groups = [[1], [2]]
+    ten = TensorSearch(make_shardstore_protocol(groups), chunk=256,
+                       max_depth=3).run()
+    assert ten.unique_states == obj.discovered_count, (
+        f"tensor {ten.unique_states} != object {obj.discovered_count}")
+
+
+@SLOW
+def test_lab4_multi_client_deep_parity():
+    for d in (4, 5):
+        obj = _object_joined_multi(d)
+        ten = TensorSearch(make_shardstore_protocol([[1], [2]]),
+                           chunk=512, max_depth=d).run()
+        assert ten.unique_states == obj.discovered_count, (
+            f"depth {d}: tensor {ten.unique_states} != "
+            f"object {obj.discovered_count}")
+
+
+# -------------------------------------- unrestricted space (test13 shape)
+
+def _object_joined_unrestricted(max_levels):
+    """test13's search narrows NOTHING: master election/heartbeat
+    timers live, the controller node active with its join-phase debris
+    deliverable (tests/test_lab4_shardstore.py _random_search)."""
+    state = lab4.make_search(2, 1, 1, 2)
+    joined = lab4._joined_state(state, 2)
+    joined.add_client_worker(LocalAddress("client1"),
+                             kv_workload(["APPEND:foo-1:x"]))
+    joined.add_client_worker(LocalAddress("client2"),
+                             kv_workload(["APPEND:foo-2:y"]))
+    settings = SearchSettings().max_time(600)
+    settings.add_invariant(RESULTS_OK)
+    settings.set_max_depth(joined.depth + max_levels)
+    return BFS(settings).run(joined)
+
+
+def test_lab4_unrestricted_depth_parity():
+    """model_master_timers + model_ctl twin parity: the master's heard
+    lane, its election/heartbeat timers, the controller's stale
+    ClientTimers, and the join REQ/REP debris self-loops must reproduce
+    the object space exactly."""
+    obj = _object_joined_unrestricted(3)
+    ten = TensorSearch(
+        make_shardstore_protocol([[1], [2]], model_master_timers=True,
+                                 model_ctl=True),
+        chunk=256, max_depth=3).run()
+    assert ten.unique_states == obj.discovered_count, (
+        f"tensor {ten.unique_states} != object {obj.discovered_count}")
+
+
+@SLOW
+def test_lab4_unrestricted_deep_parity():
+    for d in (4, 5):
+        obj = _object_joined_unrestricted(d)
+        ten = TensorSearch(
+            make_shardstore_protocol([[1], [2]],
+                                     model_master_timers=True,
+                                     model_ctl=True),
+            chunk=512, max_depth=d).run()
+        assert ten.unique_states == obj.discovered_count, (
+            f"depth {d}: tensor {ten.unique_states} != "
+            f"object {obj.discovered_count}")
+
+
+# ------------------------------------------------------- join-phase twin
+
+def _join_initial(n_groups):
+    """The join-phase initial state + settings, exactly as
+    _joined_state builds them (partition {CCA, master}, store-server
+    timers suppressed)."""
+    from dslabs_tpu.labs.shardedstore.shardmaster import Join, Ok
+    from dslabs_tpu.testing.workload import Workload
+
+    state = lab4.make_search(n_groups, 1, 1, 10)
+    cmds = [Join(g, lab4.group(g, 1)) for g in range(1, n_groups + 1)]
+    state.add_client_worker(lab4.CCA, Workload(commands=cmds,
+                                               results=[Ok()] * len(cmds)))
+    settings = SearchSettings().max_time(300)
+    settings.add_invariant(RESULTS_OK)
+    settings.partition(lab4.CCA, lab4.shard_master(1))
+    for a in list(state.servers):
+        if "server" in str(a):
+            settings.deliver_timers(a, False)
+    return state, settings
+
+
+def test_join_twin_depth_parity():
+    """The join twin (tpu/protocols/shardmaster_join.py) matches the
+    object oracle's unique-state counts depth by depth for both group
+    counts, including full exhaustion of the done-pruned space."""
+    from dslabs_tpu.testing.predicates import CLIENTS_DONE
+    from dslabs_tpu.tpu.protocols.shardmaster_join import \
+        make_join_protocol
+
+    for G in (1, 2):
+        state, settings = _join_initial(G)
+        settings.add_prune(CLIENTS_DONE)
+        import dataclasses as _dc
+
+        proto = make_join_protocol(G)
+        proto = _dc.replace(
+            proto, goals={},
+            prunes={"CLIENTS_DONE": proto.goals["CLIENTS_DONE"]})
+        for depth in (2, 4, 30):
+            settings.set_max_depth(depth)
+            obj = BFS(settings).run(state)
+            ten = TensorSearch(proto, chunk=64, max_depth=depth).run()
+            assert ten.unique_states == obj.discovered_count, (
+                f"G={G} depth={depth}: tensor {ten.unique_states} != "
+                f"object {obj.discovered_count}")
+
+
 # ----------------------------------------------------- Part 2: 2PC twin
 
 def _object_tx_joined(max_levels, n_tx=1):
