@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast lab0 lab1 lab2 lab3 lab4 bench dryrun clean
+.PHONY: test test-fast lab0 lab1 lab2 lab3 lab4 bench dryrun handout clean
 
 test:            ## full acceptance + parity suite
 	$(PY) -m pytest tests/ -q
@@ -20,6 +20,9 @@ bench:           ## TPU states/min benchmark (one JSON line)
 
 dryrun:          ## multi-chip sharding dry run on a virtual CPU mesh
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+handout:         ## student distribution (lab solutions AST-stripped)
+	$(PY) tools/handout.py --out /tmp/dslabs_tpu_handout --tar
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

@@ -141,6 +141,23 @@ class DifferentKeysInfiniteWorkload(Workload):
         self._last_put_key: Optional[str] = None
 
     def _next_pair(self, a: Address):
+        from dslabs_tpu.testing.workload import derandomized, stream_rng
+
+        if derandomized():
+            # Counter-mode stream: the pair at index i is a pure
+            # function of (a, i) — evens Put a fresh (key, value), odds
+            # Get back the preceding Put's — so twin adapters can
+            # re-derive any command for decode/staged replay
+            # (testing/workload.py stream_rng).
+            i = self._i
+            self._i += 1
+            rng = stream_rng(a, i - (i % 2))
+            key = f"{a}-{rng.randint(1, 5)}"
+            v = "".join(rng.choices(
+                _string.ascii_letters + _string.digits, k=8))
+            if i % 2 == 0:
+                return Put(key, v), PutOk()
+            return Get(key), GetResult(v)
         if self._last_was_get:
             self._last_put_key = f"{a}-{random.randint(1, 5)}"
             v = "".join(random.choices(_string.ascii_letters + _string.digits, k=8))

@@ -120,9 +120,12 @@ class Search:
         return StateStatus.VALID
 
     def _time_exhausted(self) -> bool:
+        from dslabs_tpu.utils.flags import GlobalSettings
+
         return (self.settings.max_time_secs is not None
                 and time.monotonic() - self._start_time
-                >= self.settings.max_time_secs)
+                >= self.settings.max_time_secs
+                * GlobalSettings.time_scale)
 
     def _maybe_print_status(self) -> None:
         if not self.settings.should_output_status():

@@ -15,6 +15,7 @@ in order) — exactly the reference's randomness-map protocol.
 
 from __future__ import annotations
 
+import hashlib
 import random
 import re
 import string
@@ -23,17 +24,44 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from dslabs_tpu.core.address import Address
 from dslabs_tpu.core.types import Command, Result
 
-__all__ = ["Workload", "InfiniteWorkload", "workload_builder"]
+__all__ = ["Workload", "InfiniteWorkload", "workload_builder",
+           "stream_rng", "derandomized"]
+
+
+def derandomized() -> bool:
+    """Whether command streams draw from the COUNTER-MODE rng (a pure
+    function of (client address, command index)) instead of the global
+    rng.  On under the tensor search strategy: the twin adapters must be
+    able to RE-DERIVE what command a client sends at index i to decode
+    terminal states and replay staged phases (round-4 verdict item 8 —
+    the global-rng stream made infinite workloads a loud decode
+    refusal).  The object path's semantics are unchanged either way:
+    draws are still uniform per draw site, just keyed."""
+    from dslabs_tpu.utils.flags import GlobalSettings
+
+    return GlobalSettings.search_backend == "tensor"
+
+
+def stream_rng(a: Address, i: int) -> random.Random:
+    """The counter-mode stream: rng for client ``a``'s i-th command
+    (0-based), identical across every copy of the workload."""
+    seed = int.from_bytes(
+        hashlib.md5(f"{a}|{i}".encode()).digest()[:8], "big")
+    return random.Random(seed)
 
 _TOKEN = re.compile(r"%(?:r(\d*)|n(\d*)|i(?:-1|\+1)?|a)")
 
 
 def _substitute(s: str, a: Address, i: int,
-                randomness: Optional[Dict[str, List[str]]]):
+                randomness: Optional[Dict[str, List[str]]],
+                rng=None):
     """One pass of %-token replacement.  When ``randomness`` is None, fresh
-    draws are made and recorded; when given, recorded draws are consumed."""
+    draws are made and recorded; when given, recorded draws are consumed.
+    ``rng`` overrides the global random module (the counter-mode
+    deterministic stream, see :func:`stream_rng`)."""
     recording: Dict[str, List[str]] = {}
     use_recorded = randomness is not None
+    r = rng if rng is not None else random
 
     def repl(m: re.Match) -> str:
         tok = m.group(0)
@@ -45,11 +73,11 @@ def _substitute(s: str, a: Address, i: int,
             if val is None:
                 if kind == "r":
                     n = int(m.group(1)) if m.group(1) else 8
-                    val = "".join(random.choices(
+                    val = "".join(r.choices(
                         string.ascii_letters + string.digits, k=n))
                 else:
                     ub = int(m.group(2)) if m.group(2) else 100
-                    val = str(random.randint(1, ub))
+                    val = str(r.randint(1, ub))
             if not use_recorded:
                 recording.setdefault(tok, []).append(val)
             return val
@@ -68,13 +96,14 @@ def _substitute(s: str, a: Address, i: int,
 
 
 def do_replacements(command: Optional[str], result: Optional[str],
-                    a: Address, i: int) -> Tuple[Optional[str], Optional[str]]:
+                    a: Address, i: int,
+                    rng=None) -> Tuple[Optional[str], Optional[str]]:
     if command is None:
         return None, None
-    new_cmd, rec = _substitute(command, a, i, None)
+    new_cmd, rec = _substitute(command, a, i, None, rng)
     if result is None:
         return new_cmd, None
-    new_res, _ = _substitute(result, a, i, rec)
+    new_res, _ = _substitute(result, a, i, rec, rng)
     return new_cmd, new_res
 
 
@@ -144,7 +173,8 @@ class Workload:
             cs = self._command_strings[index]
             rs = self._result_strings[index] if self.has_results() else None
             if self._replacements:
-                cs, rs = do_replacements(cs, rs, a, self._i + 1)
+                rng = stream_rng(a, self._i) if derandomized() else None
+                cs, rs = do_replacements(cs, rs, a, self._i + 1, rng)
             command, result = self._parser(cs, rs)
         self._i += 1
         return command, result

@@ -323,10 +323,27 @@ class ShardStoreBinding(TwinBinding):
             LocalAddress(self.master_name))
         snd = {str(a): v for a, v in settings._sender_active.items()}
         rcv = {str(a): v for a, v in settings._receiver_active.items()}
+        link = {(str(f), str(t)): v
+                for (f, t), v in settings._link_active.items()}
+
+        def msg_live(f, t):
+            # The exact should_deliver precedence compile_masks uses
+            # (link override -> sender -> receiver -> network): a
+            # link_active(ctl, master, True) override makes the debris
+            # deliverable even with the node deactivated.
+            v = link.get((f, t))
+            if v is None:
+                v = snd.get(f)
+            if v is None:
+                v = rcv.get(t)
+            if v is None:
+                v = settings._network_active
+            return v
+
         live = [n for n in self.ctl_names
                 if (settings.should_deliver_timer(LocalAddress(n))
-                    or snd.get(n, settings._network_active)
-                    or rcv.get(n, settings._network_active))]
+                    or msg_live(n, self.master_name)
+                    or msg_live(self.master_name, n))]
         if live and len(self.ctl_names) != 1:
             raise NoTensorTwin(
                 f"controllers {live} are active but the twin models at "

@@ -429,7 +429,13 @@ def _run_tensor(binding: TwinBinding, settings, state, chunk=512):
                 search.max_depth = 2
                 search.run(initial=root, check_initial=False)
             search.max_depth = rel
-            search.max_secs = settings.max_time_secs
+            if settings.max_time_secs is not None:
+                from dslabs_tpu.utils.flags import GlobalSettings
+
+                search.max_secs = (settings.max_time_secs
+                                   * GlobalSettings.time_scale)
+            else:
+                search.max_secs = None
             outcome = search.run(initial=root)
             return search, outcome, history
         except CapacityOverflow as e:

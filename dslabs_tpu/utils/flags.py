@@ -37,6 +37,14 @@ class _GlobalSettings:
         # "tensor" (the TPU engine via protocol twins, tpu/backend.py).
         self.search_backend: str = os.environ.get(
             "DSLABS_SEARCH_BACKEND", "object")
+        # Multiplier on every search max-time budget (the reference
+        # grader's timeout-multiplier analog): batch runs under compile
+        # or CPU contention can set e.g. 2.0 so a directed staged phase
+        # that needs 10s solo doesn't TIME_EXHAUST at a nominal 60s
+        # budget that contention stretched past (the round-4 "test23
+        # passes standalone, fails in batch" margin).
+        self.time_scale: float = float(
+            os.environ.get("DSLABS_TIME_SCALE", "1.0"))
         # Temporarily-enabled error checks (@ChecksEnabled rule analog)
         self.error_checks_temporarily_enabled: bool = False
 

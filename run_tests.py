@@ -106,6 +106,18 @@ def _apply_flags(args) -> None:
         logging.basicConfig(level=args.log_level.upper())
     if args.search_backend:
         GlobalSettings.search_backend = args.search_backend
+    if os.environ.get("DSLABS_FORCE_CPU"):
+        # The axon accelerator plugin pins jax_platforms at import, so
+        # the JAX_PLATFORMS env var alone cannot select CPU; re-pin via
+        # config before any backend initialises (same trick as
+        # tests/conftest.py and bench.py).  Lets the tensor backend run
+        # the lab suites on a machine whose accelerator runtime is
+        # wedged or absent.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_compilation_cache_dir",
+                          "/tmp/jaxcache-cpu")
 
 
 def _replay_traces() -> int:

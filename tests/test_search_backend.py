@@ -289,3 +289,51 @@ def test_lab4_two_phase_tensor(tensor_backend):
     obj = bfs(joined_obj, settings)
     assert obj.end_condition == EndCondition.SPACE_EXHAUSTED
     assert obj.discovered_count == res2.discovered_count
+
+
+def test_lab1_infinite_workload_tensor(tensor_backend):
+    """ClientServerPart2Test.test11's shape on the tensor strategy with
+    DERANDOMIZED streams (round-4 verdict item 8): exhaust verdicts,
+    the add-a-client staged reuse, and — the part the old global-rng
+    streams refused — terminal-state decode through the counter-mode
+    command reconstruction (_StreamPairs)."""
+    from dslabs_tpu.labs.clientserver.kv_workload import (
+        different_keys_infinite_workload)
+    from dslabs_tpu.labs.clientserver.kvstore import Put
+    from dslabs_tpu.search.search import dfs
+    from dslabs_tpu.testing.predicates import (RESULTS_OK,
+                                               client_has_results)
+    import tests.test_lab1 as L1
+
+    state = L1._search_state(
+        workload_factory=lambda: different_keys_infinite_workload())
+    settings = SearchSettings().add_invariant(RESULTS_OK)
+    settings.max_time(5)
+    res = bfs(state, settings)
+    assert res.end_condition in (EndCondition.TIME_EXHAUSTED,
+                                 EndCondition.SPACE_EXHAUSTED)
+
+    settings.set_max_depth(1000).max_time(5)
+    res = dfs(state, settings)
+    assert not res.terminal_found()
+
+    state.add_client_worker(LocalAddress("client2"),
+                            different_keys_infinite_workload())
+    res = dfs(state, settings)
+    assert not res.terminal_found()
+
+    # Terminal-state materialisation through the stream reconstruction:
+    # the goal state's results must be the ACTUAL commands the object
+    # client drew — the counter-mode stream's first Put.
+    state2 = L1._search_state(
+        workload_factory=lambda: different_keys_infinite_workload())
+    s2 = (SearchSettings().add_invariant(RESULTS_OK)
+          .add_goal(client_has_results(LocalAddress("client1"), 1))
+          .max_time(60))
+    res2 = bfs(state2, s2)
+    assert res2.end_condition == EndCondition.GOAL_FOUND
+    goal = res2.goal_matching_state
+    worker = goal.client_workers()[LocalAddress("client1")]
+    assert len(worker.results) >= 1
+    sent = worker.sent_commands[0]
+    assert isinstance(sent, Put) and sent.key.startswith("client1-")
