@@ -284,10 +284,13 @@ def _sub(args, child_budget: float, label: str):
     # The kill slack must never push past the GLOBAL deadline — a
     # driver that enforces DSLABS_BENCH_DEADLINE_SECS externally would
     # otherwise kill US first and lose the JSON line (the rc=124
-    # shape).  A phase that cannot finish inside the deadline gets cut
-    # at the deadline and reported as such.
-    timeout = min(child_budget + KILL_SLACK_SECS,
-                  max(_remaining() - 5, 10.0))
+    # shape).  With too little deadline left to even start+kill a
+    # child, SKIP the phase outright (best-so-far JSON beats a race).
+    if _remaining() < 20:
+        err = f"{label} skipped: global deadline exhausted"
+        _hb(f"phase {label}: SKIPPED (deadline)")
+        return None, err
+    timeout = min(child_budget + KILL_SLACK_SECS, _remaining() - 5)
     _hb(f"phase {label}: start (budget {child_budget:.0f}s, "
         f"kill at {timeout:.0f}s, deadline in {_remaining():.0f}s)")
     t0 = time.time()

@@ -45,6 +45,74 @@ def _single(seq, what: str):
     return items[0]
 
 
+def _ctl_live(settings, ctl_names, master_name):
+    """Controller addresses whose events are deliverable under the FULL
+    should_deliver precedence (link override -> sender -> receiver ->
+    network, testing/settings.py:138-151) or whose timers are live."""
+    from dslabs_tpu.core.address import LocalAddress
+
+    snd = {str(a): v for a, v in settings._sender_active.items()}
+    rcv = {str(a): v for a, v in settings._receiver_active.items()}
+    link = {(str(f), str(t)): v
+            for (f, t), v in settings._link_active.items()}
+
+    def msg_live(f, t):
+        v = link.get((f, t))
+        if v is None:
+            v = snd.get(f)
+        if v is None:
+            v = rcv.get(t)
+        if v is None:
+            v = settings._network_active
+        return v
+
+    return [n for n in ctl_names
+            if (settings.should_deliver_timer(LocalAddress(n))
+                or msg_live(n, master_name)
+                or msg_live(master_name, n))]
+
+
+def _validate_joined_root(state, master_name, server_names,
+                          client_names) -> None:
+    """Shared canonical-joined-root validation: the lab4 twins' initial
+    states BAKE IN the staged joins, so instead of provenance replay the
+    bindings verify the staged object state matches that canonical shape
+    field by field — any deviation is a loud NoTensorTwin, never a
+    silently-wrong root."""
+    from dslabs_tpu.core.address import LocalAddress
+
+    def req(cond, what):
+        if not cond:
+            raise NoTensorTwin(
+                f"staged state is not the canonical joined root: {what}")
+
+    by_name = {str(a): s for a, s in state.servers.items()}
+    master = by_name[master_name]
+    app = master.app
+    for name in (*client_names, *server_names):
+        req(app.last.get(LocalAddress(name)) is None,
+            f"master AMO already has an entry for {name}")
+    for name in server_names:
+        s = by_name[name]
+        req(s.current_config is None, f"{name} already has a config")
+        req(s.qseq == 0, f"{name} qseq {s.qseq} != 0")
+        req(not s.owned and not s.incoming and not s.outgoing,
+            f"{name} has shard-handoff state")
+        req(not s.locks and not s.prepared and not s.coord,
+            f"{name} has 2PC state")
+        req(not s.paxos.log, f"{name} paxos log not empty")
+    workers = {str(a): w for a, w in state.client_workers().items()}
+    for name in client_names:
+        worker = workers[name]
+        req(not worker.results, f"{name} already has results")
+        c = worker.client
+        req(c.current_config is None, f"{name} already has a config")
+        req(c.qseq == 2, f"{name} qseq {c.qseq} != 2 (init + "
+            "config-less send_pending fallback)")
+        req(c.pending is not None and c.pending.sequence_num == 1,
+            f"{name}'s first command is not pending")
+
+
 class JoinBinding(TwinBinding):
     """Join-phase binding: one shard master + the config controller,
     store servers cut off (tpu/protocols/shardmaster_join.py)."""
@@ -281,9 +349,20 @@ class ShardStoreBinding(TwinBinding):
             gs = []
             for cmd, _ in pairs:
                 if isinstance(cmd, Transaction):
-                    raise NoTensorTwin(
-                        "shardstore twin does not model transactions — "
-                        "the tx twin covers those shapes")
+                    # A SINGLE-group transaction executes like any app
+                    # command (shards <= mine -> app.execute, no 2PC:
+                    # shardstore.py _execute_client_command) — the twin
+                    # is command-content agnostic, so it binds here.
+                    # Cross-group transactions route to TxBinding.
+                    tgs = {final.group_of(key_to_shard(k,
+                                                       self.num_shards))
+                           for k in cmd.key_set()}
+                    if len(tgs) != 1:
+                        raise NoTensorTwin(
+                            f"cross-group transaction {cmd!r} — the tx "
+                            "twin covers those shapes")
+                    gs.append(tgs.pop())
+                    continue
                 key = getattr(cmd, "key", None)
                 if key is None:
                     raise NoTensorTwin(f"command {cmd!r} has no key")
@@ -321,29 +400,7 @@ class ShardStoreBinding(TwinBinding):
 
         self._model_mh = settings.should_deliver_timer(
             LocalAddress(self.master_name))
-        snd = {str(a): v for a, v in settings._sender_active.items()}
-        rcv = {str(a): v for a, v in settings._receiver_active.items()}
-        link = {(str(f), str(t)): v
-                for (f, t), v in settings._link_active.items()}
-
-        def msg_live(f, t):
-            # The exact should_deliver precedence compile_masks uses
-            # (link override -> sender -> receiver -> network): a
-            # link_active(ctl, master, True) override makes the debris
-            # deliverable even with the node deactivated.
-            v = link.get((f, t))
-            if v is None:
-                v = snd.get(f)
-            if v is None:
-                v = rcv.get(t)
-            if v is None:
-                v = settings._network_active
-            return v
-
-        live = [n for n in self.ctl_names
-                if (settings.should_deliver_timer(LocalAddress(n))
-                    or msg_live(n, self.master_name)
-                    or msg_live(self.master_name, n))]
+        live = _ctl_live(settings, self.ctl_names, self.master_name)
         if live and len(self.ctl_names) != 1:
             raise NoTensorTwin(
                 f"controllers {live} are active but the twin models at "
@@ -366,6 +423,9 @@ class ShardStoreBinding(TwinBinding):
                 "staged network ops on the joined root are not part of "
                 "the canonical lab4 shape")
 
+        _validate_joined_root(state, self.master_name,
+                              self.server_names, self.client_names)
+
         def req(cond, what):
             if not cond:
                 raise NoTensorTwin(
@@ -374,31 +434,6 @@ class ShardStoreBinding(TwinBinding):
 
         by_name = {str(a): s for a, s in state.servers.items()}
         master = by_name[self.master_name]
-        app = master.app
-        for name in (*self.client_names, *self.server_names):
-            from dslabs_tpu.core.address import LocalAddress
-
-            req(app.last.get(LocalAddress(name)) is None,
-                f"master AMO already has an entry for {name}")
-        for g, name in enumerate(self.server_names, start=1):
-            s = by_name[name]
-            req(s.current_config is None, f"{name} already has a config")
-            req(s.qseq == 0, f"{name} qseq {s.qseq} != 0")
-            req(not s.owned and not s.incoming and not s.outgoing,
-                f"{name} has shard-handoff state")
-            req(not s.locks and not s.prepared and not s.coord,
-                f"{name} has 2PC state")
-            req(not s.paxos.log, f"{name} paxos log not empty")
-        workers = {str(a): w for a, w in state.client_workers().items()}
-        for name in self.client_names:
-            worker = workers[name]
-            req(not worker.results, f"{name} already has results")
-            c = worker.client
-            req(c.current_config is None, f"{name} already has a config")
-            req(c.qseq == 2, f"{name} qseq {c.qseq} != 2 (init + "
-                "config-less send_pending fallback)")
-            req(c.pending is not None and c.pending.sequence_num == 1,
-                f"{name}'s first command is not pending")
         if self._model_mh:
             req(master.heard_from_leader,
                 "master heard_from_leader is False (twin init assumes "
@@ -682,6 +717,326 @@ class ShardStoreBinding(TwinBinding):
         return None
 
 
+class ShardStoreTxBinding(TwinBinding):
+    """Cross-group-transaction binding (ShardStorePart2Test.test09 /
+    our test09_single_client_multi_group_tx_search): two one-server
+    groups, one client whose every command is a Transaction spanning
+    BOTH groups with its minimum shard owned by group 1 (the static
+    coordinator) — the shardstore_tx twin's exact scope.  Node order
+    mirrors the twin: master 0, servers 1..2, client 3."""
+
+    def __init__(self, state, master_addr, kv_addr, ctl_addrs):
+        from dslabs_tpu.labs.shardedstore.shardmaster import ShardConfig
+        from dslabs_tpu.labs.shardedstore.shardstore import (
+            ShardStoreServer, key_to_shard)
+        from dslabs_tpu.labs.shardedstore.txkvstore import Transaction
+
+        self.master_name = str(master_addr)
+        self.client_name = str(kv_addr)
+        self.ctl_names = [str(a) for a in ctl_addrs]
+        master = state.servers[master_addr]
+
+        by_group = {}
+        for a, s in state.servers.items():
+            if isinstance(s, ShardStoreServer):
+                if s.group_id in by_group:
+                    raise NoTensorTwin(
+                        "tx twin models ONE server per group")
+                by_group[s.group_id] = (a, s)
+        if sorted(by_group) != [1, 2]:
+            raise NoTensorTwin(
+                f"tx twin models exactly groups 1..2, got "
+                f"{sorted(by_group)}")
+        self.server_names = [str(by_group[g][0]) for g in (1, 2)]
+        self.ballots = [by_group[g][1].paxos.ballot for g in (1, 2)]
+        self.master_ballot = master.ballot
+        self.num_shards = by_group[1][1].num_shards
+
+        self.addr_index = {self.master_name: 0,
+                           self.server_names[0]: 1,
+                           self.server_names[1]: 2,
+                           self.client_name: 3}
+
+        app = master.app.application if master.app is not None else None
+        configs = getattr(app, "configs", None)
+        if not configs or len(configs) != 2:
+            raise NoTensorTwin(
+                f"master has {len(configs or [])} configs, tx twin "
+                "expects 2 (Join(1), Join(2))")
+        if not all(isinstance(c, ShardConfig) for c in configs):
+            raise NoTensorTwin("master configs are not ShardConfigs")
+        self.configs = list(configs)
+        for s in range(1, self.num_shards + 1):
+            if self.configs[0].group_of(s) != 1:
+                raise NoTensorTwin(
+                    "tx twin assumes cfg0 assigns every shard to g1")
+
+        workers = state.client_workers()
+        pairs = _workload_pairs(workers[kv_addr], kv_addr)
+        final = self.configs[-1]
+        for cmd, _ in pairs:
+            if not isinstance(cmd, Transaction):
+                raise NoTensorTwin(
+                    f"tx twin models all-transaction workloads, got "
+                    f"{cmd!r}")
+            shards = sorted(key_to_shard(k, self.num_shards)
+                            for k in cmd.key_set())
+            tgs = {final.group_of(s) for s in shards}
+            if tgs != {1, 2}:
+                raise NoTensorTwin(
+                    f"transaction {cmd!r} spans groups {sorted(tgs)}, "
+                    "the tx twin models both-group transactions")
+            if final.group_of(min(shards)) != 1:
+                raise NoTensorTwin(
+                    "tx twin's static coordinator is group 1 (the "
+                    "minimum shard's owner)")
+        self.pairs = pairs
+        self.W = len(pairs)
+        self.key = ("shardstore-tx", self.master_name, self.client_name,
+                    tuple(self.server_names),
+                    tuple(repr(c) for c, _ in pairs))
+        # Client workload-index lane (tx twin layout: master 2+G, then
+        # per-server blocks 9 + 3W, then the g1 coordinator block 7W).
+        self._ck = (2 + 2) + (9 + 3 * self.W) * 2 + 7 * self.W
+
+    def initial_caps(self):
+        return 48, 6
+
+    def check_settings(self, settings) -> None:
+        from dslabs_tpu.core.address import LocalAddress
+
+        if settings.should_deliver_timer(
+                LocalAddress(self.master_name)):
+            raise NoTensorTwin(
+                "tx twin freezes the master's timers — settings must "
+                "deliver_timers(master, False)")
+        live = _ctl_live(settings, self.ctl_names, self.master_name)
+        if live:
+            raise NoTensorTwin(
+                f"controllers {live} must be fully suppressed — the "
+                "tx twin does not model their debris")
+
+    def derive_root(self, search, state):
+        prov = getattr(state, "_tensor_provenance", None)
+        if prov is not None and prov.key == self.key:
+            from dslabs_tpu.tpu import backend as _b
+
+            return _b.derive_root(self, search, state)
+        if getattr(state, "_staged_ops", None):
+            raise NoTensorTwin(
+                "staged network ops on the joined root are not part of "
+                "the canonical lab4 shape")
+        _validate_joined_root(state, self.master_name,
+                              self.server_names, [self.client_name])
+        return None, []
+
+    def build_protocol(self, net_cap, timer_cap):
+        from dslabs_tpu.tpu.protocols.shardstore_tx import             make_shardstore_tx_protocol
+
+        p = make_shardstore_tx_protocol(
+            n_tx=self.W, net_cap=max(net_cap, 48),
+            timer_cap=max(timer_cap, 6))
+        return dataclasses.replace(
+            p, decode_message=self._decode_message,
+            decode_timer=self._decode_timer)
+
+    # ------------------------------------------------------------ decoders
+
+    def _addr(self, name):
+        from dslabs_tpu.core.address import LocalAddress
+
+        return LocalAddress(name)
+
+    def _amo(self, t):
+        from dslabs_tpu.labs.clientserver.amo import AMOCommand
+
+        return AMOCommand(self.pairs[t - 1][0],
+                          self._addr(self.client_name), t)
+
+    def _decode_message(self, rec):
+        from dslabs_tpu.labs.clientserver.amo import AMOCommand, AMOResult
+        from dslabs_tpu.labs.paxos.paxos import PaxosReply, PaxosRequest
+        from dslabs_tpu.labs.shardedstore.shardmaster import (Query,
+                                                              ShardConfig)
+        from dslabs_tpu.labs.shardedstore.shardstore import (
+            ShardMove, ShardMoveAck, ShardStoreReply, ShardStoreRequest,
+            TxAck, TxDecision, TxPrepare, TxVote, WrongGroup)
+        from dslabs_tpu.tpu.protocols.shardstore_tx import (QREP, QRY,
+                                                            SM, SMACK,
+                                                            SSREP,
+                                                            SSREQ, TXA,
+                                                            TXD, TXP,
+                                                            TXV, WG)
+        from dslabs_tpu.tpu.trace import MessageTemplate
+
+        r = [int(x) for x in rec]
+        tag, a, b, c = r[0], r[1], r[2], r[3]
+        master = self._addr(self.master_name)
+        client = self._addr(self.client_name)
+        s1 = self._addr(self.server_names[0])
+        s2 = self._addr(self.server_names[1])
+        srv_of = {1: s1, 2: s2}
+        final_num = self.configs[-1].config_num
+        tx_id = lambda t: (client, t)     # noqa: E731
+        if tag == QRY:
+            frm = client if a == 0 else srv_of[a]
+            return frm, master, PaxosRequest(
+                AMOCommand(Query(c), frm, b))
+        if tag == QREP:
+            to = client if a == 0 else srv_of[a]
+            return master, to, MessageTemplate(
+                PaxosReply, None,
+                lambda m, s=b: (m.result.sequence_num == s
+                                and isinstance(m.result.result,
+                                               ShardConfig)))
+        if tag == SSREQ:
+            return client, s1, ShardStoreRequest(self._amo(a))
+        if tag == SSREP:
+            res = self.pairs[a - 1][1]
+            fallback = (ShardStoreReply(AMOResult(res, a))
+                        if res is not None else None)
+            return s1, client, MessageTemplate(
+                ShardStoreReply, fallback,
+                lambda m, s=a: m.result.sequence_num == s)
+        if tag == WG:
+            return s1, client, WrongGroup(a)
+        if tag == SM:
+            return s1, s2, MessageTemplate(
+                ShardMove, None,
+                lambda m: (m.config_num == final_num
+                           and m.from_group == 1))
+        if tag == SMACK:
+            return s2, s1, MessageTemplate(
+                ShardMoveAck, None,
+                lambda m: m.config_num == final_num)
+        if tag == TXP:
+            # The coordinator's prepare: config_num is constantly the
+            # final config's (coordination only happens at cfg1), the
+            # member tuple is g1's single server.
+            return s1, srv_of[c], TxPrepare(
+                self._amo(a), b, 1, final_num, (s1,))
+        if tag == TXV:
+            fg, ok = c // 2, bool(c % 2)
+            # Vote VALUES are () in every reachable voting state (the
+            # twin's collapse argument, shardstore_tx.py docstring).
+            return srv_of[fg], s1, TxVote(tx_id(a), b, fg, ok, ())
+        if tag == TXD:
+            dst, commit = c // 2, bool(c % 2)
+            return s1, srv_of[dst], MessageTemplate(
+                TxDecision, None,
+                lambda m, t=a, rnd=b, cm=commit: (
+                    m.tx_id == tx_id(t) and m.round == rnd
+                    and m.commit == cm))
+        if tag == TXA:
+            return srv_of[c], s1, TxAck(tx_id(a), b, c)
+        raise NoTensorTwin(f"unknown tx twin message tag {tag}")
+
+    def _decode_timer(self, node_idx, rec):
+        from dslabs_tpu.core.address import SubAddress
+        from dslabs_tpu.labs.paxos import paxos as P
+        from dslabs_tpu.labs.shardedstore.shardstore import (ClientTimer,
+                                                             QueryTimer)
+        from dslabs_tpu.tpu.protocols.shardstore_tx import (CLIENT_MS,
+                                                            ELECTION_MAX,
+                                                            ELECTION_MIN,
+                                                            HEARTBEAT_MS,
+                                                            QUERY_MS,
+                                                            T_CLIENT,
+                                                            T_ELECTION,
+                                                            T_HEARTBEAT,
+                                                            T_QUERY)
+
+        tag, p0 = int(rec[0]), int(rec[3])
+        node_idx = int(node_idx)
+        if tag == T_CLIENT:
+            return (self._addr(self.client_name), ClientTimer(p0),
+                    CLIENT_MS, CLIENT_MS)
+        name = self.server_names[node_idx - 1]
+        if tag == T_QUERY:
+            return (self._addr(name), QueryTimer(), QUERY_MS, QUERY_MS)
+        sub = SubAddress(self._addr(name), "paxos")
+        if tag == T_ELECTION:
+            return (sub, P.ElectionTimer(), ELECTION_MIN, ELECTION_MAX)
+        if tag == T_HEARTBEAT:
+            return (sub, P.HeartbeatTimer(self.ballots[node_idx - 1]),
+                    HEARTBEAT_MS, HEARTBEAT_MS)
+        raise NoTensorTwin(f"unknown tx twin timer tag {tag}")
+
+    # ---------------------------------------------------------------- masks
+
+    def msg_mask_fn(self):
+        from dslabs_tpu.tpu.protocols.shardstore_tx import (QREP, QRY,
+                                                            SM, SMACK,
+                                                            SSREP,
+                                                            SSREQ, TXA,
+                                                            TXD, TXP,
+                                                            TXV, WG)
+
+        nn = len(self.addr_index)
+
+        def fn(msg, marr):
+            import jax.numpy as jnp
+
+            tag, a, c = msg[0], msg[1], msg[3]
+            CL = 3
+            src = jnp.where(a == 0, CL, a)
+            frm = jnp.asarray(0, jnp.int32)
+            to = jnp.asarray(0, jnp.int32)
+            frm = jnp.where(tag == QRY, src, frm)
+            to = jnp.where(tag == QREP, src, to)
+            frm = jnp.where(tag == SSREQ, CL, frm)
+            to = jnp.where(tag == SSREQ, 1, to)
+            frm = jnp.where((tag == SSREP) | (tag == WG), 1, frm)
+            to = jnp.where((tag == SSREP) | (tag == WG), CL, to)
+            frm = jnp.where(tag == SM, 1, frm)
+            to = jnp.where(tag == SM, 2, to)
+            frm = jnp.where(tag == SMACK, 2, frm)
+            to = jnp.where(tag == SMACK, 1, to)
+            frm = jnp.where(tag == TXP, 1, frm)
+            to = jnp.where(tag == TXP, c, to)
+            frm = jnp.where(tag == TXV, c // 2, frm)
+            to = jnp.where(tag == TXV, 1, to)
+            frm = jnp.where(tag == TXD, 1, frm)
+            to = jnp.where(tag == TXD, c // 2, to)
+            frm = jnp.where(tag == TXA, c, frm)
+            to = jnp.where(tag == TXA, 1, to)
+            k = frm * nn + to
+            return jnp.sum(jnp.where(jnp.arange(nn * nn) == k, marr,
+                                     False))
+        return fn
+
+    # ----------------------------------------------------------- predicates
+
+    def predicate(self, tkey):
+        kind = tkey[0]
+        W, ck = self.W, self._ck
+
+        def k(s):
+            return s["nodes"][ck]
+
+        def const_true(s):
+            return k(s) >= 1
+        const_true.value_level = True
+
+        if kind in ("RESULTS_OK", "RESULTS_LINEARIZABLE",
+                    "ALL_RESULTS_SAME", "MULTI_GETS_MATCH"):
+            return const_true
+        if kind == "CLIENTS_DONE":
+            return lambda s: k(s) == W + 1
+        if kind == "CLIENT_DONE":
+            if str(tkey[1].root_address()) != self.client_name:
+                return None
+            return lambda s: k(s) == W + 1
+        if kind == "CLIENT_HAS_RESULTS":
+            if str(tkey[1].root_address()) != self.client_name:
+                return None
+            num = tkey[2]
+            return lambda s: k(s) >= num + 1
+        if kind == "NONE_DECIDED":
+            return lambda s: k(s) == 1
+        return None
+
+
 @register_adapter
 def match_shardstore(state):
     from dslabs_tpu.labs.paxos.paxos import PaxosClient, PaxosServer
@@ -720,6 +1075,36 @@ def match_shardstore(state):
             return None
         return JoinBinding(state, _single(masters, "shard master"),
                            ctl[0], stores)
-    # Main phase: controllers must be finished (their workload drained).
-    return ShardStoreBinding(state, _single(masters, "shard master"),
-                             kv, ctl)
+    # Main phase: controllers must be finished (their workload
+    # drained).  Workloads containing a CROSS-group transaction bind to
+    # the 2PC twin; everything else (plain commands and single-group
+    # transactions, which execute without 2PC) binds to the Part-1 twin.
+    from dslabs_tpu.labs.shardedstore.shardmaster import ShardConfig
+    from dslabs_tpu.labs.shardedstore.shardstore import key_to_shard
+    from dslabs_tpu.labs.shardedstore.txkvstore import Transaction
+
+    master_addr = _single(masters, "shard master")
+    master = servers[master_addr]
+    app = master.app.application if master.app is not None else None
+    configs = getattr(app, "configs", None)
+    cross = False
+    if configs and all(isinstance(c, ShardConfig) for c in configs):
+        final = configs[-1]
+        ns = next(s for s in servers.values()
+                  if isinstance(s, ShardStoreServer)).num_shards
+        for a in kv:
+            if workers[a].workload.infinite():
+                continue
+            # Materialize through the same path the bindings use, so
+            # string-template workloads whose PARSER yields
+            # Transactions route correctly too.
+            for cmd, _ in _workload_pairs(workers[a], a):
+                if isinstance(cmd, Transaction) and len(
+                        {final.group_of(key_to_shard(k, ns))
+                         for k in cmd.key_set()}) > 1:
+                    cross = True
+    if cross:
+        return ShardStoreTxBinding(state, master_addr,
+                                   _single(kv, "tx-workload client"),
+                                   ctl)
+    return ShardStoreBinding(state, master_addr, kv, ctl)

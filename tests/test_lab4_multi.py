@@ -12,9 +12,14 @@ and the controller gated exactly like ShardStoreBaseTest.java:209-220):
     settings: RESULTS_OK invariant, CCA node+timers off,
               shardmaster timers off, max_depth = joined.depth + d
 
-measured 2026-07-31 (tools-free repro: /tmp-style driver in this file's
-git history; the object run takes ~10 min for depth 4):
-    depth 1 -> 10    depth 2 -> 69    depth 3 -> 392    depth 4 -> 1985
+measured 2026-07-31 (tools-free repro: /tmp-style drivers in this file's
+git history; the deeper runs are round-5 additions):
+    (2, 3, 1, 10): depth 1 -> 10   2 -> 69    3 -> 392
+                   depth 4 -> 1985 5 -> 9304  6 -> 41189
+    (2, 2, 1, 10): depth 1 -> 8    2 -> 42    3 -> 180
+                   depth 4 -> 681  5 -> 2365      (second staged start:
+                   2-server groups — different majority, different
+                   election interleavings from depth 1 on)
 
 The twin starts from the equivalent staged state by construction
 (init_* in the twin factory mirror the object staging: two pending
@@ -33,7 +38,8 @@ from dslabs_tpu.tpu.protocols.shardstore_multi import \
 
 SLOW = not os.environ.get("DSLABS_SLOW_TESTS")
 
-ORACLE = {1: 10, 2: 69, 3: 392, 4: 1985}
+ORACLE = {1: 10, 2: 69, 3: 392, 4: 1985, 5: 9304, 6: 41189}
+ORACLE_N2 = {1: 8, 2: 42, 3: 180, 4: 681, 5: 2365}
 
 
 @pytest.mark.skipif(SLOW, reason="multi-group twin compile is minutes on "
@@ -41,6 +47,20 @@ ORACLE = {1: 10, 2: 69, 3: 392, 4: 1985}
 def test_lab4_multi_group_depth_parity():
     p = make_shardstore_multi_protocol(n_groups=2, n=3, num_shards=10)
     for depth, want in ORACLE.items():
+        out = TensorSearch(p, chunk=128, max_depth=depth).run()
+        assert out.unique_states == want, (
+            f"depth {depth}: tensor {out.unique_states} != object {want}")
+
+
+@pytest.mark.skipif(SLOW, reason="multi-group twin compile is minutes on "
+                    "CPU (DSLABS_SLOW_TESTS=1 enables)")
+def test_lab4_multi_group_n2_depth_parity():
+    """The SECOND staged start (round-4 verdict item 6): 2-server
+    groups — majority 2 of 2, so the in-group Paxos walks different
+    quorum/election interleavings than the 3-server shape from the very
+    first level."""
+    p = make_shardstore_multi_protocol(n_groups=2, n=2, num_shards=10)
+    for depth, want in ORACLE_N2.items():
         out = TensorSearch(p, chunk=128, max_depth=depth).run()
         assert out.unique_states == want, (
             f"depth {depth}: tensor {out.unique_states} != object {want}")

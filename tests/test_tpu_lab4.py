@@ -285,6 +285,37 @@ def test_lab4_tx_depth_parity():
         f"tensor {ten.unique_states} != object {obj.discovered_count}")
 
 
+def test_lab4_tx_two_shard_depth_parity():
+    """The tx twin is shard-count agnostic (handoff collapses to flags,
+    never masks): test09's OWN 2-shard configuration must walk the same
+    space shape — pinned against its object oracle so the tensor-backend
+    run of test09 rests on a verified twin, not an analogy to the
+    10-shard fixture."""
+    from dslabs_tpu.labs.shardedstore.txkvstore import (MultiPut,
+                                                       MultiPutOk)
+    from dslabs_tpu.testing.workload import Workload
+    from dslabs_tpu.tpu.protocols.shardstore_tx import \
+        make_shardstore_tx_protocol
+
+    state = lab4.make_search(2, 1, 1, 2)
+    joined = lab4._joined_state(state, 2)
+    joined.add_client_worker(
+        LocalAddress("client1"),
+        Workload(commands=[MultiPut({"key-1": "x", "key-2": "y"})],
+                 results=[MultiPutOk()]))
+    settings = SearchSettings().max_time(600)
+    settings.add_invariant(RESULTS_OK)
+    settings.node_active(lab4.CCA, False)
+    settings.deliver_timers(lab4.CCA, False)
+    settings.deliver_timers(lab4.shard_master(1), False)
+    settings.set_max_depth(joined.depth + 3)
+    obj = BFS(settings).run(joined)
+    ten = TensorSearch(make_shardstore_tx_protocol(n_tx=1), chunk=256,
+                       max_depth=3).run()
+    assert ten.unique_states == obj.discovered_count, (
+        f"tensor {ten.unique_states} != object {obj.discovered_count}")
+
+
 @SLOW
 def test_lab4_tx_deep_parity():
     """Depths 4-5 (slow: the object oracle expands thousands of 2PC

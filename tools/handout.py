@@ -33,7 +33,20 @@ import tarfile
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 SHIP = ["dslabs_tpu", "tests", "run_tests.py", "bench.py", "Makefile",
-        "README.md", "docs", "grading", "__graft_entry__.py"]
+        "README.md", "docs", "__graft_entry__.py"]
+# Instructor-only material and SOLUTION MIRRORS never ship: the tensor
+# protocol twins + compiler specs are handler-for-handler readable
+# reimplementations of the lab solutions (their module docstrings say
+# so), and the adapters embed the same logic — handing them out would
+# defeat the skeleton stripping.  The tensor ENGINE ships (it is
+# framework); twin resolution then fails loudly with NoTensorTwin for
+# students, and the default object search path is unaffected.
+OMIT = [
+    "dslabs_tpu/tpu/protocols",
+    "dslabs_tpu/tpu/specs.py",
+    "dslabs_tpu/tpu/adapters",
+    "grading",
+]
 # Lab modules whose logic methods are the assignment (stripped); the
 # scaffolding modules (amo, kv_workload, workloads, predicates) ship
 # verbatim like the reference's handed-out utility classes.
@@ -94,6 +107,12 @@ def build(out_dir: str, make_tar: bool) -> str:
         else:
             os.makedirs(os.path.dirname(dst), exist_ok=True)
             shutil.copy2(src, dst)
+    for rel in OMIT:
+        path = os.path.join(out, rel)
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)
     stripped = []
     for rel in sorted(STRIP):
         path = os.path.join(out, rel)
