@@ -449,6 +449,8 @@ class ShardStoreBinding(TwinBinding):
                                                      PaxosRequest)
 
             name = self.ctl_names[0]
+            workers = {str(a): w
+                       for a, w in state.client_workers().items()}
             ctl_client = workers[name].client
             G = self.G
             req(ctl_client.pending is None and ctl_client.seq_num == G,

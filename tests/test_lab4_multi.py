@@ -38,7 +38,11 @@ from dslabs_tpu.tpu.protocols.shardstore_multi import \
 
 SLOW = not os.environ.get("DSLABS_SLOW_TESTS")
 
-ORACLE = {1: 10, 2: 69, 3: 392, 4: 1985, 5: 9304, 6: 41189}
+# Depth 6's oracle count (41189, measured 2026-07-31) stays OUT of the
+# automated sweep: the twin side alone needs ~an hour of CPU at that
+# depth, past the slow job's budget.  Depth 5 pins the same transition
+# surface (every handler class fires by depth 4).
+ORACLE = {1: 10, 2: 69, 3: 392, 4: 1985, 5: 9304}
 ORACLE_N2 = {1: 8, 2: 42, 3: 180, 4: 681, 5: 2365}
 
 
