@@ -1,0 +1,51 @@
+"""bench.py contract smoke tests: whatever happens — wedged runtime,
+exhausted deadline, healthy run — the bench must exit 0 with exactly one
+parseable JSON line on stdout (round-4's BENCH_r04.json was rc=124 with
+an empty tail; the round-5 rework makes that shape impossible)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(ROOT, "bench.py")
+
+
+def _run(env_extra, timeout):
+    env = dict(os.environ, DSLABS_FORCE_CPU="1", **env_extra)
+    # The bench manages its own platform pinning; drop the test
+    # harness's CPU-mesh flags so the child sees a clean slate.
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, BENCH], capture_output=True, text=True,
+        timeout=timeout, env=env, cwd=ROOT)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, proc.stdout
+    out = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in out, out
+    return out
+
+
+def test_bench_exhausted_deadline_still_emits_json():
+    """With a deadline too small for any phase, the bench must skip
+    phases (never race an external killer) and still land the JSON
+    line with an attributable error."""
+    out = _run({"DSLABS_BENCH_DEADLINE_SECS": "1"}, timeout=240)
+    assert out["value"] == 0.0
+    assert "error" in out
+
+
+@pytest.mark.skipif(not os.environ.get("DSLABS_SLOW_TESTS"),
+                    reason="runs a real (small) CPU beam rung")
+def test_bench_cpu_smoke_lands_a_rate():
+    """The healthy-path contract on the CPU backend: preflight, one
+    beam rung, a nonzero rate, compile_secs reported."""
+    out = _run({"DSLABS_BENCH_DEADLINE_SECS": "400"}, timeout=450)
+    assert out["value"] > 0, out
+    assert out["beam"]["dropped"] >= 0
+    assert "compile_secs" in out["beam"]
