@@ -78,6 +78,24 @@ def test_generated_pb_matches_hand_twin():
             f"hand {hand.states_explored}")
 
 
+def test_generated_pb_two_client_parity():
+    """Two clients through the generated lab2 twin: the forwarding and
+    AMO lanes are per-client vectors, so this pins the array-field
+    (get_at/put_at) compilation path on a stateful protocol."""
+    from dslabs_tpu.tpu.protocols.primarybackup import make_pb_protocol
+    from dslabs_tpu.tpu.specs import pb_spec
+
+    gen_p = pb_spec(2, 2, 1).compile()
+    hand_p = make_pb_protocol(2, 2, 1)
+    for depth in (2, 3):
+        gen = TensorSearch(gen_p, chunk=256, max_depth=depth).run()
+        hand = TensorSearch(hand_p, chunk=256, max_depth=depth).run()
+        assert gen.unique_states == hand.unique_states, (
+            f"depth {depth}: gen {gen.unique_states} != "
+            f"hand {hand.unique_states}")
+        assert gen.states_explored == hand.states_explored
+
+
 def test_generated_pb_goal():
     """The generated lab2 twin completes the workload (view startup ->
     state transfer -> forwarded op -> reply) exactly like the hand
