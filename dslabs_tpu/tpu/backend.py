@@ -391,19 +391,9 @@ def _run_tensor(binding: TwinBinding, settings, state, chunk=512):
     # them on the FIRST attempt, not after a capacity retry.
     binding.check_settings(settings)
     for attempt, (f_cap, v_cap) in enumerate(_LADDER):
-        protocol = binding.build_protocol(net_cap << attempt,
-                                          timer_cap + 2 * attempt)
-        marr, tarr = compile_masks(binding, settings)
-        inv = {p.name: translate_predicate(binding, p)
-               for p in settings.invariants}
-        goals = {p.name: translate_predicate(binding, p)
-                 for p in settings.goals}
-        prunes = {p.name: translate_predicate(binding, p)
-                  for p in settings.prunes}
-        protocol = dataclasses.replace(
-            protocol, invariants=inv, goals=goals, prunes=prunes,
-            deliver_message_rt=binding.msg_mask_fn(),
-            deliver_timer_rt=TwinBinding.tmr_mask_fn(len(tarr)))
+        protocol, marr, tarr = _bind_protocol(
+            binding, settings, net_cap << attempt,
+            timer_cap + 2 * attempt)
         search = ShardedTensorSearch(
             protocol, mesh, chunk_per_device=chunk, frontier_cap=f_cap,
             visited_cap=v_cap, strict=True, record_trace=True)
@@ -482,7 +472,80 @@ def _sampled_value_recheck(binding, search, outcome, settings, state):
     return None
 
 
-def tensor_bfs(initial_state, settings=None):
+def _bind_protocol(binding, settings, net_cap, timer_cap,
+                   with_goals=True):
+    """Assemble the runnable twin for one capacity rung: protocol with
+    translated predicates + runtime mask arrays — ONE code path for the
+    BFS ladder and the rollout probe, so both always search identically
+    configured twins."""
+    marr, tarr = compile_masks(binding, settings)
+    protocol = binding.build_protocol(net_cap, timer_cap)
+    inv = {p.name: translate_predicate(binding, p)
+           for p in settings.invariants}
+    goals = ({p.name: translate_predicate(binding, p)
+              for p in settings.goals} if with_goals else {})
+    prunes = {p.name: translate_predicate(binding, p)
+              for p in settings.prunes}
+    protocol = dataclasses.replace(
+        protocol, invariants=inv, goals=goals, prunes=prunes,
+        deliver_message_rt=binding.msg_mask_fn(),
+        deliver_timer_rt=TwinBinding.tmr_mask_fn(len(tarr)))
+    return protocol, marr, tarr
+
+
+def _rollout_probe(binding, settings, state):
+    """RandomDFS-style deep probe before a dfs-routed BFS: random event
+    walks on the single-device twin reach depth d in O(d) steps, so the
+    deep-narrow violations the object RandomDFS could hit inside a time
+    budget are covered BEFORE the level-by-level search starts (the
+    round-4 advisor's dfs-coverage gap, engine.random_rollouts).
+    Returns (search, outcome, history) on a terminal hit, else None —
+    capacity overflows skip the probe (the BFS ladder handles caps)."""
+    import jax
+
+    from dslabs_tpu.tpu.engine import CapacityOverflow, TensorSearch
+    from dslabs_tpu.utils.flags import GlobalSettings
+
+    import time
+
+    t_probe = time.time()
+    try:
+        binding.check_settings(settings)
+        net_cap, timer_cap = binding.initial_caps()
+        # Probe at the capacity ladder's TOP rung outright: rollouts
+        # hold K rows, not a frontier, so the wide caps cost nothing —
+        # and an overflowed step is a silent walker restart here, which
+        # at base caps would fence every walker below the very depths
+        # the probe exists to reach.
+        top = len(_LADDER) - 1
+        protocol, marr, tarr = _bind_protocol(
+            binding, settings, net_cap << top, timer_cap + 2 * top,
+            with_goals=False)
+        search = TensorSearch(protocol, chunk=1)
+        search.set_runtime_masks(marr, tarr)
+        root, history = binding.derive_root(search, state)
+        rel = (settings.max_depth - state.depth
+               if settings.depth_limited() else 192)
+        if rel <= 0:
+            return None
+        budget = 10.0 * GlobalSettings.time_scale
+        if settings.max_time_secs is not None:
+            budget = min(budget, settings.max_time_secs / 3
+                         * GlobalSettings.time_scale)
+        outcome = search.random_rollouts(
+            n_walkers=128, n_steps=min(rel, 192), seed=0,
+            initial=(jax.tree.map(jax.numpy.asarray, root)
+                     if root is not None else None),
+            max_secs=budget)
+    except CapacityOverflow:
+        return None, time.time() - t_probe
+    if outcome.end_condition in ("INVARIANT_VIOLATED",
+                                 "EXCEPTION_THROWN"):
+        return (search, outcome, history), time.time() - t_probe
+    return None, time.time() - t_probe
+
+
+def tensor_bfs(initial_state, settings=None, _probe_first=False):
     """The tensor-strategy analog of search.bfs (Search.java:390-402 via
     SURVEY §8.1): same inputs, same SearchResults contract."""
     from dslabs_tpu.search.results import EndCondition, SearchResults
@@ -490,8 +553,24 @@ def tensor_bfs(initial_state, settings=None):
 
     settings = settings if settings is not None else SearchSettings()
     binding = resolve_binding(initial_state)
-    search, outcome, history = _run_tensor(binding, settings,
-                                           initial_state)
+    trip = None
+    if _probe_first:
+        trip, probe_secs = _rollout_probe(binding, settings,
+                                          initial_state)
+        if trip is None and settings.max_time_secs is not None:
+            # The probe spends part of the SAME maxTime contract the
+            # object RandomDFS honours — deduct it from the BFS's
+            # budget (on a copy; the caller's settings are theirs).
+            import copy as _copy
+
+            settings = _copy.copy(settings)
+            settings.max_time_secs = max(
+                1.0, settings.max_time_secs - probe_secs)
+    if trip is not None:
+        search, outcome, history = trip
+    else:
+        search, outcome, history = _run_tensor(binding, settings,
+                                               initial_state)
     results = SearchResults(settings.invariants, settings.goals)
     results.discovered_count = outcome.unique_states
     end = outcome.end_condition
@@ -543,18 +622,11 @@ def tensor_bfs(initial_state, settings=None):
 
 
 def tensor_dfs(initial_state, settings=None):
-    """Tensor strategy for dfs call sites: a strict BFS under the same
-    settings.
-
-    KNOWN COVERAGE DIFFERENCE (ADVICE r4): this is NOT an exact
-    substitute for RandomDFS under a *time* budget — a random walk
-    reaches depth-d states in O(d) steps while BFS must exhaust every
-    shallower level first, so a deep, narrow violation can fall outside
-    the BFS time horizon that a lucky probe would hit.  In exchange BFS
-    is exhaustive at every depth it completes (no luck involved) and its
-    violations are minimal-depth.  Call sites that specifically need
-    deep probes keep the object RandomDFS (the default strategy for
-    dfs when no twin is bound); the depth-limited lab searches — every
-    dfs use in the reference suites has maxDepth set — are exactly the
-    budget shape where BFS dominates."""
-    return tensor_bfs(initial_state, settings)
+    """Tensor strategy for dfs call sites: a RANDOM-ROLLOUT deep probe
+    (engine.random_rollouts — RandomDFS's O(d) depth reach, restoring
+    the coverage the round-4 advisor flagged) followed by a strict BFS
+    under the same settings.  The probe's violations carry full
+    replayable traces through the same materialisation path; when it
+    finds nothing, BFS contributes what RandomDFS never could —
+    exhaustiveness at every level it completes."""
+    return tensor_bfs(initial_state, settings, _probe_first=True)

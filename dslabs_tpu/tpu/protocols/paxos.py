@@ -713,6 +713,16 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
         nodes = np.zeros((NW,), np.int32)
         for i in range(n):
             nodes[i * SW + 3] = 1  # slot_in = 1
+        if n == 1:
+            # A lone server self-elects SYNCHRONOUSLY at init
+            # (paxos.py:201-205: len(servers) == 1 -> _start_election,
+            # P1a/P1b self-delivered inline) — the object never spends
+            # an ElectionTimer event becoming leader, so neither may
+            # the twin (pre-fix, every singleton path was one event
+            # deeper than the object's, test_lab3_singleton_goal_parity).
+            nodes[0] = 1               # ballot (1, 0) encoded round*n+i
+            nodes[1] = 1               # leader
+            nodes[VOTES] = 1           # own permanent P1b (empty log)
         for c in range(NC):
             nodes[n * SW + c] = 1    # first command in flight
         return nodes
@@ -731,6 +741,12 @@ def make_paxos_protocol(n: int = 3, n_clients: int = 1, w: int = 1,
         recs = []
         for i in range(n):
             recs.append([i, T_ELECTION, ELECTION_MIN, ELECTION_MAX, 0])
+            if n == 1:
+                # The init self-election's leader setup arms the
+                # heartbeat (handle_P1b, paxos.py:317) — queue order
+                # [Election, Heartbeat], exactly the object root state.
+                recs.append([i, T_HEARTBEAT, HEARTBEAT_MS, HEARTBEAT_MS,
+                             1])
         for c in range(NC):
             recs.append([n + c, T_CLIENT, CLIENT_MS, CLIENT_MS, 1])
         return np.array(recs, np.int32)

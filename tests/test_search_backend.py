@@ -337,3 +337,29 @@ def test_lab1_infinite_workload_tensor(tensor_backend):
     assert len(worker.results) >= 1
     sent = worker.sent_commands[0]
     assert isinstance(sent, Put) and sent.key.startswith("client1-")
+
+
+def test_lab1_deep_probe_dfs(tensor_backend):
+    """The dfs-routed rollout probe (engine.random_rollouts via
+    backend._rollout_probe): a violation that only exists ~24 levels
+    deep — far past what a level-by-level search clears in this time
+    budget — must still be found, with a real replayed object state
+    (the round-4 advisor's RandomDFS depth-reach gap, closed)."""
+    from dslabs_tpu.labs.clientserver.kv_workload import kv_workload
+    from dslabs_tpu.search.search import dfs
+    from dslabs_tpu.testing.predicates import client_has_results
+    import tests.test_lab1 as L1
+
+    w = 10
+    state = L1._search_state(workload_factory=lambda: kv_workload(
+        [f"PUT:key{i}:v{i}" for i in range(1, w + 1)]))
+    settings = SearchSettings().max_time(45).set_max_depth(1000)
+    settings.add_invariant(
+        client_has_results(LocalAddress("client1"), w - 1).negate())
+    res = dfs(state, settings)
+    assert res.end_condition == EndCondition.INVARIANT_VIOLATED
+    bad = res.invariant_violating_state
+    assert bad is not None
+    assert len(bad.client_workers()[LocalAddress("client1")].results) \
+        >= w - 1
+    assert bad.depth >= 2 * (w - 1)       # deep, as constructed
