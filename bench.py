@@ -45,6 +45,7 @@ BASELINE_STATES_PER_MIN = 1e8
 DEADLINE_SECS = float(os.environ.get("DSLABS_BENCH_DEADLINE_SECS", 480.0))
 PREFLIGHT_CAP_SECS = 150.0   # import+client init+first tiny compile
 CALIBRATE_CAP_SECS = 240.0
+FALLBACK_CAP_SECS = 240.0    # wedged-TPU CPU-mesh fallback phase
 STRICT_CAP_SECS = 420.0      # child budget cap; parent adds kill slack
 BEAM_CAP_SECS = 300.0
 # Parent backstop beyond the child's budget.  Generous on purpose: the
@@ -120,6 +121,10 @@ def _preflight() -> dict:
     import jax
     import jax.numpy as jnp
 
+    if os.environ.get("DSLABS_BENCH_FAKE_WEDGE"):
+        # Test knob: simulate the BENCH_r04/r05 wedge shape so the
+        # cpu-fallback path is exercisable without a broken accelerator.
+        raise RuntimeError("fake TPU wedge (DSLABS_BENCH_FAKE_WEDGE)")
     _persistent_cache()
     t0 = time.time()
     devs = jax.devices()
@@ -271,6 +276,67 @@ def _run_strict(ev_budget, budget_secs: float) -> dict:
     }
 
 
+def _cpu_fallback(budget_secs: float) -> dict:
+    """Wedged-TPU fallback (ISSUE 1): a bounded strict lab1 BFS on the
+    CPU backend, measured TWICE on the identical protocol/depth — the
+    device-resident wave loop (engine.py ``run()``, this PR's hot path:
+    donated visited table + frontier, scalar-only syncs) and the legacy
+    host-dedup loop (``run_host()``, verbatim the pre-PR ``tensor_bfs``
+    single-chip hot loop) — so a wedged round lands a real, comparable
+    before/after states/min pair instead of 0.0.
+
+    On the CPU backend both loops share the same XLA expand (the
+    dominant cost — there is no device->host tunnel to win back here);
+    the pair is the honest apples-to-apples record, and the device
+    loop's structural win (scalar-only transfers, in-place donated
+    carry) shows up fully on the tunnelled TPU runtime."""
+    import dataclasses
+
+    os.environ["DSLABS_FORCE_CPU"] = "1"
+    _persistent_cache()
+
+    from dslabs_tpu.tpu.engine import TensorSearch
+    from dslabs_tpu.tpu.protocols.clientserver import \
+        make_clientserver_protocol
+
+    t_phase = time.time()
+    proto = dataclasses.replace(
+        make_clientserver_protocol(n_clients=3, w=4, net_cap=32),
+        goals={})
+    depth = int(os.environ.get("DSLABS_FALLBACK_DEPTH", "15"))
+
+    def run_one(use_host: bool) -> dict:
+        search = TensorSearch(proto, chunk=2048, frontier_cap=1 << 17,
+                              max_depth=2)
+        runner = search.run_host if use_host else search.run
+        t_c = time.time()
+        runner()            # warm-up: compile outside the measured window
+        compile_secs = time.time() - t_c
+        search.max_depth = depth
+        search.max_secs = max(20.0, budget_secs / 3)
+        t0 = time.time()
+        out = runner()
+        dt = max(time.time() - t0, 1e-9)
+        return {"value": out.unique_states / dt * 60.0,
+                "unique": out.unique_states,
+                "explored": out.states_explored,
+                "depth": out.depth, "end": out.end_condition,
+                "elapsed": round(dt, 2),
+                "compile_secs": round(compile_secs, 1)}
+
+    device = run_one(use_host=False)
+    legacy = run_one(use_host=True)
+    return {
+        "backend": "cpu-fallback",
+        "config": f"lab1-clientserver c3 w4 strict depth<={depth}",
+        **device,
+        "legacy": legacy,
+        "speedup_vs_legacy": round(
+            device["value"] / max(legacy["value"], 1e-9), 2),
+        "total_secs": round(time.time() - t_phase, 1),
+    }
+
+
 # ----------------------------------------------------------------- parent
 
 def _sub(args, child_budget: float, label: str):
@@ -395,6 +461,27 @@ def main() -> None:
         result["error"] = (
             "TPU runtime wedged or unreachable: pre-flight 256x256 "
             f"matmul failed ({pf_err})")
+        # ---- wedged-TPU fallback: a bounded CPU bench run so the round
+        # still records a REAL states/min number, tagged cpu-fallback
+        # (BENCH_r04/r05 emitted 0.0 — three rounds without an official
+        # perf number).
+        fb, fb_err = _sub(
+            ["--cpu-fallback",
+             str(min(FALLBACK_CAP_SECS, max(_remaining() - 30, 60.0)))],
+            min(FALLBACK_CAP_SECS, max(_remaining() - 20, 60.0)),
+            "cpu-fallback")
+        if fb is not None:
+            result["backend"] = fb.get("backend", "cpu-fallback")
+            result["cpu_fallback"] = fb
+            result["metric"] = (
+                "lab1-clientserver strict BFS unique states/min "
+                "(device-resident single-chip loop, cpu-fallback)")
+            result["value"] = round(fb["value"], 1)
+            result["vs_baseline"] = round(
+                fb["value"] / BASELINE_STATES_PER_MIN, 6)
+        else:
+            result["error"] += f"; cpu-fallback failed: {fb_err}"
+        result["total_secs"] = round(time.time() - _T0, 1)
         _emit(result)
         return
     platform, n_dev = pf["platform"], pf["n_devices"]
@@ -502,6 +589,11 @@ if __name__ == "__main__":
         sys.exit(0)
     if len(sys.argv) >= 2 and sys.argv[1] == "--calibrate":
         print(json.dumps(_calibrate()))
+        sys.exit(0)
+    if len(sys.argv) >= 2 and sys.argv[1] == "--cpu-fallback":
+        budget = (float(sys.argv[2]) if len(sys.argv) > 2
+                  else FALLBACK_CAP_SECS)
+        print(json.dumps(_cpu_fallback(budget)))
         sys.exit(0)
     if len(sys.argv) >= 2 and sys.argv[1] == "--preflight":
         print(json.dumps(_preflight()))

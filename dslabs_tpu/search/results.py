@@ -27,6 +27,13 @@ class EndCondition(enum.Enum):
 class SearchResults:
 
     discovered_count: int = 0
+    # Tensor-backend exploration stats (0 on the object checker): beam-
+    # style coverage truncations and visited-table treat-as-fresh
+    # overflows (see dslabs_tpu/tpu/visited.py's overflow contract) are
+    # surfaced here so callers can tell an exact exhaustion from a
+    # degraded one.
+    dropped: int = 0
+    visited_overflow: int = 0
 
     def __init__(self, invariants: List[StatePredicate],
                  goals: List[StatePredicate]):

@@ -573,6 +573,11 @@ def tensor_bfs(initial_state, settings=None, _probe_first=False):
                                                initial_state)
     results = SearchResults(settings.invariants, settings.goals)
     results.discovered_count = outcome.unique_states
+    # Degradation stats ride along so exhaust verdicts are auditable:
+    # dropped (beam truncation) and visited_overflow (table-full
+    # treat-as-fresh re-exploration) are both 0 on strict runs.
+    results.dropped = outcome.dropped
+    results.visited_overflow = outcome.visited_overflow
     end = outcome.end_condition
     by_name = {p.name: p for p in (settings.invariants + settings.goals)}
     if end == "GOAL_FOUND":

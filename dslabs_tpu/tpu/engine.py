@@ -8,7 +8,18 @@ program:
 
   frontier [N, ...]  --(enumerate events x vmapped transition)-->
   successors [N*E, ...] --(canonicalise + 128-bit fingerprint)-->
-  dedup (device sort-unique + host sorted-visited membership) --> frontier'
+  dedup (device sort-unique prefilter + device-resident visited hash
+  table, dslabs_tpu/tpu/visited.py) --> frontier'
+
+The whole wave cycle — expand, in-chunk sort-unique, visited-table
+insert, frontier compaction — stays on device: the carry (visited table
++ frontier) rides ``jax.jit(..., donate_argnums=0)`` so the table is
+updated in place, per-wave host transfers are SCALARS only (counters +
+flag counts; never ``[N, 4]`` fingerprint pulls), and the loop is
+double-buffered (wave k+1 dispatches before wave k's scalars are read).
+The original host-side ``sorted_member`` loop survives as
+:meth:`TensorSearch.run_host` — the parity oracle for tests and the
+trace-recording path (per-level event spills are host-side by nature).
 
 Checker semantics reproduced exactly (SURVEY §7):
   * the network is a SET of fixed-width message records, kept in canonical
@@ -52,8 +63,21 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dslabs_tpu.tpu import visited as visited_mod
+
 __all__ = ["TensorProtocol", "TensorState", "TensorSearch", "SearchOutcome",
-           "CapacityOverflow", "SENTINEL", "drop_pending_messages"]
+           "CapacityOverflow", "SENTINEL", "drop_pending_messages",
+           "device_get"]
+
+
+def device_get(x) -> np.ndarray:
+    """The device->host readback funnel for the device-resident run loop.
+
+    Every transfer the wave loop performs goes through here so tests can
+    instrument it (monkeypatch) and assert the per-wave transfer
+    contract: scalars/short stat vectors only — never state rows or
+    ``[N, 4]`` fingerprint batches."""
+    return np.asarray(x)
 
 # Empty slots in the network / timer arrays hold SENTINEL in every lane, so
 # they sort after every real record and hash consistently.
@@ -177,6 +201,12 @@ class SearchOutcome:
     # constant-true lane predicates on the twin) on replayed OBJECT
     # states before trusting the exhaustion (ADVICE r4).
     samples: Optional[list] = None   # [root-first event-id list, ...]
+    # Visited-table overflow: keys whose probe exhausted (table
+    # effectively full) were treated as FRESH — sound (the state may be
+    # re-explored; nothing is ever silently dropped) but the unique
+    # count can then over-report re-explorations.  Strict engines raise
+    # instead; beam runs report the count here (ISSUE 1 contract).
+    visited_overflow: int = 0
 
 
 # ----------------------------------------------------------------- hashing
@@ -605,13 +635,26 @@ class TensorSearch:
                  max_secs: Optional[float] = None,
                  record_trace: bool = False,
                  in_chunk_dedup: bool = True,
-                 ev_budget: Optional[int] = None):
+                 ev_budget: Optional[int] = None,
+                 visited_cap: int = 1 << 20,
+                 strict: bool = True,
+                 use_host_visited: bool = False):
         self.p = protocol
         self.frontier_cap = frontier_cap
         self.chunk = chunk
         self.max_depth = max_depth
         self.max_secs = max_secs
         self.record_trace = record_trace
+        # Device-resident dedup (run()): capacity of the open-addressing
+        # visited table (power of two; ~16 bytes/slot) and the overflow
+        # policy — strict raises on a table-full (unique counts must be
+        # exact), non-strict degrades to treat-as-fresh and reports the
+        # count via SearchOutcome.visited_overflow.  use_host_visited
+        # forces the legacy host sorted_member loop (the parity oracle).
+        visited_mod.check_cap(visited_cap)
+        self.visited_cap = visited_cap
+        self.strict = strict
+        self.use_host_visited = use_host_visited
         # Occupancy-compacted event enumeration: expand only each state's
         # VALID events (occupied messages + deliverable timers), packed
         # into per-KIND pair-slot tables — message pairs run only the
@@ -656,6 +699,15 @@ class TensorSearch:
         # record_trace is set; consumed by tpu/trace.py.
         self._levels: List[dict] = []
         self._expand = jax.jit(self._expand_chunk)
+        # Terminal-flag order = checkState order (Search.java:162-231):
+        # exception strictly first, then invariants, then goals.  Shared
+        # by the device-resident wave loop and the sharded driver.
+        self._flag_names = (["exc"]
+                            + [f"inv:{n}" for n in protocol.invariants]
+                            + [f"goal:{n}" for n in protocol.goals])
+        # Jitted device-loop programs, keyed by frontier-buffer capacity
+        # (the buffer grows geometrically on overflow — see _run_device).
+        self._dev_progs: Dict[int, tuple] = {}
 
     # ------------------------------------------------------------- plumbing
 
@@ -929,7 +981,8 @@ class TensorSearch:
         return msg_ids, tmr_ids, m_rem + t_rem
 
     def _expand_chunk(self, chunk_rows: jnp.ndarray,
-                      chunk_valid: jnp.ndarray, ev_pass=0, masks=None):
+                      chunk_valid: jnp.ndarray, ev_pass=0, masks=None,
+                      dedup: Optional[bool] = None):
         """[C, lanes] chunk rows -> successor rows + fingerprints + masks
         + flags.
 
@@ -1021,7 +1074,7 @@ class TensorSearch:
         if stop == "fp":
             return _cut(fp, valids)
 
-        if self._in_chunk_dedup:
+        if self._in_chunk_dedup if dedup is None else dedup:
             # In-chunk sort-unique on device: first occurrence of each
             # 128-bit key among valid rows (invalid rows sort last and are
             # never unique).  Cuts host dedup work before any readback.
@@ -1296,7 +1349,25 @@ class TensorSearch:
         state — the staged-search pattern (PaxosTest.java:886-1096):
         extract a goal state, change the settings masks
         (``dataclasses.replace(protocol, deliver_message=...)``), and
-        search onward from it."""
+        search onward from it.
+
+        Dispatch: the device-resident wave loop (:meth:`_run_device` —
+        visited table + frontier as donated device buffers, scalar-only
+        per-wave host transfers) unless trace recording or
+        ``use_host_visited`` demand the legacy host-dedup loop
+        (:meth:`run_host`, the parity oracle — trace mode spills
+        per-level event tables to the host by design)."""
+        if self.record_trace or self.use_host_visited:
+            return self.run_host(check_initial, initial)
+        return self._run_device(check_initial, initial)
+
+    def run_host(self, check_initial: bool = True,
+                 initial: Optional[dict] = None) -> SearchOutcome:
+        """The legacy host-dedup BFS: device expand + in-chunk sort-unique,
+        host ``sorted_member`` visited membership.  Kept as (a) the parity
+        oracle the device-table loop is tested against and (b) the trace-
+        recording path (per-level (parent, event) spills are host-side).
+        Same contract as :meth:`run`."""
         import time
         t0 = time.time()
         state = (jax.tree.map(jnp.asarray, initial) if initial is not None
@@ -1307,6 +1378,9 @@ class TensorSearch:
         self._trace_root = jax.tree.map(np.asarray, state)
         fp0 = np.asarray(state_fingerprints(state))
         visited = host_keys(fp0)
+        # Diagnostic stash: the parity tests compare this loop's exact
+        # visited SET against the device table's extracted keys.
+        self._host_visited = visited
         explored = 0
         depth = 0
         self._levels = []
@@ -1422,6 +1496,7 @@ class TensorSearch:
                 mh2 = np.concatenate([visited[1], h2[nk][no]])
                 mo = np.lexsort((mh2, mh1))
                 visited = (mh1[mo], mh2[mo])
+                self._host_visited = visited
 
             expand = fresh & ~pruned
             if not expand.any():
@@ -1444,3 +1519,333 @@ class TensorSearch:
 
         return SearchOutcome("SPACE_EXHAUSTED", explored, len(visited[0]),
                              depth, 0.0)
+
+    # ------------------------------------------- device-resident wave loop
+
+    def _build_dev_step(self, cap: int):
+        """One wave step over frontier chunk ``j``: expand -> in-chunk
+        sort-unique -> visited-table insert -> frontier-compact append,
+        all on device.  The carry is DONATED (run() jits with
+        donate_argnums=0), so the table and frontier update in place
+        instead of reallocating per wave."""
+        p = self.p
+        C = self.chunk
+        lanes = self.lanes
+
+        def step(carry, masks):
+            cur, cur_n = carry["cur"], carry["cur_n"][0]
+            j = carry["j"][0]
+            start = j * C
+            rows_chunk = jax.lax.dynamic_slice(cur, (start, 0), (C, lanes))
+            valid = (start + jnp.arange(C)) < cur_n
+            ev_pass = carry["evp"][0]
+            # dedup=False: the visited table below is the dedup
+            # authority and resolves in-batch duplicates natively (the
+            # per-bucket reservation admits exactly one copy), so the
+            # in-chunk sort-unique prefilter is redundant work here —
+            # same ~60% chunk-step saving the sharded single-device
+            # path measured.  run_host keeps the prefilter (its host
+            # merge requires batch-unique keys).
+            (rows, valids, fp, unique, overflow, ev_rem, _event_ids,
+             flags) = self._expand_chunk(rows_chunk, valid, ev_pass,
+                                         masks, dedup=False)
+            # Event-window spill (round-4 semantics): valid events past
+            # this pass's window re-step the SAME chunk at the next
+            # window before j advances — a finite ev_budget costs extra
+            # passes, never coverage.
+            spill = ev_rem > 0
+            j_next = carry["j"] + jnp.where(spill, 0, 1)
+            evp_next = jnp.where(spill, carry["evp"] + 1, 0)
+
+            # ---- terminal flags, checkState order (exception first);
+            # first-hit successor row kept per flag.
+            hit_list = [valids & (rows[:, -1] != 0)]
+            for n in p.invariants:
+                hit_list.append(valids & ~flags[f"inv:{n}"])
+            for n in p.goals:
+                hit_list.append(flags[f"goal:{n}"])
+            hits = jnp.stack(hit_list)                   # [nf, C*B]
+            cnts = jnp.sum(hits, axis=1).astype(jnp.int32)
+            idxs = jnp.argmax(hits, axis=1)
+            fresh_flag = (carry["flag_cnt"] == 0) & (cnts > 0)
+            flag_rows = jnp.where(fresh_flag[:, None], rows[idxs],
+                                  carry["flag_rows"])
+
+            pruned = rows[:, -1] != 0        # exception states terminal
+            for n in p.prunes:
+                pruned = pruned | flags[f"prune:{n}"]
+
+            # ---- device-table dedup (the authority): in-chunk firsts go
+            # through the shared open-addressing table; unresolved keys
+            # (probe exhausted = table effectively full) are treated as
+            # FRESH — sound, may re-explore, never a silent drop — and
+            # counted into vis_over (fatal in strict mode at the sync).
+            table, inserted, unresolved = visited_mod.insert(
+                carry["visited"], fp, unique)
+            fresh = inserted | unresolved
+
+            # ---- frontier-compact append of fresh, un-pruned successors
+            sel = fresh & ~pruned
+            spos = jnp.cumsum(sel) - 1
+            nxt_n = carry["nxt_n"][0]
+            sdst = jnp.where(sel & (nxt_n + spos < cap), nxt_n + spos, cap)
+            nxt = carry["nxt"].at[sdst].set(rows)
+            n_sel = jnp.sum(sel).astype(jnp.int32)
+            f_drop = jnp.maximum(nxt_n + n_sel - cap, 0)
+            n_sel = n_sel - f_drop
+
+            out = {
+                "cur": cur, "cur_n": carry["cur_n"],
+                "j": j_next, "evp": evp_next,
+                "nxt": nxt, "nxt_n": carry["nxt_n"].at[0].add(n_sel),
+                "visited": table,
+                "vis_n": carry["vis_n"].at[0].add(
+                    jnp.sum(inserted).astype(jnp.int32)),
+                "explored": carry["explored"].at[0].add(
+                    jnp.sum(valids).astype(jnp.int32)),
+                "overflow": carry["overflow"].at[0].add(overflow),
+                "vis_over": carry["vis_over"].at[0].add(
+                    jnp.sum(unresolved).astype(jnp.int32)),
+                "f_drop": carry["f_drop"].at[0].add(f_drop),
+                "flag_cnt": carry["flag_cnt"] + cnts,
+                "flag_rows": flag_rows,
+            }
+            # The per-wave scalar stats ride along with every step (the
+            # ONLY recurring device->host transfer of the device loop:
+            # [explored, overflow, vis_over, f_drop, vis_n, nxt_n, j] ++
+            # flag counts) — computed in-program so the sync needs no
+            # separate dispatch, and only the LAST chunk's vector of a
+            # wave is actually pulled to the host.
+            stats = jnp.concatenate([
+                jnp.asarray([out["explored"][0], out["overflow"][0],
+                             out["vis_over"][0], out["f_drop"][0],
+                             out["vis_n"][0], out["nxt_n"][0],
+                             out["j"][0]], jnp.int32),
+                out["flag_cnt"].astype(jnp.int32)])
+            return out, stats
+
+        return step
+
+    def _build_dev_promote(self, cap: int):
+        """Between-wave frontier promotion (nxt -> cur), donated like the
+        step so the buffers swap in place."""
+        lanes = self.lanes
+
+        def promote(carry):
+            out = dict(carry)
+            out["cur"] = carry["nxt"][:cap]
+            out["cur_n"] = carry["nxt_n"]
+            out["nxt"] = jnp.zeros((cap + 1, lanes), jnp.int32)
+            out["nxt_n"] = jnp.zeros((1,), jnp.int32)
+            out["j"] = jnp.zeros((1,), jnp.int32)
+            out["evp"] = jnp.zeros((1,), jnp.int32)
+            return out
+
+        return promote
+
+    def _build_dev_init(self, cap: int):
+        """Carry built ON DEVICE inside one jitted program: only the root
+        row crosses the host boundary; the root key is inserted through
+        the same shared table code the waves use."""
+        lanes = self.lanes
+        V = self.visited_cap
+        nf = len(self._flag_names)
+
+        def build(row0):
+            from dslabs_tpu.tpu.kernels import fingerprint_rows
+
+            fp0 = fingerprint_rows(row0)                 # [1, 4]
+            table, _, _ = visited_mod.insert(
+                visited_mod.empty_table(V), fp0, jnp.ones((1,), bool))
+            return {
+                "cur": jnp.zeros((cap, lanes), jnp.int32).at[0].set(
+                    row0[0]),
+                "cur_n": jnp.ones((1,), jnp.int32),
+                "j": jnp.zeros((1,), jnp.int32),
+                "evp": jnp.zeros((1,), jnp.int32),
+                "nxt": jnp.zeros((cap + 1, lanes), jnp.int32),
+                "nxt_n": jnp.zeros((1,), jnp.int32),
+                "visited": table,
+                "vis_n": jnp.ones((1,), jnp.int32),
+                "explored": jnp.zeros((1,), jnp.int32),
+                "overflow": jnp.zeros((1,), jnp.int32),
+                "vis_over": jnp.zeros((1,), jnp.int32),
+                "f_drop": jnp.zeros((1,), jnp.int32),
+                "flag_cnt": jnp.zeros((nf,), jnp.int32),
+                "flag_rows": jnp.zeros((nf, lanes), jnp.int32),
+            }
+
+        return build
+
+    def _dev_programs(self, cap: int):
+        progs = self._dev_progs.get(cap)
+        if progs is None:
+            progs = (jax.jit(self._build_dev_step(cap), donate_argnums=0),
+                     jax.jit(self._build_dev_promote(cap),
+                             donate_argnums=0),
+                     jax.jit(self._build_dev_init(cap)))
+            self._dev_progs[cap] = progs
+        return progs
+
+    def _dev_terminal(self, carry, flag_counts, explored, vis_n, depth,
+                      t0, vis_over) -> SearchOutcome:
+        """Resolve the first terminal flag (checkState order).  The flag
+        rows are the one non-scalar readback of the device loop — paid
+        once per RUN, only when a terminal state actually fired."""
+        import time
+
+        rows = device_get(carry["flag_rows"])
+        for fi, fname in enumerate(self._flag_names):
+            if flag_counts[fi] <= 0:
+                continue
+            st = jax.tree.map(np.asarray,
+                              self.unflatten_rows(rows[fi][None]))
+            elapsed = time.time() - t0
+            if fname == "exc":
+                return SearchOutcome(
+                    "EXCEPTION_THROWN", explored, vis_n, depth, elapsed,
+                    violating_state=st, exception_code=int(st["exc"][0]),
+                    visited_overflow=vis_over)
+            kind, pname = fname.split(":", 1)
+            if kind == "inv":
+                return SearchOutcome(
+                    "INVARIANT_VIOLATED", explored, vis_n, depth, elapsed,
+                    violating_state=st, predicate_name=pname,
+                    visited_overflow=vis_over)
+            return SearchOutcome(
+                "GOAL_FOUND", explored, vis_n, depth, elapsed,
+                goal_state=st, predicate_name=pname,
+                visited_overflow=vis_over)
+        raise AssertionError("flag counts fired without a flag name")
+
+    def _run_device(self, check_initial: bool = True,
+                    initial: Optional[dict] = None) -> SearchOutcome:
+        """The device-resident BFS.  Frontier + visited table live in
+        device buffers donated through every wave; host transfers are the
+        per-wave stats scalars.  The frontier buffer starts small and
+        grows geometrically on overflow (deterministic restart — same
+        verdict, amortised cost), up to ``frontier_cap``; overflowing AT
+        the cap is the legacy CAPACITY_EXHAUSTED."""
+        import time
+
+        t0 = time.time()
+        state = (jax.tree.map(jnp.asarray, initial) if initial is not None
+                 else self.initial_state())
+        self._trace_root = jax.tree.map(np.asarray, state)
+        if check_initial:
+            out = self._check_initial(state, t0)
+            if out is not None:
+                return out
+        C = self.chunk
+        user_cap = -(-self.frontier_cap // C) * C
+        # Start the frontier buffer SMALL (2k rows): the per-wave promote
+        # zero+copy scales with the buffer, and most searches never need
+        # more; the ones that do pay one bounded deterministic restart
+        # per x8 growth rung.
+        cap = min(user_cap, -(-max(C, 1 << 11) // C) * C)
+        while True:
+            out = self._device_attempt(state, cap, user_cap, t0)
+            if out is not None:
+                return out
+            cap = min(cap * 8, user_cap)
+
+    def _device_attempt(self, state, cap: int, user_cap: int,
+                        t0) -> Optional[SearchOutcome]:
+        """One run at a fixed frontier-buffer capacity; None = frontier
+        overflowed below the user cap (caller grows and restarts)."""
+        import time
+
+        p = self.p
+        C = self.chunk
+        step, promote, init = self._dev_programs(cap)
+        rt = getattr(self, "_rt_masks", None)
+        carry = init(flatten_state(state))
+        sdev = None        # stats vector of the latest dispatched step
+        # With a finite ev_budget a chunk can spill extra window passes,
+        # holding j back — then the sync must watch j and re-dispatch,
+        # which precludes the pre-sync speculative dispatch below.
+        spill = (self._ev_msg < p.net_cap
+                 or self._ev_tmr < p.n_nodes * p.timer_cap)
+        depth = 0
+        n_chunks = 1
+        spec = 0           # chunks of the current wave already dispatched
+        last = (0, 1, 0)   # (explored, unique, vis_over) at the last sync
+        while True:
+            if (self.max_secs is not None
+                    and time.time() - t0 > self.max_secs):
+                return SearchOutcome(
+                    "TIME_EXHAUSTED", last[0], last[1], depth,
+                    time.time() - t0, visited_overflow=last[2])
+            if self.max_depth is not None and depth >= self.max_depth:
+                return SearchOutcome(
+                    "DEPTH_EXHAUSTED", last[0], last[1], depth,
+                    time.time() - t0, visited_overflow=last[2])
+            depth += 1
+            for _ in range(n_chunks - spec):
+                carry, sdev = step(carry, rt)
+            if spill:
+                while True:
+                    s = device_get(sdev)
+                    if int(s[6]) >= n_chunks:
+                        break
+                    for _ in range(n_chunks - int(s[6])):
+                        carry, sdev = step(carry, rt)
+                carry = promote(carry)
+                spec = 0
+            else:
+                # Double-buffering: the next wave's promotion AND its
+                # first chunk dispatch BEFORE this wave's scalars are
+                # read, so host bookkeeping overlaps device compute.  A
+                # terminal/empty wave makes the speculative chunk a
+                # no-op (flags keep first-hit; empty frontier expands
+                # nothing) — the readback below still reports wave k.
+                # Single-chunk waves skip the speculation: the chunk
+                # would BE the whole next wave, and on termination it is
+                # a full expand wasted (the measured 20% overhead on
+                # small search spaces).  When the wave's last chunk WAS
+                # last wave's speculative dispatch (n_chunks == spec),
+                # its stats vector is already in hand.
+                wave_stats = sdev
+                carry = promote(carry)
+                if n_chunks > 1:
+                    carry, sdev = step(carry, rt)
+                    spec = 1
+                else:
+                    spec = 0
+                s = device_get(wave_stats)
+            (explored, overflow, vis_over, f_drop, vis_n,
+             nxt_n) = (int(x) for x in s[:6])
+            flag_counts = np.asarray(s[7:])
+            if overflow:
+                raise CapacityOverflow(
+                    f"{p.name}: net_cap={p.net_cap}, timer_cap="
+                    f"{p.timer_cap}, or max_live_sends={p.max_live_sends} "
+                    f"overflowed at depth {depth} ({overflow} drops); "
+                    "raise the caps")
+            if vis_over and self.strict:
+                raise CapacityOverflow(
+                    f"{p.name}: visited table full at depth {depth} "
+                    f"({vis_over} unresolved keys, cap "
+                    f"{self.visited_cap}); raise visited_cap or run "
+                    "strict=False for sound treat-as-fresh degradation")
+            if self.strict and vis_n > 3 * self.visited_cap // 4:
+                raise CapacityOverflow(
+                    f"{p.name}: visited table > 75% full "
+                    f"({vis_n}/{self.visited_cap}) at depth {depth}; "
+                    "raise visited_cap")
+            last = (explored, vis_n, vis_over)
+            self._last_dev_carry = carry
+            if flag_counts.any():
+                return self._dev_terminal(carry, flag_counts, explored,
+                                          vis_n, depth, t0, vis_over)
+            if f_drop:
+                if cap < user_cap:
+                    return None            # grow the buffer and restart
+                return SearchOutcome(
+                    "CAPACITY_EXHAUSTED", explored, vis_n, depth,
+                    time.time() - t0, visited_overflow=vis_over)
+            if nxt_n == 0:
+                return SearchOutcome(
+                    "SPACE_EXHAUSTED", explored, vis_n, depth,
+                    time.time() - t0, visited_overflow=vis_over)
+            n_chunks = -(-nxt_n // C)

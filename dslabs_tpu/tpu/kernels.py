@@ -32,6 +32,23 @@ __all__ = ["fingerprint_rows"]
 
 TILE = 128
 
+# DSLABS_PALLAS_FP opt-in, resolved once: fingerprint_rows is traced
+# inside the engine's hottest jitted programs (the expand pipeline and
+# the device-resident dedup loop's carry initialiser — the fingerprints
+# it emits feed dslabs_tpu/tpu/visited.py's hash table directly on
+# device), and the mode decision must be stable across retraces.
+_PALLAS_OPT_IN: bool = None
+
+
+def _pallas_opt_in() -> bool:
+    global _PALLAS_OPT_IN
+    if _PALLAS_OPT_IN is None:
+        import os
+
+        _PALLAS_OPT_IN = os.environ.get(
+            "DSLABS_PALLAS_FP", "").lower() in ("1", "true", "yes")
+    return _PALLAS_OPT_IN
+
 
 def _kernel(in_ref, out_ref):
     # The engine's mixing math (single source of truth: _fingerprint32),
@@ -72,16 +89,12 @@ def fingerprint_rows(flat: jnp.ndarray, mode: str = "auto") -> jnp.ndarray:
     mode: "auto" (fused jnp unless DSLABS_PALLAS_FP=1 on TPU — see the
     module docstring for the measurement behind the default), "jnp",
     "pallas", or "interpret" (Pallas interpreter — CPU parity tests)."""
-    import os
-
     from dslabs_tpu.tpu.engine import row_fingerprints
 
     b = flat.shape[0]
     if mode == "auto":
         on_tpu = jax.default_backend() == "tpu"
-        opt_in = os.environ.get("DSLABS_PALLAS_FP", "").lower() in (
-            "1", "true", "yes")
-        mode = "pallas" if on_tpu and opt_in else "jnp"
+        mode = "pallas" if on_tpu and _pallas_opt_in() else "jnp"
     if mode == "jnp":
         return row_fingerprints(flat)
     pad = (-b) % TILE

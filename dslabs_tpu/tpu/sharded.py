@@ -46,6 +46,7 @@ import numpy as np
 from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dslabs_tpu.tpu import visited as visited_mod
 from dslabs_tpu.tpu.engine import (CapacityOverflow, SearchOutcome,
                                    TensorProtocol, TensorSearch,
                                    flatten_state, row_fingerprints,
@@ -54,11 +55,11 @@ from dslabs_tpu.tpu.engine import (CapacityOverflow, SearchOutcome,
 __all__ = ["ShardedTensorSearch", "make_mesh"]
 
 OVERFLOW_FACTOR = 2
-MAXU32 = np.uint32(0xFFFFFFFF)
-# Slots per visited-table bucket: the probe loop reads whole buckets (one
-# aligned 128-byte line) and _init_carry must place the root key with the
-# same addressing.
-BKT = 8
+# The visited hash table itself lives in dslabs_tpu/tpu/visited.py — ONE
+# implementation shared with the single-device engine's device-resident
+# wave loop (engine.py _run_device).
+MAXU32 = visited_mod.MAXU32
+BKT = visited_mod.BKT
 # Dev: print per-level wall time / chunk rate from run().
 _LEVEL_TIMING = bool(os.environ.get("DSLABS_LEVEL_TIMING"))
 
@@ -128,8 +129,10 @@ class ShardedTensorSearch(TensorSearch):
         # must be exact.  strict=False (throughput benches): routing-bucket
         # and frontier-cap drops truncate expansion coverage beam-style and
         # are reported via SearchOutcome.dropped; semantic overflow
-        # (net/timer caps, visited shard) stays fatal either way.
-        self.strict = strict
+        # (net/timer caps) stays fatal either way.  A FULL visited table
+        # degrades to treat-as-fresh (visited.py contract): fatal in
+        # strict (unique counts must be exact), counted and reported via
+        # SearchOutcome.visited_overflow in beam.
         # F must divide evenly by the chunk (chunk-loop slicing) AND the
         # device count (level-rebalance shares); pad to the lcm so neither
         # pad breaks the other's invariant.
@@ -163,17 +166,16 @@ class ShardedTensorSearch(TensorSearch):
                          chunk=chunk_per_device, max_depth=max_depth,
                          max_secs=max_secs,
                          in_chunk_dedup=strict and self.n_devices > 1,
-                         ev_budget=ev_budget, record_trace=record_trace)
+                         ev_budget=ev_budget, record_trace=record_trace,
+                         visited_cap=visited_cap, strict=strict)
         # Trace mode: each level spills (child_fp, parent_fp, event_id)
         # for every appended successor; reconstruction walks fingerprints
         # back to the root on the HOST (fps are stable identities, so the
         # level rebalance needs no permutation bookkeeping) and replays
         # the grid event ids on the object twin via tpu/trace.py.
         self._fp_map = {}                  # child fp bytes -> (parent, ev)
-        p = protocol
-        self._flag_names = (["exc"]
-                            + [f"inv:{n}" for n in p.invariants]
-                            + [f"goal:{n}" for n in p.goals])
+        # _flag_names is set by super().__init__ (shared with the
+        # single-device device-resident loop).
         self._chunk_step = jax.jit(self._build_chunk_step(),
                                    donate_argnums=0)
         self._finish_level = jax.jit(self._build_finish(), donate_argnums=0)
@@ -188,6 +190,7 @@ class ShardedTensorSearch(TensorSearch):
                 jnp.asarray([
                     jnp.sum(carry["overflow"]),
                     jnp.sum(carry["drops"]),
+                    jnp.sum(carry["vis_over"]),
                     jnp.sum(carry["explored"]),
                     jnp.max(carry["vis_n"]),
                     jnp.sum(carry["vis_n"]),
@@ -347,130 +350,31 @@ class ShardedTensorSearch(TensorSearch):
             if stop_after == "a2a":
                 return _stopped(carry, rows, recv_keys, recv_valid)
 
-            # ---- owner-side dedup via an open-addressing hash table in
-            # HBM ([V+1, 4] uint32, viewed as [V/8, 8]-slot buckets, last
-            # row = scatter dump).  Membership AND insert happen in one
-            # bounded probe loop; each iteration reads a key's WHOLE
-            # bucket (one aligned 128-byte line), checks membership across
-            # its 8 slots, and claims the first empty slot.
-            #
+            # ---- owner-side dedup via the SHARED open-addressing hash
+            # table (dslabs_tpu/tpu/visited.py — one implementation for
+            # this driver and the single-device device-resident loop).
             # The recv batch may hold the same key several times (from
             # different producers, or in-chunk duplicates when the
-            # prefilter is off).  Claim conflicts — equal keys AND
-            # distinct keys hashing to one bucket — are serialised by a
-            # per-bucket RESERVATION: each iteration, only the
-            # minimum-index contender of a bucket writes (.at[].min
-            # scatter + re-gather), so exactly one copy of a key ever
-            # inserts and no lexsort of the batch is needed (the previous
-            # sort-based in-batch dedup was ~60% of a loaded chunk step).
-            visited = carry["visited"]
-            # Real keys never equal the EMPTY marker (all four lanes MAX):
-            # remap the 2^-128-probability collider.
-            all_max = jnp.all(recv_keys == MAXU32, axis=1)
-            skeys = recv_keys.at[:, 3].set(
-                jnp.where(all_max & recv_valid, MAXU32 - 1, recv_keys[:, 3]))
-            cand = recv_valid
-
-            # Bucket index from lane 2 (b_hi), NOT lane 0: ownership
-            # routing already fixed lane0 ≡ device (mod D), so a
-            # lane0-derived home bucket would cluster every owned key
-            # into 1/D of the table.
-            VB = V // BKT
-            slot0 = (skeys[:, 2] & jnp.uint32(VB - 1)).astype(jnp.int32)
-            pstep = (skeys[:, 1] | jnp.uint32(1)).astype(jnp.uint32)
-            # Reservations go through a small HASHED table (bkt_i mod RT)
-            # instead of a per-bucket [VB+1] array: the full-size array
-            # cost a multi-MB init + scatter every iteration.  A hash
-            # collision between two DISTINCT buckets just makes one
-            # contender retry next iteration — correctness is unchanged
-            # (a winner must still re-win its own cell).
-            RT = 1 << max((rb * 2 - 1).bit_length(), 10)
-            # After ~2 full-batch iterations only a few percent of keys
-            # remain (deep bucket chains); compact those into a T-slot
-            # tail so late iterations stop re-scanning the whole batch —
-            # the measured high-load pathology (chunk step 90 -> 148 ms
-            # as the table filled).
-            T = max(rb // 8, 256)
-
-            def _probe_iter(table, keys, bkt_i, ps, unres, idx):
-                """One probe iteration over any batch (idx = each row's
-                identity for reservation tie-breaks; rows with
-                unres=False are inert)."""
-                nb_rows = keys.shape[0]
-                bkt = table[:V].reshape(VB, BKT, 4)[bkt_i]
-                eq = jnp.any(
-                    jnp.all(bkt == keys[:, None, :], axis=2), axis=1)
-                empty = jnp.all(bkt == MAXU32, axis=2)
-                has_empty = jnp.any(empty, axis=1)
-                first_empty = jnp.argmax(empty, axis=1)
-                want = unres & ~eq & has_empty
-                rcell = bkt_i & (RT - 1)
-                res = jnp.full((RT + 1,), rb, jnp.int32).at[
-                    jnp.where(want, rcell, RT)].min(idx)
-                winner = want & (res[rcell] == idx)
-                dst = jnp.where(winner, bkt_i * BKT + first_empty, V)
-                table = table.at[dst].set(keys)
-                newly = eq | winner
-                # Losers re-read the SAME bucket next iteration (their
-                # key may now be present, or another empty slot
-                # remains); a FULL bucket advances by double-hash step.
-                nb = (bkt_i.astype(jnp.uint32) + ps).astype(
-                    jnp.int32) & (VB - 1)
-                bkt_i = jnp.where(unres & ~newly & ~has_empty, nb, bkt_i)
-                return table, bkt_i, newly & unres, winner & unres
-
-            ridx = jnp.arange(rb, dtype=jnp.int32)
-
-            def full_cond(st):
-                _, _, resolved, _, it = st
-                # ONE guaranteed full-batch iteration: below 50% table
-                # load the first bucket read resolves all but the
-                # full-bucket collisions, which fit the tail buffer.
-                return ((it < 1) | (jnp.sum(~resolved) > T)) & (
-                    it < 64) & jnp.any(~resolved)
-
-            def full_body(st):
-                table, bkt_i, resolved, fresh, it = st
-                table, bkt_i, newly, winner = _probe_iter(
-                    table, skeys, bkt_i, pstep, ~resolved, ridx)
-                return (table, bkt_i, resolved | newly, fresh | winner,
-                        it + 1)
-
-            table, bkt_i, resolved, fresh_s, _ = jax.lax.while_loop(
-                full_cond, full_body,
-                (visited, slot0, ~cand, jnp.zeros(rb, bool), jnp.int32(0)))
-
-            # ---- tail phase: compact the unresolved few into [T] slots
-            tail_idx = jnp.nonzero(~resolved, size=T, fill_value=rb)[0]
-            tclip = tail_idx.clip(0, rb - 1)
-            tval = tail_idx < rb
-            t_keys = skeys[tclip]
-            t_bkt = bkt_i[tclip]
-            t_ps = pstep[tclip]
-            t_id = jnp.arange(T, dtype=jnp.int32)
-
-            def tail_cond(st):
-                _, _, t_unres, _, it = st
-                return (it < 64) & jnp.any(t_unres)
-
-            def tail_body(st):
-                table, tb, t_unres, t_fresh, it = st
-                table, tb, newly, winner = _probe_iter(
-                    table, t_keys, tb, t_ps, t_unres, t_id)
-                return table, tb, t_unres & ~newly, t_fresh | winner, it + 1
-
-            table, _, t_unres, t_fresh, _ = jax.lax.while_loop(
-                tail_cond, tail_body,
-                (table, t_bkt, tval, jnp.zeros(T, bool), jnp.int32(0)))
-            resolved = resolved.at[tclip].max(tval & ~t_unres)
-            fresh_s = fresh_s.at[tclip].max(t_fresh & tval)
-            new_visited = table
-            # Probe exhaustion = table effectively full: semantic overflow
-            # (missed dedup would corrupt unique counts).
-            vis_drop = jnp.sum(~resolved).astype(jnp.int32)
-            n_fresh = jnp.sum(fresh_s).astype(jnp.int32)
+            # prefilter is off); the table's per-bucket reservation
+            # guarantees exactly one copy ever inserts.  Bucket index
+            # comes from lane 2 (b_hi), NOT lane 0: ownership routing
+            # already fixed lane0 ≡ device (mod D), so a lane0-derived
+            # home bucket would cluster every owned key into 1/D of the
+            # table (visited.py keys buckets by lane 2 for this reason).
+            #
+            # Probe exhaustion (table effectively full) leaves keys
+            # UNRESOLVED: per the visited.py contract they are treated
+            # as FRESH (sound — re-explored, never silently dropped) and
+            # counted into the vis_over flag, which _sync_checks raises
+            # on in strict mode and reports via
+            # SearchOutcome.visited_overflow in beam mode.
+            new_visited, ins_s, unres_s = visited_mod.insert(
+                carry["visited"], recv_keys, recv_valid)
+            fresh_s = ins_s | unres_s
+            vis_over = jnp.sum(unres_s).astype(jnp.int32)
+            n_fresh = jnp.sum(ins_s).astype(jnp.int32)
             if stop_after == "probe":
-                out = _stopped(carry, rows, fresh_s, resolved)
+                out = _stopped(carry, rows, fresh_s, unres_s)
                 out["visited"] = new_visited
                 return out
 
@@ -529,12 +433,15 @@ class ShardedTensorSearch(TensorSearch):
                 "vis_n": carry["vis_n"].at[0].add(n_fresh),
                 "explored": carry["explored"].at[0].add(
                     jnp.sum(valids).astype(jnp.int32)),
-                # Semantic overflow (net/timer caps, visited shard) corrupts
-                # state contents or unique counts — always fatal.  Capacity
-                # drops (routing bucket, frontier cap) only truncate
-                # *expansion coverage* (beam-style) and are tolerable when
-                # the caller opts in (bench throughput runs).
-                "overflow": carry["overflow"].at[0].add(overflow + vis_drop),
+                # Semantic overflow (net/timer caps) corrupts state
+                # contents — always fatal.  Capacity drops (routing
+                # bucket, frontier cap) only truncate *expansion
+                # coverage* (beam-style) and are tolerable when the
+                # caller opts in (bench throughput runs).  A full
+                # visited table is its own flag (vis_over): sound
+                # treat-as-fresh degradation, fatal only in strict.
+                "overflow": carry["overflow"].at[0].add(overflow),
+                "vis_over": carry["vis_over"].at[0].add(vis_over),
                 # ev_drops (valid events past the ev_budget) truncate
                 # expansion coverage like a routing/frontier drop: fatal
                 # in strict mode (via _sync_checks), beam-tolerable else.
@@ -626,8 +533,8 @@ class ShardedTensorSearch(TensorSearch):
     def _carry_specs(self):
         ax = self.axis
         keys = ["cur", "cur_n", "j", "evp", "noapp", "nxt", "nxt_n",
-                "visited", "vis_n", "explored", "overflow", "drops",
-                "flag_cnt", "flag_rows"]
+                "visited", "vis_n", "explored", "overflow", "vis_over",
+                "drops", "flag_cnt", "flag_rows"]
         if self.record_trace:
             keys += ["tmeta", "flag_meta"]
         return {k: P(ax) for k in keys}
@@ -645,13 +552,10 @@ class ShardedTensorSearch(TensorSearch):
         rows0 = flatten_state(state)                     # [1, lanes] device
         fp0 = np.asarray(state_fingerprints(state), np.uint32)  # [1, 4]
         owner = int(fp0[0, 0]) % D
-        key0 = fp0[0].copy()
-        if (key0 == np.uint32(MAXU32)).all():   # EMPTY-marker collider
-            key0[3] = np.uint32(MAXU32 - 1)
-        # The root key sits in slot 0 of its home BUCKET (the bucketised
-        # probe reads whole BKT-slot buckets keyed by lane 2 — must
-        # mirror _build_chunk_step's addressing).
-        home = (int(key0[2]) & (V // BKT - 1)) * BKT
+        key0 = visited_mod.host_sanitize_key(fp0[0])
+        # The root key sits in slot 0 of its home BUCKET — addressing
+        # mirrored from visited.py (bucket keyed by lane 2).
+        home = visited_mod.host_home_slot(key0, V)
         nf = len(self._flag_names)
         shard = NamedSharding(self.mesh, P(self.axis))
 
@@ -672,6 +576,7 @@ class ShardedTensorSearch(TensorSearch):
                 "vis_n": onehot_d.astype(jnp.int32),
                 "explored": jnp.zeros((D,), jnp.int32),
                 "overflow": jnp.zeros((D,), jnp.int32),
+                "vis_over": jnp.zeros((D,), jnp.int32),
                 "drops": jnp.zeros((D,), jnp.int32),
                 "flag_cnt": jnp.zeros((D * nf,), jnp.int32),
                 "flag_rows": jnp.zeros((D * nf, lanes), jnp.int32),
@@ -771,6 +676,7 @@ class ShardedTensorSearch(TensorSearch):
                 "vis_n": c["vis_n"] + 0,
                 "explored": c["explored"] + 0,
                 "overflow": c["overflow"] + 0,
+                "vis_over": c["vis_over"] + 0,
                 "drops": c["drops"] + 0,
                 "flag_cnt": c["flag_cnt"] + 0,
                 "flag_rows": c["flag_rows"] + 0,
@@ -778,7 +684,7 @@ class ShardedTensorSearch(TensorSearch):
 
         spec = self._carry_specs()
         keys = ["cur", "cur_n", "visited", "vis_n", "explored",
-                "overflow", "drops", "flag_cnt", "flag_rows"]
+                "overflow", "vis_over", "drops", "flag_cnt", "flag_rows"]
         snap_spec = {k: spec[k] for k in keys}
         fn = jax.jit(shard_map(local, mesh=self.mesh, in_specs=(spec,),
                                out_specs=snap_spec, check_rep=False))
@@ -822,9 +728,10 @@ class ShardedTensorSearch(TensorSearch):
             th.join()
 
     def _ckpt_signature(self) -> str:
-        # "v4": carry layout gained evp/noapp (round-3 dumps must not
-        # resume into a step program that expects the new keys).
-        return repr(("v4", self.p.name, self.f_cap, self.v_cap, self.cpd,
+        # "v5": carry layout gained vis_over (the shared visited.py
+        # table's treat-as-fresh overflow counter); older dumps must not
+        # resume into a step program that expects the new key.
+        return repr(("v5", self.p.name, self.f_cap, self.v_cap, self.cpd,
                      self.n_devices, self._ev_msg, self._ev_tmr,
                      self.strict, self.ev_spill, self.record_trace))
 
@@ -1031,7 +938,8 @@ class ShardedTensorSearch(TensorSearch):
                         else "SPACE_EXHAUSTED",
                         explored, vis_total, depth,
                         time.time() - t0, dropped=drops,
-                        samples=getattr(self, "_deep_samples", None))
+                        samples=getattr(self, "_deep_samples", None),
+                        visited_overflow=getattr(self, "_vis_over", 0))
                 if self.record_trace:
                     self._spill_tmeta(carry)
                 carry = self._finish_level(carry)
@@ -1043,7 +951,8 @@ class ShardedTensorSearch(TensorSearch):
             return SearchOutcome(
                 "SPACE_EXHAUSTED", explored, vis_total, depth,
                 time.time() - t0, dropped=drops,
-                samples=getattr(self, "_deep_samples", None))
+                samples=getattr(self, "_deep_samples", None),
+                visited_overflow=getattr(self, "_vis_over", 0))
 
     def _spill_tmeta(self, carry) -> None:
         """Fold this level's appended (child_fp, parent_fp, event) rows
@@ -1113,29 +1022,39 @@ class ShardedTensorSearch(TensorSearch):
         where j_done is the slowest device's completed-chunk count (the
         spill re-dispatch signal)."""
         s = np.asarray(self._stats(carry))
-        (overflow, drops, explored, vis_max, vis_total, nxt_max,
-         j_done) = (int(x) for x in s[:7])
-        flag_counts = s[7:]
+        (overflow, drops, vis_over, explored, vis_max, vis_total, nxt_max,
+         j_done) = (int(x) for x in s[:8])
+        flag_counts = s[8:]
+        # Running total for outcome plumbing (SearchOutcome
+        # .visited_overflow): keys the full table degraded to
+        # treat-as-fresh — sound, but unique counts may over-report.
+        self._vis_over = vis_over
         if overflow:
             raise CapacityOverflow(
                 f"{self.p.name}: {overflow} semantic drops at depth "
-                f"{depth} (net_cap/timer_cap or visited cap "
-                f"{self.v_cap}/device overflowed; raise the caps)")
+                f"{depth} (net_cap/timer_cap overflowed; raise the caps)")
         if drops and self.strict:
             raise CapacityOverflow(
                 f"{self.p.name}: {drops} capacity drops at depth "
                 f"{depth} (routing bucket or frontier cap "
                 f"{self.f_cap}/device; raise caps or run "
                 f"strict=False for beam-style truncation)")
-        # Terminal flags before the load-factor guard: a violation/goal
-        # found this level is a valid verdict even if the table is full.
+        # Terminal flags before the table guards: a violation/goal found
+        # this level is a valid verdict even if the table is full.
         if flag_counts.any():
             out = self._terminal_from_flags(carry, explored, vis_total,
                                             depth, t0)
             if out is not None:
                 out.dropped = drops
+                out.visited_overflow = vis_over
                 return out, explored, vis_total, drops, nxt_max, j_done
-        if vis_max > 3 * self.v_cap // 4:
+        if vis_over and self.strict:
+            raise CapacityOverflow(
+                f"{self.p.name}: visited hash table full at depth "
+                f"{depth} ({vis_over} unresolved keys, cap "
+                f"{self.v_cap}/device); raise visited_cap or run "
+                "strict=False for sound treat-as-fresh degradation")
+        if self.strict and vis_max > 3 * self.v_cap // 4:
             raise CapacityOverflow(
                 f"{self.p.name}: visited hash table > 75% full "
                 f"({vis_max}/{self.v_cap} per device) "
@@ -1149,4 +1068,5 @@ class ShardedTensorSearch(TensorSearch):
             int(np.asarray(carry["vis_n"]).sum()),
             depth, time.time() - t0,
             dropped=int(np.asarray(carry["drops"]).sum()),
-            samples=getattr(self, "_deep_samples", None))
+            samples=getattr(self, "_deep_samples", None),
+            visited_overflow=int(np.asarray(carry["vis_over"]).sum()))

@@ -1,0 +1,188 @@
+"""Device-resident visited set: an open-addressing hash table in HBM.
+
+ONE implementation of the 128-bit-key dedup table shared by both search
+drivers — the sharded engine's owner-side dedup (sharded.py) and the
+single-device engine's device-resident wave loop (engine.py run()).
+Extracted from sharded.py so the probe/insert machinery exists exactly
+once (hash compaction after Stern & Dill; the GPUexplore-style BFS table
+in PAPERS.md).
+
+Layout: ``[V + 1, 4]`` uint32 where V (a power of two) is the slot
+count, viewed as ``[V/8, 8]``-slot buckets so one probe iteration reads
+a whole aligned 128-byte line; the trailing row is the scatter dump for
+clipped writes.  EMPTY slots are all-MAX (a real all-MAX key — the
+2^-128 collider — is remapped by :func:`sanitize_keys`).  Membership and
+insert happen in one bounded probe loop; claim conflicts (equal keys or
+distinct keys hashing to one bucket) are serialised by a hashed
+per-bucket min-index reservation, so no sort of the batch is needed.
+After ~2 full-batch iterations only deep bucket chains remain; those are
+compacted into a small tail so late iterations stop re-scanning the
+whole batch (the measured high-load pathology in round 3).
+
+Overflow contract (ISSUE 1): a key whose probe exhausts (table
+effectively full) is **unresolved** — it is NOT inserted, and the caller
+must treat it as FRESH (sound: the state may be re-explored; never a
+silent drop) while surfacing the count as a visible overflow flag.
+Strict drivers raise :class:`~dslabs_tpu.tpu.engine.CapacityOverflow`
+on a nonzero count (exact unique counts would otherwise drift); beam
+drivers report it via ``SearchOutcome.visited_overflow``.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["BKT", "MAXU32", "empty_table", "sanitize_keys",
+           "host_sanitize_key", "host_home_slot", "insert"]
+
+# Slots per bucket: the probe loop reads whole buckets (one aligned
+# 128-byte line of 8 x 16-byte keys).
+BKT = 8
+MAXU32 = np.uint32(0xFFFFFFFF)
+
+
+def check_cap(cap: int) -> None:
+    if cap & (cap - 1) or cap < BKT:
+        raise ValueError(
+            f"visited cap must be a power of two >= {BKT} "
+            f"(hash-table slot arithmetic), got {cap}")
+
+
+def empty_table(cap: int) -> jnp.ndarray:
+    """A fresh ``[cap + 1, 4]`` all-EMPTY table (+1 scatter-dump row)."""
+    check_cap(cap)
+    return jnp.full((cap + 1, 4), MAXU32, jnp.uint32)
+
+
+def sanitize_keys(keys: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """Remap the all-MAX key (would alias the EMPTY marker) on valid
+    rows; [N, 4] uint32 -> [N, 4] uint32."""
+    all_max = jnp.all(keys == MAXU32, axis=1)
+    return keys.at[:, 3].set(
+        jnp.where(all_max & valid, MAXU32 - 1, keys[:, 3]))
+
+
+def host_sanitize_key(key: np.ndarray) -> np.ndarray:
+    """Host-side :func:`sanitize_keys` for a single [4] uint32 key (carry
+    initialisers place the root key without a device round-trip)."""
+    key = key.copy()
+    if (key == MAXU32).all():
+        key[3] = np.uint32(MAXU32 - 1)
+    return key
+
+
+def host_home_slot(key: np.ndarray, cap: int) -> int:
+    """Slot index of a [4] key's home bucket's first slot — MUST mirror
+    :func:`insert`'s addressing (bucket keyed by lane 2: lane 0 is
+    owner-routing-biased in the sharded engine, see sharded.py)."""
+    check_cap(cap)
+    return (int(key[2]) & (cap // BKT - 1)) * BKT
+
+
+def _probe_iter(table, keys, bkt_i, ps, unres, idx, V, RT, batch_n):
+    """One probe iteration over any batch (idx = each row's identity for
+    reservation tie-breaks; rows with unres=False are inert).  Reads each
+    key's whole bucket, resolves membership across its BKT slots, and
+    lets the minimum-index contender of each bucket claim the first
+    empty slot; losers re-read the same bucket next iteration, full
+    buckets advance by the key's double-hash step."""
+    VB = V // BKT
+    bkt = table[:V].reshape(VB, BKT, 4)[bkt_i]
+    eq = jnp.any(jnp.all(bkt == keys[:, None, :], axis=2), axis=1)
+    empty = jnp.all(bkt == MAXU32, axis=2)
+    has_empty = jnp.any(empty, axis=1)
+    first_empty = jnp.argmax(empty, axis=1)
+    want = unres & ~eq & has_empty
+    rcell = bkt_i & (RT - 1)
+    res = jnp.full((RT + 1,), batch_n, jnp.int32).at[
+        jnp.where(want, rcell, RT)].min(idx)
+    winner = want & (res[rcell] == idx)
+    dst = jnp.where(winner, bkt_i * BKT + first_empty, V)
+    table = table.at[dst].set(keys)
+    newly = eq | winner
+    nb = (bkt_i.astype(jnp.uint32) + ps).astype(jnp.int32) & (VB - 1)
+    bkt_i = jnp.where(unres & ~newly & ~has_empty, nb, bkt_i)
+    return table, bkt_i, newly & unres, winner & unres
+
+
+def insert(table: jnp.ndarray, keys: jnp.ndarray, valid: jnp.ndarray,
+           max_iters: int = 64,
+           ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Membership + insert of a key batch in one bounded probe.
+
+    ``table`` [V+1, 4] uint32 (V a power of two; last row = scatter
+    dump), ``keys`` [N, 4] uint32 (pre-:func:`sanitize_keys`-ed or raw —
+    sanitisation is applied here), ``valid`` [N] bool.
+
+    Returns ``(table', inserted, unresolved)`` where ``inserted[i]`` is
+    True iff key i claimed a slot this call (exactly one copy of each
+    distinct key ever wins, even with in-batch duplicates) and
+    ``unresolved[i]`` is True iff the probe exhausted before key i
+    resolved — the table-full overflow case.  Callers MUST treat
+    unresolved keys as fresh (sound re-exploration, never a silent
+    drop) and surface ``sum(unresolved)`` as a visible overflow flag.
+    Pure jnp — usable under jit and inside shard_map bodies.
+    """
+    V = table.shape[0] - 1
+    check_cap(V)
+    VB = V // BKT
+    n = keys.shape[0]
+    skeys = sanitize_keys(keys, valid)
+    slot0 = (skeys[:, 2] & jnp.uint32(VB - 1)).astype(jnp.int32)
+    pstep = (skeys[:, 1] | jnp.uint32(1)).astype(jnp.uint32)
+    # Reservations go through a small HASHED table (bkt_i mod RT): a
+    # collision between two DISTINCT buckets just makes one contender
+    # retry next iteration — a winner must still re-win its own cell.
+    RT = 1 << max((n * 2 - 1).bit_length(), 10)
+    # Tail threshold: once fewer than T keys remain unresolved, compact
+    # them so late iterations stop re-scanning the whole batch.
+    T = max(n // 8, min(256, n))
+    ridx = jnp.arange(n, dtype=jnp.int32)
+
+    def full_cond(st):
+        _, _, resolved, _, it = st
+        # ONE guaranteed full-batch iteration: below 50% table load the
+        # first bucket read resolves all but the full-bucket collisions,
+        # which fit the tail buffer.
+        return ((it < 1) | (jnp.sum(~resolved) > T)) & (
+            it < max_iters) & jnp.any(~resolved)
+
+    def full_body(st):
+        tbl, bkt_i, resolved, ins, it = st
+        tbl, bkt_i, newly, winner = _probe_iter(
+            tbl, skeys, bkt_i, pstep, ~resolved, ridx, V, RT, n)
+        return tbl, bkt_i, resolved | newly, ins | winner, it + 1
+
+    table, bkt_i, resolved, inserted, _ = jax.lax.while_loop(
+        full_cond, full_body,
+        (table, slot0, ~valid, jnp.zeros(n, bool), jnp.int32(0)))
+
+    # ---- tail phase: compact the unresolved few into [T] slots.
+    tail_idx = jnp.nonzero(~resolved, size=T, fill_value=n)[0]
+    tclip = tail_idx.clip(0, n - 1)
+    tval = tail_idx < n
+    t_keys = skeys[tclip]
+    t_bkt = bkt_i[tclip]
+    t_ps = pstep[tclip]
+    t_id = jnp.arange(T, dtype=jnp.int32)
+
+    def tail_cond(st):
+        _, _, t_unres, _, it = st
+        return (it < max_iters) & jnp.any(t_unres)
+
+    def tail_body(st):
+        tbl, tb, t_unres, t_ins, it = st
+        tbl, tb, newly, winner = _probe_iter(
+            tbl, t_keys, tb, t_ps, t_unres, t_id, V, RT, n)
+        return tbl, tb, t_unres & ~newly, t_ins | winner, it + 1
+
+    table, _, t_unres, t_ins, _ = jax.lax.while_loop(
+        tail_cond, tail_body,
+        (table, t_bkt, tval, jnp.zeros(T, bool), jnp.int32(0)))
+    resolved = resolved.at[tclip].max(tval & ~t_unres)
+    inserted = inserted.at[tclip].max(t_ins & tval)
+    return table, inserted, ~resolved

@@ -1,0 +1,244 @@
+"""The shared device-resident visited table (dslabs_tpu/tpu/visited.py)
+and the single-device device-resident wave loop built on it (ISSUE 1):
+
+* collision/eviction unit tests with crafted keys sharing one bucket;
+* the overflow contract — a full table treats unresolved keys as FRESH
+  (sound, may re-explore; never a silent drop) behind a visible flag,
+  in the module, the single-device engine, and the sharded engine;
+* dedup parity — the device-table loop must produce the IDENTICAL
+  unique-state set and final verdict as the legacy host ``sorted_member``
+  loop (``run_host``, the parity oracle) on lab0 pingpong and lab1
+  clientserver;
+* the transfer contract — per-wave device->host transfers in the device
+  loop are scalars only (no [N, 4] fingerprint pulls), counted through
+  the ``engine.device_get`` instrumented wrapper.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+import dslabs_tpu.tpu.engine as engine  # noqa: E402
+from dslabs_tpu.tpu import visited as visited_mod  # noqa: E402
+from dslabs_tpu.tpu.engine import CapacityOverflow, TensorSearch  # noqa: E402
+from dslabs_tpu.tpu.protocols.clientserver import \
+    make_clientserver_protocol  # noqa: E402
+from dslabs_tpu.tpu.protocols.pingpong import \
+    make_pingpong_protocol  # noqa: E402
+
+BKT = visited_mod.BKT
+
+
+def _keys_in_bucket(n, cap, bucket=3, seed=0):
+    """Craft n distinct keys whose home bucket (lane 2 & (cap/BKT - 1))
+    is ``bucket`` — bucket-collision fodder for the probe loop."""
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, 2 ** 32, size=(n, 4), dtype=np.uint64).astype(
+        np.uint32)
+    vb = cap // BKT
+    keys[:, 2] = (keys[:, 2] & ~np.uint32(vb - 1)) | np.uint32(bucket)
+    # Distinctness: lane 3 is a counter, so no two crafted keys collide.
+    keys[:, 3] = np.arange(n, dtype=np.uint32)
+    return jnp.asarray(keys)
+
+
+def test_bucket_collision_spills_to_probe_chain():
+    """More same-bucket keys than one bucket holds: the overflow walks
+    the double-hash chain, every key inserts exactly once, and a second
+    insert of the same batch resolves all of them as known."""
+    cap = 1 << 10
+    keys = _keys_in_bucket(BKT + 5, cap)
+    valid = jnp.ones((keys.shape[0],), bool)
+    table, ins, unres = visited_mod.insert(
+        visited_mod.empty_table(cap), keys, valid)
+    assert int(ins.sum()) == keys.shape[0]
+    assert int(unres.sum()) == 0
+    # Home bucket completely full, spill landed elsewhere.
+    home = np.asarray(table)[3 * BKT:(3 + 1) * BKT]
+    assert (home != np.uint32(0xFFFFFFFF)).any(axis=1).all()
+    _, ins2, unres2 = visited_mod.insert(table, keys, valid)
+    assert int(ins2.sum()) == 0 and int(unres2.sum()) == 0
+
+
+def test_in_batch_duplicates_insert_once():
+    cap = 1 << 10
+    base = _keys_in_bucket(4, cap, seed=1)
+    dup = jnp.concatenate([base, base, base])
+    valid = jnp.ones((dup.shape[0],), bool)
+    table, ins, unres = visited_mod.insert(
+        visited_mod.empty_table(cap), dup, valid)
+    assert int(ins.sum()) == 4          # one copy of each distinct key
+    assert int(unres.sum()) == 0
+    occupied = (np.asarray(table)[:cap] != np.uint32(0xFFFFFFFF)).any(axis=1)
+    assert int(occupied.sum()) == 4
+
+
+def test_full_table_overflow_is_visible_and_fresh():
+    """The overflow contract at module level: with every slot taken, new
+    keys come back UNRESOLVED (visible flag) — candidates for sound
+    re-exploration, never silently swallowed as 'seen'."""
+    cap = BKT                           # one bucket = the whole table
+    fill = _keys_in_bucket(BKT, cap, bucket=0, seed=2)
+    table, ins, unres = visited_mod.insert(
+        visited_mod.empty_table(cap), fill, jnp.ones((BKT,), bool))
+    assert int(ins.sum()) == BKT and int(unres.sum()) == 0
+    more = _keys_in_bucket(3, cap, bucket=0, seed=3)
+    more = more.at[:, 3].add(1000)      # distinct from the fill batch
+    table, ins2, unres2 = visited_mod.insert(
+        table, more, jnp.ones((3,), bool))
+    assert int(ins2.sum()) == 0
+    assert int(unres2.sum()) == 3       # all flagged, none dropped
+    # Known keys still resolve as known even when the table is full.
+    _, ins3, unres3 = visited_mod.insert(
+        table, fill, jnp.ones((BKT,), bool))
+    assert int(ins3.sum()) == 0 and int(unres3.sum()) == 0
+
+
+def _pruned_pingpong(w=2):
+    pp = make_pingpong_protocol(w)
+    return dataclasses.replace(
+        pp, goals={}, prunes={"CLIENTS_DONE": pp.goals["CLIENTS_DONE"]})
+
+
+def _pruned_clientserver(nc=2, w=1):
+    cs = make_clientserver_protocol(n_clients=nc, w=w)
+    return dataclasses.replace(
+        cs, goals={}, prunes={"CLIENTS_DONE": cs.goals["CLIENTS_DONE"]})
+
+
+def _table_key_set(search):
+    """Extract the device table's occupied keys as a set of
+    (h1, h2) uint64 pairs (the host oracle's key format)."""
+    table = np.asarray(search._last_dev_carry["visited"],
+                       dtype=np.uint64)[:-1]
+    occ = (table != np.uint64(0xFFFFFFFF)).any(axis=1)
+    rows = table[occ]
+    h1 = (rows[:, 0] << np.uint64(32)) | rows[:, 1]
+    h2 = (rows[:, 2] << np.uint64(32)) | rows[:, 3]
+    return set(zip(h1.tolist(), h2.tolist()))
+
+
+@pytest.mark.parametrize("proto,chunk", [
+    (_pruned_pingpong(), 64),
+    (_pruned_clientserver(), 128),
+], ids=["lab0-pingpong", "lab1-clientserver"])
+def test_device_table_matches_host_oracle(proto, chunk):
+    """Verdict + unique COUNT + unique SET parity: the device-table loop
+    against the legacy host sorted_member loop on the same protocol."""
+    dev = TensorSearch(proto, chunk=chunk)
+    d = dev.run()
+    host = TensorSearch(proto, chunk=chunk)
+    h = host.run_host()
+    assert d.end_condition == h.end_condition == "SPACE_EXHAUSTED"
+    assert d.unique_states == h.unique_states
+    assert d.states_explored == h.states_explored
+    assert d.visited_overflow == 0
+    host_set = set(zip(host._host_visited[0].tolist(),
+                       host._host_visited[1].tolist()))
+    assert _table_key_set(dev) == host_set
+
+
+@pytest.mark.parametrize("depth", [2, 4])
+def test_device_table_depth_limited_parity(depth):
+    proto = _pruned_clientserver()
+    d = TensorSearch(proto, chunk=128, max_depth=depth).run()
+    h = TensorSearch(proto, chunk=128, max_depth=depth).run_host()
+    assert d.end_condition == h.end_condition == "DEPTH_EXHAUSTED"
+    assert d.unique_states == h.unique_states
+    assert d.states_explored == h.states_explored
+
+
+def test_goal_verdict_parity_device_vs_host():
+    pp = make_pingpong_protocol(2)
+    d = TensorSearch(pp, chunk=64).run()
+    h = TensorSearch(pp, chunk=64).run_host()
+    assert d.end_condition == h.end_condition == "GOAL_FOUND"
+    assert d.predicate_name == h.predicate_name
+    assert d.depth == h.depth           # BFS shortest goal depth
+
+
+def test_engine_strict_raises_on_table_full():
+    """Single-device strict engine: a too-small table is a LOUD
+    CapacityOverflow (exact unique counts cannot survive
+    treat-as-fresh), never a silent drop or hang."""
+    proto = _pruned_clientserver()
+    with pytest.raises(CapacityOverflow):
+        TensorSearch(proto, chunk=64, visited_cap=BKT).run()
+
+
+def test_engine_beam_degrades_treat_as_fresh():
+    """strict=False + a full table: the search still terminates (depth
+    bound), reports a nonzero visited_overflow, and explores at LEAST
+    the true space (re-exploration is sound; dropping would undercount)."""
+    proto = _pruned_clientserver()
+    exact = TensorSearch(proto, chunk=64, max_depth=4).run()
+    tiny = TensorSearch(proto, chunk=64, max_depth=4, visited_cap=BKT,
+                        strict=False).run()
+    assert tiny.end_condition == "DEPTH_EXHAUSTED"
+    assert tiny.visited_overflow > 0
+    assert tiny.states_explored >= exact.states_explored
+
+
+def test_sharded_beam_degrades_treat_as_fresh():
+    """The same contract on the sharded engine (strict=False): overflow
+    visible via SearchOutcome.visited_overflow, search sound.  The
+    visited_cap is PER DEVICE (8 owner shards), so the space must be
+    deep/wide enough that some owner's BKT-slot table fills AND then
+    receives a further key — lab1 c3-w2 at depth 5 (83 unique states,
+    ~10 per owner) is the smallest config that reliably does."""
+    from dslabs_tpu.tpu.sharded import ShardedTensorSearch, make_mesh
+
+    proto = _pruned_clientserver(nc=3, w=2)
+    mesh = make_mesh(8)
+    exact = ShardedTensorSearch(
+        proto, mesh, chunk_per_device=64, frontier_cap=1 << 10,
+        visited_cap=1 << 12, strict=False, max_depth=5).run()
+    assert exact.visited_overflow == 0
+    tiny = ShardedTensorSearch(
+        proto, mesh, chunk_per_device=64, frontier_cap=1 << 10,
+        visited_cap=BKT, strict=False, max_depth=5).run()
+    assert tiny.end_condition == "DEPTH_EXHAUSTED"
+    assert tiny.visited_overflow > 0
+    assert tiny.states_explored >= exact.states_explored
+
+
+def test_sharded_strict_raises_on_table_full():
+    from dslabs_tpu.tpu.sharded import ShardedTensorSearch, make_mesh
+
+    proto = _pruned_clientserver(nc=3, w=2)
+    mesh = make_mesh(8)
+    with pytest.raises(CapacityOverflow):
+        ShardedTensorSearch(
+            proto, mesh, chunk_per_device=64, frontier_cap=1 << 10,
+            visited_cap=BKT, strict=True).run()
+
+
+def test_device_loop_transfers_scalars_only(monkeypatch):
+    """The acceptance contract: per-wave device->host transfers in the
+    device-resident run() are scalars/short stat vectors — no [N, 4]
+    fingerprint pulls, no state-row pulls.  Counted via the
+    engine.device_get instrumented wrapper."""
+    sizes = []
+    real = engine.device_get
+
+    def spy(x):
+        arr = real(x)
+        sizes.append(arr.size)
+        return arr
+
+    monkeypatch.setattr(engine, "device_get", spy)
+    proto = _pruned_clientserver()
+    search = TensorSearch(proto, chunk=128)
+    out = search.run()
+    assert out.end_condition == "SPACE_EXHAUSTED"
+    assert sizes, "device loop must route readbacks through device_get"
+    stats_len = 7 + len(search._flag_names)
+    assert max(sizes) <= stats_len, (
+        f"a non-scalar readback leaked into the wave loop: {sizes}")
+    # One stats vector per wave (+ spill re-syncs, none here): bounded by
+    # the level count, nothing per-chunk or per-state.
+    assert len(sizes) <= out.depth + 2

@@ -1,0 +1,54 @@
+"""Perf smoke gate (`make perf-smoke`, marker `perf`): a small strict
+BFS on the CPU backend must not regress unique-states/min by more than
+30% against the committed floor in BASELINE.json.
+
+The floor is deliberately conservative (~half the rate measured on the
+1-core reference box at commit time) so OS noise cannot flake the gate,
+while a real hot-path regression (the measured round-3 pathologies were
+all >2x) still trips it.  Update the floor when a PR lands a real
+speedup: `python -m pytest tests/test_perf_smoke.py -s` prints the
+measured rate.
+"""
+
+import dataclasses
+import json
+import os
+import time
+
+import pytest
+
+pytest.importorskip("jax")
+
+from dslabs_tpu.tpu.engine import TensorSearch  # noqa: E402
+from dslabs_tpu.tpu.protocols.clientserver import \
+    make_clientserver_protocol  # noqa: E402
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+with open(os.path.join(ROOT, "BASELINE.json")) as f:
+    _PERF = json.load(f)["perf_smoke"]
+
+
+@pytest.mark.perf
+def test_lab1_strict_bfs_states_per_min_floor():
+    proto = dataclasses.replace(
+        make_clientserver_protocol(**_PERF["protocol_kwargs"]), goals={})
+    search = TensorSearch(proto, chunk=_PERF["chunk"],
+                          frontier_cap=1 << 17, max_depth=2)
+    search.run()                        # warm-up: compile outside the clock
+    search.max_depth = _PERF["depth"]
+    best = 0.0
+    for _ in range(2):                  # best-of-2 absorbs scheduler noise
+        t0 = time.time()
+        out = search.run()
+        best = max(best, out.unique_states / (time.time() - t0) * 60.0)
+    assert out.end_condition == "DEPTH_EXHAUSTED"
+    assert out.unique_states == _PERF["unique_states"], (
+        "state-space drift: the floor was committed for "
+        f"{_PERF['unique_states']} unique states, got {out.unique_states}")
+    floor = _PERF["floor_states_per_min"]
+    print(f"\nperf-smoke: {best:,.0f} unique states/min "
+          f"(floor {floor:,.0f}, fail below {0.7 * floor:,.0f})")
+    assert best >= 0.7 * floor, (
+        f"perf regression: {best:,.0f} states/min is >30% below the "
+        f"committed floor {floor:,.0f} (BASELINE.json perf_smoke)")
