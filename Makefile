@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast perf-smoke lab0 lab1 lab2 lab3 lab4 bench dryrun handout clean
+.PHONY: test test-fast perf-smoke fault-smoke lab0 lab1 lab2 lab3 lab4 bench dryrun handout clean
 
 test:            ## full acceptance + parity suite
 	$(PY) -m pytest tests/ -q
@@ -20,6 +20,9 @@ bench:           ## TPU states/min benchmark (one JSON line)
 
 perf-smoke:      ## fast CPU perf gate vs the BASELINE.json floor
 	$(PY) -m pytest tests/ -q -m perf -s -p no:cacheprovider
+
+fault-smoke:     ## injected-fault recovery suite (retry/failover/resume/watchdog) on CPU
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m fault -p no:cacheprovider
 
 dryrun:          ## multi-chip sharding dry run on a virtual CPU mesh
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -157,10 +157,13 @@ def _run_one(entry: TestEntry, routers=None) -> TestResult:
             # next test (the reference interrupts + joins,
             # RunState.java:340-383).
             from dslabs_tpu.runner.run_state import stop_active_run_states
-            stopped = stop_active_run_states()
+            stopped, stuck = stop_active_run_states()
             if stopped:
-                LOG.warning("timeout: stopped %d leaked RunState(s)",
-                            stopped)
+                LOG.warning(
+                    "timeout: stopped %d leaked RunState(s)%s", stopped,
+                    (f", {stuck} node thread(s) stuck past their join "
+                     "timeout (wedged handlers — names/addresses logged "
+                     "above)") if stuck else "")
             th.join(2.0)
     end = time.time()
     err = err_box[0]

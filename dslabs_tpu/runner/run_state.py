@@ -19,7 +19,7 @@ import logging
 import threading
 import time
 import weakref
-from typing import Dict, Iterable, Optional
+from typing import Dict, Iterable, Optional, Tuple
 
 from dslabs_tpu.core.address import Address
 from dslabs_tpu.core.node import Node, NodeConfig
@@ -45,14 +45,18 @@ _SLOW_HANDLER_WARN_S = 1.0
 _ACTIVE: "weakref.WeakSet[RunState]" = weakref.WeakSet()
 
 
-def stop_active_run_states() -> int:
-    """Cooperatively stop every running RunState; returns the count."""
-    n = 0
+def stop_active_run_states() -> "Tuple[int, int]":
+    """Cooperatively stop every running RunState; returns
+    ``(stopped, stuck_threads)`` where ``stuck_threads`` counts node
+    threads that survived their join timeout (the harness surfaces the
+    count so a wedged handler is attributable, not a generic warning)."""
+    stopped = stuck = 0
     for rs in list(_ACTIVE):
         if rs.running():
             rs.stop()
-            n += 1
-    return n
+            stopped += 1
+            stuck += rs.stuck_threads
+    return stopped, stuck
 
 
 class RunState(AbstractState):
@@ -67,6 +71,9 @@ class RunState(AbstractState):
         self._exception_thrown = False
         self._lock = threading.RLock()
         self.stop_time: Optional[float] = None
+        # Node threads that outlived their stop() join timeout (wedged
+        # handlers); surfaced to the harness for timeout diagnostics.
+        self.stuck_threads: int = 0
 
     # Live run state is never hashed/deduped; identity equality is fine and
     # avoids touching concurrently-mutating node state.
@@ -267,12 +274,17 @@ class RunState(AbstractState):
             time.sleep(settings.max_time_secs)
 
     def stop(self) -> None:
-        """Interrupt node threads and join them (RunState.java:340-383)."""
+        """Interrupt node threads and join them (RunState.java:340-383).
+
+        A thread that survives the 2 s join is a wedged handler: its
+        NAME AND NODE ADDRESS are logged (not a generic ">1s" line) and
+        the count lands in ``self.stuck_threads`` so the harness can
+        attribute a test timeout to the specific stuck node."""
         with self._lock:
             if not self._running:
                 return
             self._shutdown.set()
-            threads = list(self._threads.values())
+            threads = list(self._threads.items())   # (address, thread)
             self._threads.clear()
             self._running = False
         for address in list(self.addresses()):
@@ -280,9 +292,17 @@ class RunState(AbstractState):
             if inbox is not None:
                 inbox.interrupt()
         join_start = time.monotonic()
-        for t in threads:
+        for _, t in threads:
             t.join(timeout=2.0)
-        if time.monotonic() - join_start > 1.0:
+        stuck = [(a, t) for a, t in threads if t.is_alive()]
+        self.stuck_threads = len(stuck)
+        if stuck:
+            LOG.warning(
+                "%d node thread(s) still alive after stop: %s — "
+                "handlers must not block",
+                len(stuck),
+                ", ".join(f"{t.name} (node {a})" for a, t in stuck))
+        elif time.monotonic() - join_start > 1.0:
             LOG.warning("Node threads took >1s to stop; "
                         "handlers should not block")
         self.stop_time = time.monotonic()

@@ -397,6 +397,14 @@ def _run_tensor(binding: TwinBinding, settings, state, chunk=512):
         search = ShardedTensorSearch(
             protocol, mesh, chunk_per_device=chunk, frontier_cap=f_cap,
             visited_cap=v_cap, strict=True, record_trace=True)
+        # Transient-dispatch retry (tpu/supervisor.py): a preemption or
+        # transient XLA error mid-search retries with backoff instead of
+        # failing the lab test; verdict flow is untouched (semantic
+        # errors like CapacityOverflow pass straight through to the
+        # capacity ladder below).
+        from dslabs_tpu.tpu.supervisor import install_retry
+
+        install_retry(search)
         search.set_runtime_masks(marr, tarr)
         rel = None
         if settings.depth_limited():
@@ -522,6 +530,9 @@ def _rollout_probe(binding, settings, state):
             binding, settings, net_cap << top, timer_cap + 2 * top,
             with_goals=False)
         search = TensorSearch(protocol, chunk=1)
+        from dslabs_tpu.tpu.supervisor import install_retry
+
+        install_retry(search)
         search.set_runtime_masks(marr, tarr)
         root, history = binding.derive_root(search, state)
         rel = (settings.max_depth - state.depth

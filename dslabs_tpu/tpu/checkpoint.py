@@ -1,0 +1,178 @@
+"""Unified, engine-agnostic search checkpoints (atomic .npz snapshots).
+
+Extracted from the sharded engine's round-4 checkpointing (sharded.py)
+and generalised so every rung of the failover ladder — the sharded
+driver, the single-device device-resident wave loop, and the host-dedup
+parity loop — can dump and resume the SAME file (docs/resilience.md).
+That engine-portability is what makes supervisor failover
+(sharded -> single-device -> host) resumable: the dump stores the
+search's SEMANTIC state, not any engine's carry layout:
+
+  frontier      [n, lanes] int32   live frontier rows (occupied only)
+  visited_keys  [K, 4]     uint32  occupied visited-table lines (the
+                                   128-bit keys; table layout is
+                                   rebuilt on load by re-insertion)
+  depth / explored / elapsed / vis_over / dropped   scalars
+  fp_map        [M, 9]     int64   optional trace chain (sharded
+                                   record_trace mode)
+
+Every dump carries a **config fingerprint** of the search it belongs
+to: the protocol's packed-lane shape (protocol name, node/message/timer
+widths, net/timer caps, node count) plus the strict and record_trace
+flags.  Engine knobs that do not change state identity (chunk sizes,
+frontier/visited capacities, device count, ev budgets) are deliberately
+EXCLUDED — a dump written by an 8-device sharded run resumes on a
+single-device engine, or under a different chunk size, unchanged.  A
+fingerprint mismatch is refused LOUDLY (:class:`CheckpointMismatch`
+names both fingerprints); a checkpoint is never resumed silently into
+a search it does not describe.
+
+Writes are atomic (tmp + ``os.replace``): a kill mid-write leaves the
+previous complete dump.  :class:`AsyncCheckpointWriter` is the shared
+skip-if-busy background drain (one in-flight dump, never a queue).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["FORMAT_VERSION", "CheckpointMismatch", "SearchCheckpoint",
+           "config_fingerprint", "save", "load", "peek_fingerprint",
+           "AsyncCheckpointWriter"]
+
+FORMAT_VERSION = "dslabs-search-ckpt-v6"
+
+
+class CheckpointMismatch(RuntimeError):
+    """A checkpoint's config fingerprint does not match the live search.
+
+    Raised instead of silently resuming (or silently ignoring) a dump
+    from a different protocol/capacity configuration — the message
+    names BOTH fingerprints so the divergent knob is attributable."""
+
+
+@dataclasses.dataclass
+class SearchCheckpoint:
+    """The engine-agnostic snapshot of a BFS at a level boundary."""
+
+    fingerprint: str
+    depth: int
+    explored: int
+    elapsed: float
+    frontier: np.ndarray        # [n, lanes] int32, live rows only
+    visited_keys: np.ndarray    # [K, 4] uint32, occupied lines only
+    vis_over: int = 0
+    dropped: int = 0
+    fp_map: Optional[np.ndarray] = None   # [M, 9] int64 trace chain
+
+
+def config_fingerprint(protocol, strict: bool,
+                       record_trace: bool = False) -> str:
+    """The semantic identity a dump must share with the search resuming
+    it: packed-lane layout + verdict-affecting flags.  Engine-local
+    throughput knobs (chunk, caps, mesh size, ev budget) are excluded
+    by design — see the module docstring."""
+    return repr((FORMAT_VERSION, protocol.name, protocol.n_nodes,
+                 protocol.node_width, protocol.msg_width,
+                 protocol.timer_width, protocol.net_cap,
+                 protocol.timer_cap, bool(strict), bool(record_trace)))
+
+
+def save(path: str, ckpt: SearchCheckpoint) -> None:
+    """Atomic dump: write to ``path + '.tmp'``, then ``os.replace``."""
+    host = {
+        "config": np.bytes_(ckpt.fingerprint.encode()),
+        "depth": np.int64(ckpt.depth),
+        "explored": np.int64(ckpt.explored),
+        "elapsed": np.float64(ckpt.elapsed),
+        "vis_over": np.int64(ckpt.vis_over),
+        "dropped": np.int64(ckpt.dropped),
+        "frontier": np.asarray(ckpt.frontier, np.int32),
+        "visited_keys": np.asarray(ckpt.visited_keys, np.uint32),
+    }
+    if ckpt.fp_map is not None and len(ckpt.fp_map):
+        host["fp_map"] = np.asarray(ckpt.fp_map, np.int64)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **host)
+    os.replace(tmp, path)
+
+
+def peek_fingerprint(path: str) -> Optional[str]:
+    """The dump's fingerprint WITHOUT loading the arrays (callers that
+    only need a resumability boolean must not pay the full load), or
+    None when the file is missing/unreadable/not a checkpoint."""
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with np.load(path) as z:
+            if "config" not in z.files:
+                return None
+            return z["config"].item().decode()
+    except Exception:
+        return None
+
+
+def load(path: str, fingerprint: str) -> Optional[SearchCheckpoint]:
+    """Load and VERIFY a dump: ``None`` when no file exists; a loud
+    :class:`CheckpointMismatch` (naming both fingerprints) when the
+    dump belongs to a different configuration."""
+    if not path or not os.path.exists(path):
+        return None
+    with np.load(path) as z:
+        if "config" not in z.files:
+            raise CheckpointMismatch(
+                f"{path}: not a search checkpoint (no config "
+                "fingerprint)")
+        found = z["config"].item().decode()
+        if found != fingerprint:
+            raise CheckpointMismatch(
+                f"refusing to resume {path}: checkpoint fingerprint\n"
+                f"  {found}\ndoes not match the live search's\n"
+                f"  {fingerprint}\n(dump from a different protocol/"
+                "capacity config — delete the file or fix the config)")
+        return SearchCheckpoint(
+            fingerprint=found,
+            depth=int(z["depth"]),
+            explored=int(z["explored"]),
+            elapsed=float(z["elapsed"]),
+            frontier=np.asarray(z["frontier"], np.int32),
+            visited_keys=np.asarray(z["visited_keys"], np.uint32),
+            vis_over=int(z["vis_over"]) if "vis_over" in z.files else 0,
+            dropped=int(z["dropped"]) if "dropped" in z.files else 0,
+            fp_map=(np.asarray(z["fp_map"], np.int64)
+                    if "fp_map" in z.files else None))
+
+
+class AsyncCheckpointWriter:
+    """Skip-if-busy background dump drain (one thread, never a queue).
+
+    ``kick(fn)`` runs ``fn`` (host readback + :func:`save`) on a daemon
+    thread unless a prior dump is still draining — a checkpoint tick
+    that lands mid-drain is SKIPPED, not queued, so dumps can never
+    back up behind a slow disk.  ``join()`` blocks until the in-flight
+    dump (if any) completes; callers must join before reporting an
+    outcome a kill-resume test depends on."""
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+
+    def busy(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def kick(self, fn) -> bool:
+        if self.busy():
+            return False
+        th = threading.Thread(target=fn, daemon=True)
+        self._thread = th
+        th.start()
+        return True
+
+    def join(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join()

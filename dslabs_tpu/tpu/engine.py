@@ -207,6 +207,13 @@ class SearchOutcome:
     # count can then over-report re-explorations.  Strict engines raise
     # instead; beam runs report the count here (ISSUE 1 contract).
     visited_overflow: int = 0
+    # Recovery accounting (tpu/supervisor.py, docs/resilience.md): every
+    # degradation the supervisor absorbed on the way to this verdict is
+    # visible here — never a silent partial verdict.
+    retries: int = 0                 # transient-dispatch retries absorbed
+    failovers: int = 0               # ladder rungs abandoned before this one
+    resumed_from_depth: int = 0      # checkpoint depth resumed from (0=root)
+    engine: Optional[str] = None     # ladder rung that produced the verdict
 
 
 # ----------------------------------------------------------------- hashing
@@ -292,6 +299,19 @@ def host_keys(fp: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     h1 = (fp[:, 0] << np.uint64(32)) | fp[:, 1]
     h2 = (fp[:, 2] << np.uint64(32)) | fp[:, 3]
     return h1, h2
+
+
+def _keys_to_rows(visited: Tuple[np.ndarray, np.ndarray]) -> np.ndarray:
+    """Inverse of :func:`host_keys`: host (h1, h2) uint64 arrays ->
+    [K, 4] uint32 device-format key rows (the unified checkpoint's
+    visited_keys layout, tpu/checkpoint.py)."""
+    h1, h2 = visited
+    rows = np.empty((len(h1), 4), np.uint32)
+    rows[:, 0] = (h1 >> np.uint64(32)).astype(np.uint32)
+    rows[:, 1] = (h1 & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    rows[:, 2] = (h2 >> np.uint64(32)).astype(np.uint32)
+    rows[:, 3] = (h2 & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return rows
 
 
 def sorted_member(vh1: np.ndarray, vh2: np.ndarray,
@@ -638,8 +658,23 @@ class TensorSearch:
                  ev_budget: Optional[int] = None,
                  visited_cap: int = 1 << 20,
                  strict: bool = True,
-                 use_host_visited: bool = False):
+                 use_host_visited: bool = False,
+                 checkpoint_path: Optional[str] = None,
+                 checkpoint_every: int = 0):
         self.p = protocol
+        # Unified checkpoint/resume (tpu/checkpoint.py): every
+        # ``checkpoint_every`` completed waves the live search state —
+        # occupied frontier rows + occupied visited-table lines +
+        # counters + depth — is snapshotted host-side and drained to
+        # ``checkpoint_path`` (atomic .npz) by a background thread;
+        # ``run(resume=True)`` continues a killed search from the last
+        # dump with identical verdict and unique count.  The dump format
+        # is ENGINE-AGNOSTIC — the device-resident wave loop, the host
+        # parity loop, and the sharded driver all read the same file
+        # (the supervisor's failover ladder depends on that).  0 = off.
+        self.checkpoint_path = checkpoint_path
+        self.checkpoint_every = checkpoint_every
+        self._resumed_from_depth = 0
         self.frontier_cap = frontier_cap
         self.chunk = chunk
         self.max_depth = max_depth
@@ -710,6 +745,73 @@ class TensorSearch:
         self._dev_progs: Dict[int, tuple] = {}
 
     # ------------------------------------------------------------- plumbing
+
+    def _dispatch(self, tag: str, fn, *args):
+        """THE device-dispatch boundary: every hot-loop dispatch and
+        blocking readback in this engine (and the sharded subclass)
+        funnels through here.  With no hook installed it is a plain
+        call; the search supervisor (tpu/supervisor.py) installs its
+        retry/watchdog/fault-injection boundary as ``_dispatch_hook``.
+        Tags are ``"<engine>.<site>"`` — the engine half keys the
+        supervisor's fault plan and per-rung counters."""
+        hook = getattr(self, "_dispatch_hook", None)
+        if hook is None:
+            return fn(*args)
+        return hook(tag, fn, *args)
+
+    # -------------------------------------------------------- checkpointing
+
+    def _ckpt_fingerprint(self) -> str:
+        """The config identity a dump must share to be resumable here
+        (engine-agnostic by design — see tpu/checkpoint.py)."""
+        from dslabs_tpu.tpu import checkpoint as ckpt_mod
+
+        return ckpt_mod.config_fingerprint(self.p, self.strict,
+                                           self.record_trace)
+
+    def has_resumable_checkpoint(self) -> bool:
+        """Existence + fingerprint check WITHOUT loading the arrays."""
+        from dslabs_tpu.tpu import checkpoint as ckpt_mod
+
+        if not self.checkpoint_path:
+            return False
+        fp = ckpt_mod.peek_fingerprint(self.checkpoint_path)
+        return fp is not None and fp == self._ckpt_fingerprint()
+
+    def _load_ckpt(self):
+        """Load + verify the dump; ``None`` when no file exists, a loud
+        CheckpointMismatch when it belongs to a different config."""
+        from dslabs_tpu.tpu import checkpoint as ckpt_mod
+
+        if not self.checkpoint_path:
+            return None
+        ck = ckpt_mod.load(self.checkpoint_path, self._ckpt_fingerprint())
+        if ck is not None:
+            self._resumed_from_depth = ck.depth
+        return ck
+
+    @property
+    def _ckpt_writer(self):
+        from dslabs_tpu.tpu import checkpoint as ckpt_mod
+
+        w = getattr(self, "_ckpt_writer_obj", None)
+        if w is None:
+            w = self._ckpt_writer_obj = ckpt_mod.AsyncCheckpointWriter()
+        return w
+
+    def _kick_ckpt(self, frontier: np.ndarray, visited_keys: np.ndarray,
+                   depth: int, explored: int, elapsed: float,
+                   vis_over: int = 0) -> None:
+        """Queue one async atomic dump (skip-if-busy, never a queue);
+        arrays must already be host copies."""
+        from dslabs_tpu.tpu import checkpoint as ckpt_mod
+
+        ck = ckpt_mod.SearchCheckpoint(
+            fingerprint=self._ckpt_fingerprint(), depth=depth,
+            explored=explored, elapsed=elapsed, frontier=frontier,
+            visited_keys=visited_keys, vis_over=vis_over)
+        self._ckpt_writer.kick(
+            lambda: ckpt_mod.save(self.checkpoint_path, ck))
 
     def initial_state(self) -> dict:
         p = self.p
@@ -1343,13 +1445,17 @@ class TensorSearch:
             "the violating successor (engine bug)")
 
     def run(self, check_initial: bool = True,
-            initial: Optional[dict] = None) -> SearchOutcome:
+            initial: Optional[dict] = None,
+            resume: bool = False) -> SearchOutcome:
         """Run the BFS.  ``initial`` (a batch-1 state pytree, e.g. a prior
         outcome's ``goal_state``) starts the search from an arbitrary
         state — the staged-search pattern (PaxosTest.java:886-1096):
         extract a goal state, change the settings masks
         (``dataclasses.replace(protocol, deliver_message=...)``), and
-        search onward from it.
+        search onward from it.  ``resume=True`` continues from
+        ``checkpoint_path`` if a fingerprint-matching dump exists (a
+        killed search restarts at its last checkpointed level with
+        identical final verdict and unique count).
 
         Dispatch: the device-resident wave loop (:meth:`_run_device` —
         visited table + frontier as donated device buffers, scalar-only
@@ -1358,11 +1464,12 @@ class TensorSearch:
         (:meth:`run_host`, the parity oracle — trace mode spills
         per-level event tables to the host by design)."""
         if self.record_trace or self.use_host_visited:
-            return self.run_host(check_initial, initial)
-        return self._run_device(check_initial, initial)
+            return self.run_host(check_initial, initial, resume=resume)
+        return self._run_device(check_initial, initial, resume=resume)
 
     def run_host(self, check_initial: bool = True,
-                 initial: Optional[dict] = None) -> SearchOutcome:
+                 initial: Optional[dict] = None,
+                 resume: bool = False) -> SearchOutcome:
         """The legacy host-dedup BFS: device expand + in-chunk sort-unique,
         host ``sorted_member`` visited membership.  Kept as (a) the parity
         oracle the device-table loop is tested against and (b) the trace-
@@ -1376,26 +1483,47 @@ class TensorSearch:
         # searches start from arbitrary states; tpu/trace.py replays from
         # here, not from the protocol's initial state).
         self._trace_root = jax.tree.map(np.asarray, state)
-        fp0 = np.asarray(state_fingerprints(state))
-        visited = host_keys(fp0)
-        # Diagnostic stash: the parity tests compare this loop's exact
-        # visited SET against the device table's extracted keys.
-        self._host_visited = visited
-        explored = 0
-        depth = 0
+        ck = self._load_ckpt() if resume else None
+        if ck is not None and self.record_trace:
+            raise ValueError(
+                "resume + record_trace is unsupported on the host loop "
+                "(per-level trace spills cannot be rebuilt from a "
+                "checkpoint); rerun without record_trace")
         self._levels = []
+        if ck is not None:
+            # Resume at the checkpointed level boundary: the visited SET
+            # comes back from the dumped 128-bit keys, the frontier from
+            # the dumped live rows; clocks continue from the dump.
+            t0 = time.time() - ck.elapsed
+            h1, h2 = host_keys(ck.visited_keys)
+            order = np.lexsort((h2, h1))
+            visited = (h1[order], h2[order])
+            self._host_visited = visited
+            explored = ck.explored
+            depth = ck.depth
+            frontier = jnp.asarray(ck.frontier)
+            frontier_n = len(ck.frontier)
+            parent_rows = np.full(max(frontier_n, 1), -1, dtype=np.int64)
+        else:
+            fp0 = np.asarray(state_fingerprints(state))
+            visited = host_keys(fp0)
+            # Diagnostic stash: the parity tests compare this loop's
+            # exact visited SET against the device table's keys.
+            self._host_visited = visited
+            explored = 0
+            depth = 0
 
-        if check_initial:
-            out = self._check_initial(state, t0)
-            if out is not None:
-                return out
+            if check_initial:
+                out = self._check_initial(state, t0)
+                if out is not None:
+                    return out
 
-        frontier = flatten_state(state)              # [1, lanes] rows
-        # parent_rows[i] = the global successor row (in the PREVIOUS level's
-        # enumeration) that produced frontier state i; for the root level it
-        # is -1.  Used by _reconstruct.
-        parent_rows = np.array([-1], dtype=np.int64)
-        frontier_n = 1
+            frontier = flatten_state(state)          # [1, lanes] rows
+            # parent_rows[i] = the global successor row (in the PREVIOUS
+            # level's enumeration) that produced frontier state i; for
+            # the root level it is -1.  Used by _reconstruct.
+            parent_rows = np.array([-1], dtype=np.int64)
+            frontier_n = 1
         while frontier_n > 0:
             if self.max_depth is not None and depth >= self.max_depth:
                 return SearchOutcome("DEPTH_EXHAUSTED", explored,
@@ -1427,9 +1555,11 @@ class TensorSearch:
                     [jnp.ones(c, bool), jnp.zeros(pad, bool)])
                 rt = getattr(self, "_rt_masks", None)
                 (rows_d, valids, fp, unique, overflow, ev_drops, event_ids,
-                 flags) = (self._expand(chunk_rows, chunk_valid, 0, rt)
+                 flags) = (self._dispatch("host.expand", self._expand,
+                                          chunk_rows, chunk_valid, 0, rt)
                            if rt is not None
-                           else self._expand(chunk_rows, chunk_valid))
+                           else self._dispatch("host.expand", self._expand,
+                                               chunk_rows, chunk_valid))
                 if int(overflow):
                     raise CapacityOverflow(
                         f"{self.p.name}: net_cap={self.p.net_cap}, "
@@ -1516,6 +1646,19 @@ class TensorSearch:
                                      len(visited[0]), depth,
                                      time.time() - t0)
             frontier = jnp.asarray(nf)
+            if (self.checkpoint_path and self.checkpoint_every
+                    and depth % self.checkpoint_every == 0
+                    and not self.record_trace):
+                # Everything is already host-side here, so the dump is a
+                # plain synchronous atomic write (the device loops use
+                # the async drain instead — their readback is the cost).
+                from dslabs_tpu.tpu import checkpoint as ckpt_mod
+
+                ckpt_mod.save(self.checkpoint_path, ckpt_mod.SearchCheckpoint(
+                    fingerprint=self._ckpt_fingerprint(), depth=depth,
+                    explored=explored, elapsed=time.time() - t0,
+                    frontier=nf,
+                    visited_keys=_keys_to_rows(visited)))
 
         return SearchOutcome("SPACE_EXHAUSTED", explored, len(visited[0]),
                              depth, 0.0)
@@ -1694,7 +1837,8 @@ class TensorSearch:
         once per RUN, only when a terminal state actually fired."""
         import time
 
-        rows = device_get(carry["flag_rows"])
+        rows = self._dispatch("device.flags", device_get,
+                              carry["flag_rows"])
         for fi, fname in enumerate(self._flag_names):
             if flag_counts[fi] <= 0:
                 continue
@@ -1719,7 +1863,8 @@ class TensorSearch:
         raise AssertionError("flag counts fired without a flag name")
 
     def _run_device(self, check_initial: bool = True,
-                    initial: Optional[dict] = None) -> SearchOutcome:
+                    initial: Optional[dict] = None,
+                    resume: bool = False) -> SearchOutcome:
         """The device-resident BFS.  Frontier + visited table live in
         device buffers donated through every wave; host transfers are the
         per-wave stats scalars.  The frontier buffer starts small and
@@ -1732,7 +1877,10 @@ class TensorSearch:
         state = (jax.tree.map(jnp.asarray, initial) if initial is not None
                  else self.initial_state())
         self._trace_root = jax.tree.map(np.asarray, state)
-        if check_initial:
+        ck = self._load_ckpt() if resume else None
+        if ck is not None:
+            t0 = time.time() - ck.elapsed
+        elif check_initial:
             out = self._check_initial(state, t0)
             if out is not None:
                 return out
@@ -1741,35 +1889,124 @@ class TensorSearch:
         # Start the frontier buffer SMALL (2k rows): the per-wave promote
         # zero+copy scales with the buffer, and most searches never need
         # more; the ones that do pay one bounded deterministic restart
-        # per x8 growth rung.
+        # per x8 growth rung.  A resumed frontier sets the floor.
         cap = min(user_cap, -(-max(C, 1 << 11) // C) * C)
-        while True:
-            out = self._device_attempt(state, cap, user_cap, t0)
-            if out is not None:
-                return out
-            cap = min(cap * 8, user_cap)
+        if ck is not None:
+            cap = min(user_cap,
+                      max(cap, -(-max(len(ck.frontier), 1) // C) * C))
+        try:
+            while True:
+                # Growth restarts re-seed from the CHECKPOINT when one
+                # was loaded (the dump is a consistent level boundary;
+                # restarting there is deterministic and cheaper than
+                # from the root).
+                out = self._device_attempt(state, cap, user_cap, t0, ck)
+                if out is not None:
+                    return out
+                cap = min(cap * 8, user_cap)
+        finally:
+            w = getattr(self, "_ckpt_writer_obj", None)
+            if w is not None:
+                # An async dump still draining must land before the
+                # caller sees the outcome (kill-resume depends on it).
+                w.join()
+
+    def _carry_from_ckpt(self, ck, cap: int):
+        """Rebuild the device carry from a unified checkpoint
+        (tpu/checkpoint.py): frontier rows pad back to the buffer, the
+        visited table is rebuilt by RE-INSERTING the dumped keys (layout
+        is engine-local; the key SET is the semantic content), and the
+        never-dumped accumulators come back empty — exactly their state
+        at a wave boundary."""
+        lanes = self.lanes
+        V = self.visited_cap
+        nf = len(self._flag_names)
+        n = len(ck.frontier)
+        cur = np.zeros((cap, lanes), np.int32)
+        if n:
+            cur[:n] = ck.frontier
+        keys = jnp.asarray(ck.visited_keys)
+        table, ins, unres = visited_mod.insert(
+            visited_mod.empty_table(V), keys,
+            jnp.ones((keys.shape[0],), bool))
+        n_unres = int(np.asarray(jnp.sum(unres)))
+        if n_unres:
+            raise CapacityOverflow(
+                f"{self.p.name}: visited_cap={V} too small to rebuild "
+                f"the checkpoint's visited set ({n_unres} of "
+                f"{keys.shape[0]} keys unresolved); raise visited_cap")
+        return {
+            "cur": jnp.asarray(cur),
+            "cur_n": jnp.asarray([n], jnp.int32),
+            "j": jnp.zeros((1,), jnp.int32),
+            "evp": jnp.zeros((1,), jnp.int32),
+            "nxt": jnp.zeros((cap + 1, lanes), jnp.int32),
+            "nxt_n": jnp.zeros((1,), jnp.int32),
+            "visited": table,
+            "vis_n": jnp.asarray([int(np.asarray(jnp.sum(ins)))],
+                                 jnp.int32),
+            "explored": jnp.asarray([ck.explored], jnp.int32),
+            "overflow": jnp.zeros((1,), jnp.int32),
+            "vis_over": jnp.asarray([ck.vis_over], jnp.int32),
+            "f_drop": jnp.zeros((1,), jnp.int32),
+            "flag_cnt": jnp.zeros((nf,), jnp.int32),
+            "flag_rows": jnp.zeros((nf, lanes), jnp.int32),
+        }
+
+    def _write_dev_ckpt(self, carry, depth: int, explored: int,
+                        vis_over: int, nxt_n: int,
+                        elapsed: float) -> None:
+        """Snapshot the wave-boundary carry into the unified checkpoint:
+        the occupied frontier prefix + the occupied visited-table lines
+        + counters — never the empty accumulators or buffer padding."""
+        if nxt_n:
+            frontier = np.asarray(carry["cur"][:nxt_n])
+        else:
+            frontier = np.zeros((0, self.lanes), np.int32)
+        table = np.asarray(carry["visited"])[:-1]
+        occ = ~(table == visited_mod.MAXU32).all(axis=1)
+        self._kick_ckpt(frontier, table[occ], depth, explored, elapsed,
+                        vis_over)
 
     def _device_attempt(self, state, cap: int, user_cap: int,
-                        t0) -> Optional[SearchOutcome]:
+                        t0, ck=None) -> Optional[SearchOutcome]:
         """One run at a fixed frontier-buffer capacity; None = frontier
-        overflowed below the user cap (caller grows and restarts)."""
+        overflowed below the user cap (caller grows and restarts).
+        ``ck`` (a loaded SearchCheckpoint) seeds the carry from a dump
+        instead of the root."""
         import time
 
         p = self.p
         C = self.chunk
         step, promote, init = self._dev_programs(cap)
         rt = getattr(self, "_rt_masks", None)
-        carry = init(flatten_state(state))
+        if ck is not None:
+            carry = self._carry_from_ckpt(ck, cap)
+            if not len(ck.frontier):
+                # A dump saved after the final wave: the search already
+                # ended; report the finished verdict from the counters.
+                return SearchOutcome(
+                    "SPACE_EXHAUSTED", ck.explored,
+                    len(ck.visited_keys), ck.depth, time.time() - t0,
+                    visited_overflow=ck.vis_over)
+        else:
+            carry = self._dispatch("device.init", init,
+                                   flatten_state(state))
         sdev = None        # stats vector of the latest dispatched step
         # With a finite ev_budget a chunk can spill extra window passes,
         # holding j back — then the sync must watch j and re-dispatch,
         # which precludes the pre-sync speculative dispatch below.
         spill = (self._ev_msg < p.net_cap
                  or self._ev_tmr < p.n_nodes * p.timer_cap)
-        depth = 0
-        n_chunks = 1
+        if ck is not None:
+            depth = ck.depth
+            n_chunks = max(1, -(-len(ck.frontier) // C))
+            last = (ck.explored, len(ck.visited_keys), ck.vis_over)
+        else:
+            depth = 0
+            n_chunks = 1
+            last = (0, 1, 0)   # (explored, unique, vis_over) at last sync
         spec = 0           # chunks of the current wave already dispatched
-        last = (0, 1, 0)   # (explored, unique, vis_over) at the last sync
         while True:
             if (self.max_secs is not None
                     and time.time() - t0 > self.max_secs):
@@ -1781,16 +2018,23 @@ class TensorSearch:
                     "DEPTH_EXHAUSTED", last[0], last[1], depth,
                     time.time() - t0, visited_overflow=last[2])
             depth += 1
+            # A checkpoint-due wave skips the speculative next-wave
+            # dispatch: the snapshot must see the carry at a clean wave
+            # boundary, not mid-way through wave depth+1.
+            ckpt_due = bool(self.checkpoint_path and self.checkpoint_every
+                            and depth % self.checkpoint_every == 0)
             for _ in range(n_chunks - spec):
-                carry, sdev = step(carry, rt)
+                carry, sdev = self._dispatch("device.step", step,
+                                             carry, rt)
             if spill:
                 while True:
-                    s = device_get(sdev)
+                    s = self._dispatch("device.sync", device_get, sdev)
                     if int(s[6]) >= n_chunks:
                         break
                     for _ in range(n_chunks - int(s[6])):
-                        carry, sdev = step(carry, rt)
-                carry = promote(carry)
+                        carry, sdev = self._dispatch("device.step", step,
+                                                     carry, rt)
+                carry = self._dispatch("device.promote", promote, carry)
                 spec = 0
             else:
                 # Double-buffering: the next wave's promotion AND its
@@ -1806,13 +2050,14 @@ class TensorSearch:
                 # last wave's speculative dispatch (n_chunks == spec),
                 # its stats vector is already in hand.
                 wave_stats = sdev
-                carry = promote(carry)
-                if n_chunks > 1:
-                    carry, sdev = step(carry, rt)
+                carry = self._dispatch("device.promote", promote, carry)
+                if n_chunks > 1 and not ckpt_due:
+                    carry, sdev = self._dispatch("device.step", step,
+                                                 carry, rt)
                     spec = 1
                 else:
                     spec = 0
-                s = device_get(wave_stats)
+                s = self._dispatch("device.sync", device_get, wave_stats)
             (explored, overflow, vis_over, f_drop, vis_n,
              nxt_n) = (int(x) for x in s[:6])
             flag_counts = np.asarray(s[7:])
@@ -1844,6 +2089,13 @@ class TensorSearch:
                 return SearchOutcome(
                     "CAPACITY_EXHAUSTED", explored, vis_n, depth,
                     time.time() - t0, visited_overflow=vis_over)
+            if ckpt_due:
+                # Carry is at a clean wave boundary (spec == 0): cur is
+                # wave depth+1's frontier, counters are cumulative.
+                # Host copies happen HERE (before the next wave donates
+                # the buffers); the file write drains asynchronously.
+                self._write_dev_ckpt(carry, depth, explored, vis_over,
+                                     nxt_n, time.time() - t0)
             if nxt_n == 0:
                 return SearchOutcome(
                     "SPACE_EXHAUSTED", explored, vis_n, depth,

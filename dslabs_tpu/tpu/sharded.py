@@ -113,15 +113,17 @@ class ShardedTensorSearch(TensorSearch):
                  checkpoint_every: int = 0):
         # Frontier checkpointing (SURVEY §5 "dump SoA tensors"): every
         # ``checkpoint_every`` levels the live carry — the OCCUPIED
-        # frontier prefix, the visited table, and the counters; never the
-        # empty accumulators or f_cap padding — is snapshotted into fresh
-        # device buffers and drained to ``checkpoint_path`` (atomic .npz
-        # rename) by a background thread while the next levels compute
-        # (see the checkpointing section below).  ``run(resume=True)``
+        # frontier prefix, the occupied visited-table lines, and the
+        # counters; never the empty accumulators or f_cap padding — is
+        # snapshotted into fresh device buffers and drained to
+        # ``checkpoint_path`` (atomic .npz rename) by a background
+        # thread while the next levels compute (see the checkpointing
+        # section below).  The dump is the UNIFIED engine-agnostic
+        # format (tpu/checkpoint.py) — the single-device and host
+        # engines resume the same file, which is what makes supervisor
+        # failover (tpu/supervisor.py) resumable.  ``run(resume=True)``
         # continues a killed search from the last dump with identical
         # final verdict and unique count.  0 = off.
-        self.checkpoint_path = checkpoint_path
-        self.checkpoint_every = checkpoint_every
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
         self.n_devices = int(mesh.devices.size)
@@ -167,7 +169,9 @@ class ShardedTensorSearch(TensorSearch):
                          max_secs=max_secs,
                          in_chunk_dedup=strict and self.n_devices > 1,
                          ev_budget=ev_budget, record_trace=record_trace,
-                         visited_cap=visited_cap, strict=strict)
+                         visited_cap=visited_cap, strict=strict,
+                         checkpoint_path=checkpoint_path,
+                         checkpoint_every=checkpoint_every)
         # Trace mode: each level spills (child_fp, parent_fp, event_id)
         # for every appended successor; reconstruction walks fingerprints
         # back to the root on the HOST (fps are stable identities, so the
@@ -470,11 +474,14 @@ class ShardedTensorSearch(TensorSearch):
 
     def _step(self, carry):
         """Dispatch one chunk step, passing the runtime masks when the
-        protocol declares them."""
+        protocol declares them.  Routed through the supervisor's
+        dispatch boundary (engine._dispatch) like every hot-loop
+        dispatch."""
         rt = getattr(self, "_rt_masks", None)
         if rt is not None:
-            return self._chunk_step(carry, rt)
-        return self._chunk_step(carry)
+            return self._dispatch("sharded.step", self._chunk_step,
+                                  carry, rt)
+        return self._dispatch("sharded.step", self._chunk_step, carry)
 
     def _build_finish(self):
         """Promote nxt -> cur between levels, REBALANCING the frontier
@@ -588,7 +595,8 @@ class ShardedTensorSearch(TensorSearch):
 
         init = jax.jit(build, out_shardings={
             k: shard for k in self._carry_specs()})
-        return init(rows0[0], jnp.asarray(key0))
+        return self._dispatch("sharded.init", init, rows0[0],
+                              jnp.asarray(key0))
 
     def _terminal_from_flags(self, carry, explored, vis_total, depth, t0):
         """Resolve the first terminal flag (checkState order) from the
@@ -693,110 +701,151 @@ class ShardedTensorSearch(TensorSearch):
             return fn(carry)
 
     def _write_checkpoint(self, snap, depth: int, elapsed: float) -> None:
-        """Background-thread half: host readback + atomic npz write."""
-        host = {f"carry_{k}": np.asarray(v) for k, v in snap.items()}
-        host["depth"] = np.int64(depth)
-        host["elapsed"] = np.float64(elapsed)
-        host["config"] = np.bytes_(self._ckpt_signature())
+        """Background-thread half: host readback + conversion to the
+        UNIFIED engine-agnostic format (tpu/checkpoint.py) + atomic npz
+        write.  The dump stores the semantic search state — live
+        frontier rows (all shards concatenated) and the occupied
+        visited-table lines — not this engine's carry layout, so any
+        ladder rung can resume it."""
+        from dslabs_tpu.tpu import checkpoint as ckpt_mod
+
+        D = self.n_devices
+        cur = np.asarray(snap["cur"]).reshape(D, -1, self.lanes)
+        cur_n = np.asarray(snap["cur_n"]).reshape(-1)
+        parts = [cur[d, :cur_n[d]] for d in range(D)]
+        frontier = (np.concatenate(parts) if cur_n.sum()
+                    else np.zeros((0, self.lanes), np.int32))
+        vis = np.asarray(snap["visited"]).reshape(
+            D, self.v_cap + 1, 4)[:, :-1]
+        occ = ~(vis == MAXU32).all(axis=2)
+        fp_map = None
         if self.record_trace and self._fp_map:
-            items = [(k + v[0] + (v[1],)) for k, v in self._fp_map.items()]
-            host["fp_map"] = np.asarray(items, dtype=np.int64)
-        tmp = self.checkpoint_path + ".tmp"
-        with open(tmp, "wb") as f:
-            np.savez(f, **host)
-        os.replace(tmp, self.checkpoint_path)
+            fp_map = np.asarray(
+                [(k + v[0] + (v[1],)) for k, v in self._fp_map.items()],
+                dtype=np.int64)
+        ckpt_mod.save(self.checkpoint_path, ckpt_mod.SearchCheckpoint(
+            fingerprint=self._ckpt_fingerprint(), depth=depth,
+            explored=int(np.asarray(snap["explored"]).sum()),
+            elapsed=elapsed, frontier=frontier, visited_keys=vis[occ],
+            vis_over=int(np.asarray(snap["vis_over"]).sum()),
+            dropped=int(np.asarray(snap["drops"]).sum()),
+            fp_map=fp_map))
 
     def _save_checkpoint(self, carry, depth: int, elapsed: float,
                          max_n: int = None) -> None:
         """Kick an async checkpoint; skipped (not queued) while a prior
-        dump is still draining."""
-        import threading
-
-        th = getattr(self, "_ckpt_thread", None)
-        if th is not None and th.is_alive():
+        dump is still draining (checkpoint.AsyncCheckpointWriter)."""
+        if self._ckpt_writer.busy():
             return
         snap = self._snapshot_checkpoint(
             carry, max_n if max_n is not None else self.f_cap)
-        th = threading.Thread(target=self._write_checkpoint,
-                              args=(snap, depth, elapsed), daemon=True)
-        self._ckpt_thread = th
-        th.start()
+        self._ckpt_writer.kick(
+            lambda: self._write_checkpoint(snap, depth, elapsed))
 
     def _join_checkpoint(self) -> None:
-        th = getattr(self, "_ckpt_thread", None)
-        if th is not None and th.is_alive():
-            th.join()
-
-    def _ckpt_signature(self) -> str:
-        # "v5": carry layout gained vis_over (the shared visited.py
-        # table's treat-as-fresh overflow counter); older dumps must not
-        # resume into a step program that expects the new key.
-        return repr(("v5", self.p.name, self.f_cap, self.v_cap, self.cpd,
-                     self.n_devices, self._ev_msg, self._ev_tmr,
-                     self.strict, self.ev_spill, self.record_trace))
-
-    def has_resumable_checkpoint(self) -> bool:
-        """Existence + config-signature check WITHOUT loading the carry
-        (the full load device_puts hundreds of MB; callers that only
-        need a boolean must not pay that twice)."""
-        if (not self.checkpoint_path
-                or not os.path.exists(self.checkpoint_path)):
-            return False
-        try:
-            with np.load(self.checkpoint_path) as z:
-                return ("config" in z.files and
-                        z["config"].item().decode()
-                        == self._ckpt_signature())
-        except Exception:
-            return False
+        self._ckpt_writer.join()
 
     def _load_checkpoint(self):
-        """-> (carry on device, depth, elapsed) or None (no dump, or a
-        dump from a DIFFERENT configuration — never resumed silently).
-        Rebuilds the full carry from the incremental dump: the frontier
-        prefix pads back to f_cap and the never-dumped parts (nxt, loop
-        counters, trace meta) are rebuilt empty — exactly their state at
-        a level boundary."""
-        if (not self.checkpoint_path
-                or not os.path.exists(self.checkpoint_path)):
+        """-> (carry on device, depth, elapsed) or None (no dump).  A
+        dump from a DIFFERENT protocol/capacity configuration raises a
+        loud :class:`~dslabs_tpu.tpu.checkpoint.CheckpointMismatch`
+        naming both fingerprints — never resumed (or skipped) silently.
+        Rebuilds the full sharded carry from the unified dump: frontier
+        rows re-split into contiguous per-device shares, visited keys
+        RE-INSERTED into each owner's shard table (owner = key lane 0
+        mod D — the same routing the chunk step uses), and the
+        never-dumped parts (nxt, loop counters, trace meta) rebuilt
+        empty — exactly their state at a level boundary."""
+        ck = self._load_ckpt()
+        if ck is None:
             return None
-        z = np.load(self.checkpoint_path)
-        if ("config" not in z.files
-                or z["config"].item().decode() != self._ckpt_signature()):
-            return None
-        shard = NamedSharding(self.mesh, P(self.axis))
-        snap = {k[len("carry_"):]: jax.device_put(z[k], shard)
-                for k in z.files if k.startswith("carry_")}
-        D, F, lanes = self.n_devices, self.f_cap, self.lanes
-        m = snap["cur"].shape[0] // D
+        if ck.fp_map is not None:
+            self._fp_map = {tuple(r[:4]): (tuple(r[4:8]), int(r[8]))
+                            for r in ck.fp_map.tolist()}
+        return self._resume_carry(ck), ck.depth, ck.elapsed
+
+    def _resume_carry(self, ck):
+        D, F, V, lanes = self.n_devices, self.f_cap, self.v_cap, self.lanes
         nf = len(self._flag_names)
-        spec = self._carry_specs()
-        snap_spec = {k: spec[k] for k in snap}
+        n = len(ck.frontier)
+        if -(-n // D) > F:
+            raise CapacityOverflow(
+                f"{self.p.name}: frontier_cap {F}/device too small to "
+                f"resume {n} checkpointed frontier rows on {D} devices")
+        per = max(1, -(-n // D))
+        cur = np.zeros((D, per, lanes), np.int32)
+        cur_n = np.zeros((D,), np.int32)
+        for d in range(D):
+            rows = ck.frontier[d * per:(d + 1) * per]
+            cur[d, :len(rows)] = rows
+            cur_n[d] = len(rows)
+        keys = ck.visited_keys
+        owner = (keys[:, 0].astype(np.uint64)
+                 % np.uint64(D)).astype(np.int64)
+        groups = [keys[owner == d] for d in range(D)]
+        kmax = max([len(g) for g in groups] + [1])
+        kbuf = np.zeros((D, kmax, 4), np.uint32)
+        kval = np.zeros((D, kmax), bool)
+        for d, g in enumerate(groups):
+            kbuf[d, :len(g)] = g
+            kval[d, :len(g)] = True
+
+        def spread0(v):
+            a = np.zeros((D,), np.int32)
+            a[0] = v
+            return a
+
+        shard = NamedSharding(self.mesh, P(self.axis))
+        dev_in = {k: jax.device_put(v, shard) for k, v in {
+            "cur0": cur.reshape(D * per, lanes),
+            "cur_n": cur_n,
+            "keys": kbuf.reshape(D * kmax, 4),
+            "kval": kval.reshape(D * kmax),
+            "explored": spread0(ck.explored),
+            "vis_over": spread0(ck.vis_over),
+            "drops": spread0(ck.dropped),
+        }.items()}
 
         def local(s):
-            out = dict(s)
-            out["cur"] = jnp.zeros((F, lanes), jnp.int32).at[:m].set(
-                s["cur"])
-            out["j"] = jnp.zeros((1,), jnp.int32)
-            out["evp"] = jnp.zeros((1,), jnp.int32)
-            out["noapp"] = jnp.zeros((1,), jnp.int32)
-            out["nxt"] = jnp.zeros((F + 1, lanes), jnp.int32)
-            out["nxt_n"] = jnp.zeros((1,), jnp.int32)
+            table, ins, unres = visited_mod.insert(
+                visited_mod.empty_table(V), s["keys"], s["kval"])
+            out = {
+                "cur": jnp.zeros((F, lanes), jnp.int32).at[:per].set(
+                    s["cur0"]),
+                "cur_n": s["cur_n"],
+                "j": jnp.zeros((1,), jnp.int32),
+                "evp": jnp.zeros((1,), jnp.int32),
+                "noapp": jnp.zeros((1,), jnp.int32),
+                "nxt": jnp.zeros((F + 1, lanes), jnp.int32),
+                "nxt_n": jnp.zeros((1,), jnp.int32),
+                "visited": table,
+                "vis_n": jnp.sum(ins).astype(jnp.int32)[None],
+                "explored": s["explored"],
+                "overflow": jnp.zeros((1,), jnp.int32),
+                "vis_over": s["vis_over"],
+                "drops": s["drops"],
+                "flag_cnt": jnp.zeros((nf,), jnp.int32),
+                "flag_rows": jnp.zeros((nf, lanes), jnp.int32),
+            }
             if self.record_trace:
                 out["tmeta"] = jnp.zeros((F + 1, 9), jnp.uint32)
                 out["flag_meta"] = jnp.zeros((nf, 9), jnp.uint32)
-            return out
+            return out, jnp.sum(unres).astype(jnp.int32)[None]
 
-        fn = jax.jit(shard_map(local, mesh=self.mesh,
-                               in_specs=(snap_spec,), out_specs=spec,
-                               check_rep=False))
+        ax = self.axis
+        in_spec = {k: P(ax) for k in dev_in}
+        fn = jax.jit(shard_map(
+            local, mesh=self.mesh, in_specs=(in_spec,),
+            out_specs=(self._carry_specs(), P(ax)), check_rep=False))
         with self.mesh:
-            carry = fn(snap)
-        if "fp_map" in z.files:
-            rows = z["fp_map"]
-            self._fp_map = {tuple(r[:4]): (tuple(r[4:8]), int(r[8]))
-                            for r in rows.tolist()}
-        return carry, int(z["depth"]), float(z["elapsed"])
+            carry, unres = fn(dev_in)
+        n_unres = int(np.asarray(unres).sum())
+        if n_unres:
+            raise CapacityOverflow(
+                f"{self.p.name}: visited_cap={V}/device too small to "
+                f"rebuild the checkpoint's visited set ({n_unres} keys "
+                "unresolved); raise visited_cap")
+        return carry
 
     def run(self, check_initial: bool = True,
             initial: Optional[dict] = None,
@@ -942,7 +991,8 @@ class ShardedTensorSearch(TensorSearch):
                         visited_overflow=getattr(self, "_vis_over", 0))
                 if self.record_trace:
                     self._spill_tmeta(carry)
-                carry = self._finish_level(carry)
+                carry = self._dispatch("sharded.promote",
+                                       self._finish_level, carry)
                 if (self.checkpoint_every and self.checkpoint_path
                         and depth % self.checkpoint_every == 0):
                     self._save_checkpoint(carry, depth, time.time() - t0,
@@ -1021,7 +1071,7 @@ class ShardedTensorSearch(TensorSearch):
         (outcome_or_none, explored, vis_total, drops, nxt_max, j_done)
         where j_done is the slowest device's completed-chunk count (the
         spill re-dispatch signal)."""
-        s = np.asarray(self._stats(carry))
+        s = np.asarray(self._dispatch("sharded.sync", self._stats, carry))
         (overflow, drops, vis_over, explored, vis_max, vis_total, nxt_max,
          j_done) = (int(x) for x in s[:8])
         flag_counts = s[8:]

@@ -1,0 +1,494 @@
+"""Fault-tolerant search supervisor: retry, watchdog, engine failover.
+
+The north-star deployment is an hours-long accelerator job, and before
+this module ANY transient device error, preemption, or wedged TPU killed
+a run outright.  The supervisor gives the framework the same spine a
+production training/inference stack assumes:
+
+* **One dispatch boundary.**  Every device dispatch in the hot loops —
+  the sharded chunk step / level promote / stats sync (sharded.py), the
+  single-device wave step / promote / scalar sync (engine.py
+  ``_run_device``), and the host loop's expand — funnels through
+  ``TensorSearch._dispatch(tag, fn, *args)``.  With no supervisor
+  installed that is a zero-cost passthrough; the supervisor installs a
+  :class:`DispatchBoundary` there.
+* **Failure classification + bounded retry.**  Transient runtime errors
+  (XLA RESOURCE_EXHAUSTED / UNAVAILABLE / ABORTED, preemptions,
+  :class:`TransientDeviceError` from the fault harness) retry in place
+  with exponential backoff + deterministic jitter up to
+  ``RetryPolicy.max_retries``.  Fatal errors and exhausted budgets
+  raise :class:`EngineFailure`.
+* **Wall-clock watchdog.**  With ``RetryPolicy.deadline_secs`` set,
+  each dispatch runs on a watchdog thread; a dispatch exceeding its
+  deadline (wedged device) is ABANDONED — :class:`DispatchTimeout`,
+  classified wedged, no retry — and the supervisor restarts on the
+  next rung from the last checkpoint.  ``bench.py``'s wedged-TPU
+  preflight is a thin client (:func:`probe_device`).
+* **Engine failover ladder.**  :class:`SearchSupervisor` runs the
+  search on the first healthy rung of ``sharded -> device -> host``
+  (the host loop is the parity oracle — every rung has identical
+  verdict semantics), resuming each rung from the shared
+  engine-agnostic checkpoint (tpu/checkpoint.py) when one exists.
+  Semantic errors (``CapacityOverflow``, ``CheckpointMismatch``)
+  propagate unchanged — failover can never mask a wrong-config verdict.
+* **Deterministic fault injection.**  A :class:`FaultPlan` installed at
+  the same boundary makes every recovery path exercisable in CI on CPU
+  ("dispatch k of engine E raises", "dispatch j hangs") — see
+  tests/test_supervisor.py and ``make fault-smoke``.
+
+Every recovery ends in the normal ``SearchOutcome`` end-condition
+vocabulary — never a silent partial verdict — with ``retries``,
+``failovers``, ``engine``, and ``resumed_from_depth`` reported on the
+outcome.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from dslabs_tpu.tpu import checkpoint as ckpt_mod
+
+__all__ = ["TransientDeviceError", "DispatchTimeout", "EngineFailure",
+           "SupervisorExhausted", "RetryPolicy", "FaultRule", "FaultPlan",
+           "DispatchBoundary", "SearchSupervisor", "classify_failure",
+           "install_retry", "probe_device"]
+
+
+class TransientDeviceError(RuntimeError):
+    """A retryable device/runtime failure (the injectable stand-in for
+    an XLA transient status on real hardware)."""
+
+
+class DispatchTimeout(RuntimeError):
+    """A dispatch exceeded its wall-clock deadline (wedged device).
+    Never retried in place — the dispatch was abandoned, so the rung's
+    device state is unknown; recovery is failover-from-checkpoint."""
+
+
+class EngineFailure(RuntimeError):
+    """A rung of the ladder failed past recovery-in-place.  ``kind`` is
+    ``"fatal"`` / ``"retries_exhausted"`` / ``"wedged"``; ``cause`` is
+    the underlying exception."""
+
+    def __init__(self, engine: str, kind: str, cause: BaseException):
+        super().__init__(f"{engine} engine failed ({kind}): "
+                         f"{type(cause).__name__}: {cause}")
+        self.engine = engine
+        self.kind = kind
+        self.cause = cause
+
+
+class SupervisorExhausted(RuntimeError):
+    """Every rung of the failover ladder failed.  ``failures`` holds the
+    per-rung :class:`EngineFailure` chain — the full recovery story is
+    attributable, never a bare crash."""
+
+    def __init__(self, failures: List[EngineFailure]):
+        super().__init__(
+            "all failover rungs failed: "
+            + "; ".join(str(f) for f in failures))
+        self.failures = failures
+
+
+# Status markers that make a real runtime error retryable: the set a
+# production JAX stack treats as preemption/transient (jaxlib surfaces
+# them inside XlaRuntimeError messages).
+_TRANSIENT_MARKERS = ("RESOURCE_EXHAUSTED", "UNAVAILABLE", "ABORTED",
+                      "DEADLINE_EXCEEDED", "preempt", "slice restart",
+                      "connection reset")
+# Exception TYPE NAMES treated as runtime-layer errors (matched by name:
+# jaxlib's concrete classes move between versions and must not be a hard
+# import dependency).
+_RUNTIME_ERROR_NAMES = ("XlaRuntimeError", "JaxRuntimeError")
+
+# Errors the boundary must NEVER absorb: semantic/config failures where
+# retry or failover would mask a wrong answer, plus interrupts.
+def _passthrough_types() -> tuple:
+    from dslabs_tpu.tpu.engine import CapacityOverflow
+
+    return (CapacityOverflow, ckpt_mod.CheckpointMismatch,
+            KeyboardInterrupt, SystemExit)
+
+
+def classify_failure(exc: BaseException) -> str:
+    """``"transient"`` (retry in place), ``"wedged"`` (abandon, fail
+    over), or ``"fatal"`` (fail over)."""
+    if isinstance(exc, DispatchTimeout):
+        return "wedged"
+    if isinstance(exc, TransientDeviceError):
+        return "transient"
+    if type(exc).__name__ in _RUNTIME_ERROR_NAMES or isinstance(
+            exc, MemoryError):
+        msg = str(exc)
+        if any(m.lower() in msg.lower() for m in _TRANSIENT_MARKERS):
+            return "transient"
+    return "fatal"
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded-retry + watchdog knobs (docs/resilience.md)."""
+
+    max_retries: int = 3          # per ENGINE rung, across its dispatches
+    backoff_base: float = 0.05    # first-retry sleep, seconds
+    backoff_factor: float = 2.0
+    backoff_max: float = 2.0
+    jitter: float = 0.25          # +/- fraction of the backoff, seeded
+    deadline_secs: Optional[float] = None   # per-dispatch watchdog; None = off
+    # Watchdog deadline for the FIRST dispatch at each (engine, site)
+    # tag: that call pays the XLA compile, which dwarfs a steady-state
+    # dispatch — a steady-state deadline would misread every cold
+    # compile as a wedge.  None = 10 x deadline_secs.
+    deadline_first_secs: Optional[float] = None
+    seed: int = 0
+
+    def first_deadline(self) -> Optional[float]:
+        if self.deadline_secs is None:
+            return None
+        if self.deadline_first_secs is not None:
+            return self.deadline_first_secs
+        return 10.0 * self.deadline_secs
+
+
+@dataclasses.dataclass
+class FaultRule:
+    """One deterministic fault: dispatches ``at .. at+count-1`` of
+    ``engine`` (None = any rung) either raise ``error()`` or hang for
+    ``hang_secs`` (interruptibly — the watchdog's abandon releases the
+    thread).  ``count=None`` fires forever."""
+
+    kind: str                      # "raise" | "hang"
+    at: int = 0
+    count: Optional[int] = 1
+    engine: Optional[str] = None
+    error: type = TransientDeviceError
+    message: str = "injected fault"
+    hang_secs: float = 3600.0
+
+
+class FaultPlan:
+    """A deterministic schedule of dispatch-boundary faults.
+
+    Indexing is per-engine: each rung counts its own dispatches from 0,
+    and RETRIES ADVANCE THE INDEX (a retry is a new dispatch), so
+    ``raise_at(k, count=2)`` means "the dispatch reaching index k fails,
+    its first retry fails too, the second retry succeeds"."""
+
+    def __init__(self):
+        self.rules: List[FaultRule] = []
+        self.fired: int = 0
+
+    def raise_at(self, at: int, error: type = TransientDeviceError,
+                 engine: Optional[str] = None, count: Optional[int] = 1,
+                 message: str = "injected fault") -> "FaultPlan":
+        self.rules.append(FaultRule("raise", at=at, count=count,
+                                    engine=engine, error=error,
+                                    message=message))
+        return self
+
+    def raise_always(self, error: type = TransientDeviceError,
+                     engine: Optional[str] = None,
+                     message: str = "injected fault") -> "FaultPlan":
+        return self.raise_at(0, error=error, engine=engine, count=None,
+                             message=message)
+
+    def hang_at(self, at: int, engine: Optional[str] = None,
+                secs: float = 3600.0,
+                count: Optional[int] = 1) -> "FaultPlan":
+        self.rules.append(FaultRule("hang", at=at, count=count,
+                                    engine=engine, hang_secs=secs))
+        return self
+
+    def match(self, engine: str, index: int) -> Optional[FaultRule]:
+        for r in self.rules:
+            if r.engine is not None and r.engine != engine:
+                continue
+            if index < r.at:
+                continue
+            if r.count is not None and index >= r.at + r.count:
+                continue
+            self.fired += 1
+            return r
+        return None
+
+
+class DispatchBoundary:
+    """The retry/watchdog/fault-injection wrapper every hot-loop device
+    dispatch funnels through (``TensorSearch._dispatch``).
+
+    Install on a search with :meth:`install`; tags are
+    ``"<engine>.<site>"`` (e.g. ``"sharded.step"``) and the engine half
+    keys both the fault plan and the per-rung dispatch/retry counters.
+    """
+
+    def __init__(self, policy: Optional[RetryPolicy] = None,
+                 plan: Optional[FaultPlan] = None):
+        self.policy = policy or RetryPolicy()
+        self.plan = plan
+        self.retries = 0
+        self.timeouts = 0
+        self.counts: Dict[str, int] = {}
+        self._engine_retries: Dict[str, int] = {}
+        self._rng = random.Random(self.policy.seed)
+
+    def install(self, search, engine: Optional[str] = None) -> None:
+        """Route ``search``'s dispatches through this boundary.  The
+        optional ``engine`` override renames the tag prefix (the
+        supervisor uses the rung name so plans written against the
+        ladder vocabulary match)."""
+        if engine is None:
+            search._dispatch_hook = self.dispatch
+        else:
+            def hook(tag, fn, *args, _e=engine):
+                return self.dispatch(
+                    _e + "." + tag.split(".", 1)[-1], fn, *args)
+            search._dispatch_hook = hook
+
+    # ------------------------------------------------------------ dispatch
+
+    def dispatch(self, tag: str, fn, *args):
+        engine = tag.split(".", 1)[0]
+        passthrough = _passthrough_types()
+        while True:
+            idx = self.counts.get(engine, 0)
+            self.counts[engine] = idx + 1
+            rule = self.plan.match(engine, idx) if self.plan else None
+            try:
+                if rule is not None and rule.kind == "raise":
+                    # Raised BEFORE fn runs: the dispatch args (donated
+                    # carries included) are untouched, so a retry of the
+                    # same call is always well-defined.
+                    raise rule.error(f"{rule.message} "
+                                     f"[{engine} dispatch {idx}]")
+                if self.policy.deadline_secs is not None:
+                    return self._watchdog_call(tag, fn, args, rule)
+                return fn(*args)
+            except passthrough:
+                raise
+            except DispatchTimeout as e:
+                # The abandoned dispatch may have consumed its donated
+                # buffers; there is nothing sound to retry in place.
+                self.timeouts += 1
+                raise EngineFailure(engine, "wedged", e)
+            except Exception as e:  # noqa: BLE001 — classified below
+                if classify_failure(e) != "transient":
+                    raise EngineFailure(engine, "fatal", e)
+                used = self._engine_retries.get(engine, 0)
+                if used >= self.policy.max_retries:
+                    raise EngineFailure(engine, "retries_exhausted", e)
+                self._engine_retries[engine] = used + 1
+                self.retries += 1
+                time.sleep(self._backoff(used))
+
+    def _backoff(self, attempt: int) -> float:
+        p = self.policy
+        base = min(p.backoff_base * (p.backoff_factor ** attempt),
+                   p.backoff_max)
+        # Deterministic jitter (seeded RNG): desynchronises retry storms
+        # without making CI runs unreproducible.
+        return base * (1.0 + p.jitter * (2.0 * self._rng.random() - 1.0))
+
+    def _watchdog_call(self, tag: str, fn, args, rule):
+        """Run one dispatch on a watchdog thread; abandon it at the
+        deadline.  The first dispatch at each tag gets the compile-
+        inclusive grace deadline (RetryPolicy.first_deadline).  An
+        injected hang waits interruptibly AND checks for abandonment
+        before touching the real dispatch, so an abandoned fault thread
+        exits cleanly instead of racing device work in the background."""
+        release = threading.Event()
+        box: List[Tuple[str, object]] = []
+        seen = getattr(self, "_seen_tags", None)
+        if seen is None:
+            seen = self._seen_tags = set()
+        deadline = (self.policy.deadline_secs if tag in seen
+                    else self.policy.first_deadline())
+        seen.add(tag)
+
+        def work():
+            try:
+                if rule is not None and rule.kind == "hang":
+                    release.wait(rule.hang_secs)
+                    if release.is_set():
+                        return          # abandoned: never run the dispatch
+                box.append(("ok", fn(*args)))
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                box.append(("err", e))
+
+        th = threading.Thread(target=work, daemon=True,
+                              name=f"dslabs-dispatch-{tag}")
+        th.start()
+        th.join(deadline)
+        if th.is_alive():
+            release.set()
+            raise DispatchTimeout(
+                f"dispatch {tag!r} exceeded its {deadline}s deadline "
+                "(wedged device); abandoned")
+        kind, val = box[0]
+        if kind == "err":
+            raise val
+        return val
+
+
+def install_retry(search, policy: Optional[RetryPolicy] = None,
+                  plan: Optional[FaultPlan] = None) -> DispatchBoundary:
+    """Wrap a single engine's dispatches with retry/backoff (no ladder):
+    the light-touch entry point the search backend uses so lab searches
+    survive transient device errors without changing verdict flow."""
+    boundary = DispatchBoundary(policy, plan)
+    boundary.install(search)
+    return boundary
+
+
+def probe_device(deadline_secs: float = 60.0) -> dict:
+    """Watchdog-bounded accelerator liveness probe: a tiny matmul
+    through the same dispatch boundary the search loops use.  Returns
+    ``{platform, n_devices, secs}``; a wedged runtime surfaces as
+    :class:`EngineFailure` (kind ``wedged``) instead of a hang —
+    ``bench.py``'s preflight is a thin client of this."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    t0 = time.time()
+    boundary = DispatchBoundary(
+        RetryPolicy(max_retries=0, deadline_secs=deadline_secs))
+    devs = jax.devices()
+
+    def _mm():
+        x = jnp.ones((256, 256), jnp.float32)
+        return jax.block_until_ready(x @ x)
+
+    y = boundary.dispatch("probe.matmul", _mm)
+    if float(np.asarray(y)[0, 0]) != 256.0:
+        raise RuntimeError("probe matmul returned a wrong result")
+    return {"platform": devs[0].platform, "n_devices": len(devs),
+            "secs": round(time.time() - t0, 1)}
+
+
+# ------------------------------------------------------------- supervisor
+
+class SearchSupervisor:
+    """Run a tensor search with retry, watchdog, checkpointing, and the
+    engine failover ladder.
+
+    ``ladder`` names the rungs to try in order (default
+    ``("sharded", "device", "host")``); each rung is built from the
+    shared protocol/limits, has the boundary installed, and — when a
+    ``checkpoint_path`` is configured and a fingerprint-matching dump
+    exists — resumes from the last checkpoint instead of the root.  A
+    rung that fails past recovery (fatal error, exhausted retries,
+    wedged dispatch) is abandoned and the next rung takes over; its
+    verdict is identical by construction (the host loop is the parity
+    oracle the device engines are tested against).  The returned
+    ``SearchOutcome`` carries ``retries`` / ``failovers`` / ``engine``
+    / ``resumed_from_depth`` so no degradation is ever silent."""
+
+    def __init__(self, protocol,
+                 ladder: Tuple[str, ...] = ("sharded", "device", "host"),
+                 mesh=None,
+                 policy: Optional[RetryPolicy] = None,
+                 fault_plan: Optional[FaultPlan] = None,
+                 checkpoint_path: Optional[str] = None,
+                 checkpoint_every: int = 0,
+                 strict: bool = True,
+                 max_depth: Optional[int] = None,
+                 max_secs: Optional[float] = None,
+                 chunk: int = 1 << 10,
+                 frontier_cap: int = 1 << 14,
+                 visited_cap: int = 1 << 20,
+                 ev_budget=None):
+        for rung in ladder:
+            if rung not in ("sharded", "device", "host"):
+                raise ValueError(f"unknown ladder rung {rung!r}")
+        self.protocol = protocol
+        self.ladder = tuple(ladder)
+        self.mesh = mesh
+        self.policy = policy or RetryPolicy()
+        self.fault_plan = fault_plan
+        self.checkpoint_path = checkpoint_path
+        self.checkpoint_every = checkpoint_every
+        self.strict = strict
+        self.max_depth = max_depth
+        self.max_secs = max_secs
+        self.chunk = chunk
+        self.frontier_cap = frontier_cap
+        self.visited_cap = visited_cap
+        self.ev_budget = ev_budget
+        self.boundary: Optional[DispatchBoundary] = None
+        self.failures: List[EngineFailure] = []
+        # Engines are cached per rung so repeated run() calls (e.g. the
+        # bench's warm-up-then-measure pattern) reuse the compiled
+        # programs; limits are refreshed from the supervisor per run.
+        self._engines: Dict[str, object] = {}
+
+    def _build(self, rung: str):
+        cached = self._engines.get(rung)
+        if cached is not None:
+            cached.max_depth = self.max_depth
+            cached.max_secs = self.max_secs
+            return cached
+        self._engines[rung] = s = self._build_fresh(rung)
+        return s
+
+    def _build_fresh(self, rung: str):
+        from dslabs_tpu.tpu.engine import TensorSearch
+
+        ck = {"checkpoint_path": self.checkpoint_path,
+              "checkpoint_every": self.checkpoint_every}
+        if rung == "sharded":
+            import jax
+
+            from dslabs_tpu.tpu.sharded import (ShardedTensorSearch,
+                                                make_mesh)
+
+            mesh = self.mesh
+            if mesh is None:
+                mesh = self.mesh = make_mesh(len(jax.devices()))
+            return ShardedTensorSearch(
+                self.protocol, mesh, chunk_per_device=self.chunk,
+                frontier_cap=self.frontier_cap,
+                visited_cap=self.visited_cap, max_depth=self.max_depth,
+                max_secs=self.max_secs, strict=self.strict,
+                ev_budget=self.ev_budget, **ck)
+        return TensorSearch(
+            self.protocol, frontier_cap=self.frontier_cap,
+            chunk=self.chunk, max_depth=self.max_depth,
+            max_secs=self.max_secs, ev_budget=self.ev_budget,
+            visited_cap=self.visited_cap, strict=self.strict,
+            use_host_visited=(rung == "host"), **ck)
+
+    def _resumable(self, search) -> bool:
+        if not self.checkpoint_path:
+            return False
+        fp = ckpt_mod.peek_fingerprint(self.checkpoint_path)
+        return fp is not None and fp == search._ckpt_fingerprint()
+
+    def run(self, resume: bool = False, initial=None,
+            check_initial: bool = True):
+        """Run the search to a verdict across the ladder.  ``resume``
+        opts in to resuming the FIRST rung from an existing checkpoint;
+        failover rungs always resume when a matching dump exists (that
+        is the point of the checkpoint)."""
+        self.boundary = DispatchBoundary(self.policy, self.fault_plan)
+        self.failures = []
+        for i, rung in enumerate(self.ladder):
+            search = self._build(rung)
+            self.boundary.install(search, engine=rung)
+            do_resume = (resume or i > 0) and self._resumable(search)
+            try:
+                out = search.run(check_initial=check_initial,
+                                 initial=initial, resume=do_resume)
+            except EngineFailure as e:
+                self.failures.append(e)
+                continue
+            out.engine = rung
+            out.retries = self.boundary.retries
+            out.failovers = len(self.failures)
+            out.resumed_from_depth = getattr(
+                search, "_resumed_from_depth", 0)
+            return out
+        raise SupervisorExhausted(self.failures)

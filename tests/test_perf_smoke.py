@@ -52,3 +52,34 @@ def test_lab1_strict_bfs_states_per_min_floor():
     assert best >= 0.7 * floor, (
         f"perf regression: {best:,.0f} states/min is >30% below the "
         f"committed floor {floor:,.0f} (BASELINE.json perf_smoke)")
+
+
+@pytest.mark.perf
+def test_supervised_run_holds_the_same_floor():
+    """The SAME gate through the search supervisor with a zero-fault
+    plan (ISSUE 2): the dispatch-boundary wrapper must be overhead-free
+    enough that the supervised run still clears the committed floor's
+    30% margin — robustness is not allowed to tax the hot loop."""
+    from dslabs_tpu.tpu.supervisor import SearchSupervisor
+
+    proto = dataclasses.replace(
+        make_clientserver_protocol(**_PERF["protocol_kwargs"]), goals={})
+    sup = SearchSupervisor(proto, ladder=("device",),
+                           chunk=_PERF["chunk"], frontier_cap=1 << 17,
+                           max_depth=2)
+    sup.run()                           # warm-up: compile off the clock
+    sup.max_depth = _PERF["depth"]
+    best = 0.0
+    for _ in range(2):
+        t0 = time.time()
+        out = sup.run()
+        best = max(best, out.unique_states / (time.time() - t0) * 60.0)
+    assert out.end_condition == "DEPTH_EXHAUSTED"
+    assert out.unique_states == _PERF["unique_states"]
+    assert (out.retries, out.failovers) == (0, 0)
+    floor = _PERF["floor_states_per_min"]
+    print(f"\nperf-smoke (supervised): {best:,.0f} unique states/min "
+          f"(floor {floor:,.0f}, fail below {0.7 * floor:,.0f})")
+    assert best >= 0.7 * floor, (
+        f"supervisor overhead regression: {best:,.0f} states/min is "
+        f">30% below the committed floor {floor:,.0f}")

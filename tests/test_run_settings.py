@@ -1,0 +1,128 @@
+"""RunSettings probabilistic delivery (runner/run_settings.py): the
+rate-resolution priority chain — link > sender > receiver > global —
+plus the two unconditional cases (self-sends always deliver; a rate
+above 1.0 is the reference's "explicitly reliable" placeholder,
+RunSettings.java:126).  Previously untested (ISSUE 2 satellite).
+
+Priority is pinned with degenerate rates (0.0 = never, 1.0/2.0 =
+always, no RNG involved); the Bernoulli draw itself is pinned with a
+seeded ``random`` so the delivered count for a fixed rate is exact and
+reproducible.
+"""
+
+import random
+
+from dslabs_tpu.core.address import LocalAddress
+from dslabs_tpu.runner.run_settings import RunSettings
+from dslabs_tpu.testing.events import MessageEnvelope
+
+A = LocalAddress("a")
+B = LocalAddress("b")
+C = LocalAddress("c")
+
+
+def _env(frm=A, to=B):
+    return MessageEnvelope(frm, to, {"m": 1})
+
+
+def _rate(settings, frm=A, to=B, n=400, seed=12345):
+    """Deterministic delivered fraction over n draws (seeded RNG)."""
+    random.seed(seed)
+    return sum(settings.should_deliver(_env(frm, to))
+               for _ in range(n)) / n
+
+
+def test_self_send_always_delivers():
+    """frm == to short-circuits EVERYTHING — even a zero rate at every
+    level and a deactivated network (RunSettings.java:41-60)."""
+    s = (RunSettings().network_deliver_rate(0.0)
+         .link_deliver_rate(A, A, 0.0)
+         .sender_deliver_rate(A, 0.0)
+         .receiver_deliver_rate(A, 0.0))
+    s.partition([])          # connectivity off for everyone
+    assert all(s.should_deliver(_env(A, A)) for _ in range(50))
+
+
+def test_link_rate_beats_sender_receiver_and_global():
+    s = (RunSettings().network_deliver_rate(0.0)
+         .sender_deliver_rate(A, 0.0)
+         .receiver_deliver_rate(B, 0.0)
+         .link_deliver_rate(A, B, 1.0))
+    assert _rate(s) == 1.0               # link=1 wins over three zeros
+    s2 = (RunSettings().network_deliver_rate(1.0)
+          .sender_deliver_rate(A, 1.0)
+          .receiver_deliver_rate(B, 1.0)
+          .link_deliver_rate(A, B, 0.0))
+    assert _rate(s2) == 0.0              # link=0 wins over three ones
+    # The link override is DIRECTIONAL: b->a is untouched by (a, b).
+    assert _rate(s2, frm=B, to=A) == 1.0
+
+
+def test_sender_rate_beats_receiver_and_global():
+    s = (RunSettings().network_deliver_rate(0.0)
+         .receiver_deliver_rate(B, 0.0)
+         .sender_deliver_rate(A, 1.0))
+    assert _rate(s) == 1.0
+    s2 = (RunSettings().network_deliver_rate(1.0)
+          .receiver_deliver_rate(B, 1.0)
+          .sender_deliver_rate(A, 0.0))
+    assert _rate(s2) == 0.0
+    # A different sender is untouched by a's rate.
+    assert _rate(s2, frm=C, to=B) == 1.0
+
+
+def test_receiver_rate_beats_global():
+    s = (RunSettings().network_deliver_rate(0.0)
+         .receiver_deliver_rate(B, 1.0))
+    assert _rate(s) == 1.0
+    s2 = (RunSettings().network_deliver_rate(1.0)
+          .receiver_deliver_rate(B, 0.0))
+    assert _rate(s2) == 0.0
+    assert _rate(s2, frm=A, to=C) == 1.0
+
+
+def test_explicitly_reliable_placeholder_above_one():
+    """link_unreliable(..., False) stores the 2.0 placeholder: it must
+    short-circuit the Bernoulli draw entirely (always deliver), while
+    still being OVERRIDDEN back to 0.5 by a later unreliable toggle."""
+    s = RunSettings().network_deliver_rate(0.0)
+    s.link_unreliable(A, B, False)       # stores rate 2.0 on the link
+    assert s._link_rate[(A, B)] == 2.0
+    assert _rate(s) == 1.0               # >1.0 = reliable, no draw
+    s.link_unreliable(A, B, True)        # reliable placeholder -> 0.5
+    assert s._link_rate[(A, B)] == 0.5
+    # An explicit sub-1.0 rate is NOT clobbered by unreliable(True).
+    s2 = RunSettings().link_deliver_rate(A, B, 0.25)
+    s2.link_unreliable(A, B, True)
+    assert s2._link_rate[(A, B)] == 0.25
+
+
+def test_seeded_bernoulli_rate_is_deterministic_and_plausible():
+    """The global 0.5 rate with a fixed seed: exact reproducibility
+    across runs, and the delivered fraction sits near the rate (the
+    draw really is rate-driven, not constant)."""
+    s = RunSettings().network_unreliable(True)   # global rate 0.5
+    assert s._network_rate == 0.5
+    r1 = _rate(s, n=1000, seed=7)
+    r2 = _rate(s, n=1000, seed=7)
+    assert r1 == r2                      # seeded == reproducible
+    assert 0.4 < r1 < 0.6
+    # Different seed, different sequence (sanity that the seed matters).
+    assert _rate(s, n=1000, seed=8) != r1
+
+
+def test_connectivity_still_gates_before_rates():
+    """TestSettings connectivity runs FIRST: a severed link never
+    delivers regardless of a 1.0/2.0 rate on the same link."""
+    s = RunSettings().link_deliver_rate(A, B, 1.0)
+    s.partition([A])                     # only intra-{a} links stay up
+    assert not s.should_deliver(_env(A, B))
+
+
+def test_reset_network_clears_all_rates():
+    s = (RunSettings().network_deliver_rate(0.0)
+         .link_deliver_rate(A, B, 0.0)
+         .sender_deliver_rate(A, 0.0)
+         .receiver_deliver_rate(B, 0.0))
+    s.reset_network()
+    assert _rate(s) == 1.0               # no rates left: always deliver

@@ -138,12 +138,37 @@ def test_checkpoint_resume_identical_outcome(tmp_path):
     assert r.unique_states == full.unique_states
     assert r.states_explored == full.states_explored
 
-    # A dump from a DIFFERENT config is never resumed silently.
+    # The unified dump format (tpu/checkpoint.py) is engine-knob
+    # agnostic: a DIFFERENT chunk size resumes the same file to the
+    # same verdict and counts (the dump stores semantic search state,
+    # not a carry layout).
     other = ShardedTensorSearch(
         proto, mesh, chunk_per_device=32, frontier_cap=1 << 8,
         visited_cap=1 << 10, checkpoint_path=ckpt)
-    assert other._load_checkpoint() is None
-    assert not other.has_resumable_checkpoint()
+    assert other.has_resumable_checkpoint()
+    o = other.run(resume=True)
+    assert o.end_condition == full.end_condition
+    assert o.unique_states == full.unique_states
+    assert o.states_explored == full.states_explored
+
+    # A dump from a different PROTOCOL/CAPACITY config is rejected
+    # loudly — CheckpointMismatch naming both fingerprints, never a
+    # silent skip (see also tests/test_supervisor.py).
+    import dataclasses as _dc
+
+    import pytest as _pytest
+
+    from dslabs_tpu.tpu.checkpoint import CheckpointMismatch
+
+    bigger = _dc.replace(proto, net_cap=proto.net_cap * 2)
+    mismatched = ShardedTensorSearch(
+        bigger, mesh, chunk_per_device=16, frontier_cap=1 << 8,
+        visited_cap=1 << 10, checkpoint_path=ckpt)
+    assert not mismatched.has_resumable_checkpoint()
+    with _pytest.raises(CheckpointMismatch) as ei:
+        mismatched.run(resume=True)
+    assert proto.name in str(ei.value)
+    assert mismatched._ckpt_fingerprint() in str(ei.value)
 
     # Resuming a checkpoint saved AFTER the final level (empty frontier)
     # returns the finished verdict instead of crashing.
