@@ -18,6 +18,10 @@ lab0 lab1 lab2 lab3 lab4:   ## scored lab runs via the CLI driver
 bench:           ## TPU states/min benchmark (one JSON line)
 	$(PY) bench.py
 
+# perf-smoke = the BASELINE.json states/min floor PLUS the dry-run
+# 8-virtual-device superstep-vs-legacy parity gate (exact unique/
+# explored/verdict match on pingpong + paxos d5 + shardstore —
+# tests/test_superstep.py, ISSUE 3 acceptance).
 perf-smoke:      ## fast CPU perf gate vs the BASELINE.json floor
 	$(PY) -m pytest tests/ -q -m perf -s -p no:cacheprovider
 

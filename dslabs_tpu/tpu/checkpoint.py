@@ -43,7 +43,21 @@ import numpy as np
 
 __all__ = ["FORMAT_VERSION", "CheckpointMismatch", "SearchCheckpoint",
            "config_fingerprint", "save", "load", "peek_fingerprint",
-           "AsyncCheckpointWriter"]
+           "AsyncCheckpointWriter", "default_compile_cache_dir"]
+
+
+def default_compile_cache_dir(checkpoint_path) -> "Optional[str]":
+    """The documented default location of the persistent XLA compile
+    cache (tpu/compile_cache.py) for a checkpointed search: a
+    ``compile_cache/`` directory beside the dump, so a resumable job
+    keeps its compiled programs with its state.  ``None`` when no
+    checkpoint is configured (the env knob ``DSLABS_COMPILE_CACHE``
+    overrides either way)."""
+    if not checkpoint_path:
+        return None
+    return os.path.join(
+        os.path.dirname(os.path.abspath(checkpoint_path)),
+        "compile_cache")
 
 FORMAT_VERSION = "dslabs-search-ckpt-v6"
 

@@ -1,0 +1,87 @@
+"""Persistent XLA compile-cache wiring (one knob, one place).
+
+BENCH_r05 blew its 300 s preflight deadline on COMPILE alone: every
+sharded program was rebuilt from scratch every run because nothing wired
+JAX's persistent compilation cache outside ad-hoc bench code.  This
+module is the single seam:
+
+* ``DSLABS_COMPILE_CACHE=<dir>`` points the cache anywhere (a falsy
+  value — ``0`` / ``off`` / ``none`` — disables the default entirely);
+* with the knob unset, a search that has a ``checkpoint_path``
+  configured defaults to a ``compile_cache/`` directory next to the
+  dump (:func:`dslabs_tpu.tpu.checkpoint.default_compile_cache_dir`) —
+  a resumable job keeps its compiled programs beside its state;
+* an already-configured cache dir (conftest.py, bench.py) is never
+  clobbered by a default — only the explicit env knob overrides.
+
+Together with the engines' AOT warm-up (``ShardedTensorSearch
+.aot_warmup``) the second run of any config pays near-zero compile: the
+warm-up's ``.lower().compile()`` hits the on-disk cache instead of XLA.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = ["setup", "setup_for_checkpoint", "cache_dir"]
+
+_DISABLED = ("0", "off", "none", "false", "no", "")
+
+
+def cache_dir() -> Optional[str]:
+    """The persistent-compile-cache directory currently in effect."""
+    import jax
+
+    return jax.config.jax_compilation_cache_dir
+
+
+def setup(default_dir: Optional[str] = None) -> Optional[str]:
+    """Enable JAX's persistent compilation cache.
+
+    Resolution order: ``DSLABS_COMPILE_CACHE`` (explicit dir, or a
+    falsy value to disable) > an already-configured cache dir (left
+    untouched) > ``default_dir`` > off.  Returns the directory in
+    effect (``None`` = no persistent cache).  Idempotent — safe to call
+    from every engine constructor."""
+    import jax
+
+    env = os.environ.get("DSLABS_COMPILE_CACHE")
+    if env is not None:
+        if env.strip().lower() in _DISABLED:
+            return None
+        path = env
+    else:
+        current = jax.config.jax_compilation_cache_dir
+        if current:
+            return current
+        if not default_dir:
+            return None
+        path = default_dir
+    if jax.config.jax_compilation_cache_dir != path:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # The runtime holds a cache singleton initialised with the dir
+        # at FIRST use — without a reset, a dir change after any cached
+        # compile is silently ignored.
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:  # pragma: no cover — private API drift
+            pass
+    # Cache even quick compiles: the same program that builds in
+    # seconds on CPU costs minutes on the tunnelled TPU runtime, and
+    # the cache key is platform-specific anyway.
+    if jax.config.jax_persistent_cache_min_compile_time_secs > 0.5:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.5)
+    return path
+
+
+def setup_for_checkpoint(checkpoint_path: Optional[str]) -> Optional[str]:
+    """:func:`setup` with the documented default — a ``compile_cache/``
+    dir beside the search's checkpoint dump (no-op without one)."""
+    from dslabs_tpu.tpu import checkpoint as ckpt_mod
+
+    return setup(ckpt_mod.default_compile_cache_dir(checkpoint_path))

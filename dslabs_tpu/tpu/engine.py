@@ -214,6 +214,17 @@ class SearchOutcome:
     failovers: int = 0               # ladder rungs abandoned before this one
     resumed_from_depth: int = 0      # checkpoint depth resumed from (0=root)
     engine: Optional[str] = None     # ladder rung that produced the verdict
+    # Structured per-level throughput records from the sharded driver
+    # (dicts of depth / chunks / wall / explored / unique /
+    # next_frontier) — the bench emits them as its throughput series;
+    # DSLABS_LEVEL_TIMING pretty-prints the same records live.
+    levels: Optional[list] = None
+    # Wall seconds spent in explicit AOT compilation (the construction-
+    # time .lower().compile() warm-up) — reported SEPARATELY from
+    # elapsed_secs so compile cost never pollutes states/min, and so a
+    # warm persistent compile cache (tpu/compile_cache.py) is visible
+    # as this number dropping to near-zero on the second run.
+    compile_secs: float = 0.0
 
 
 # ----------------------------------------------------------------- hashing
@@ -675,6 +686,13 @@ class TensorSearch:
         self.checkpoint_path = checkpoint_path
         self.checkpoint_every = checkpoint_every
         self._resumed_from_depth = 0
+        # Persistent XLA compile cache (tpu/compile_cache.py): the
+        # DSLABS_COMPILE_CACHE knob, defaulting to a compile_cache/
+        # dir beside the checkpoint when one is configured — so the
+        # second run of any config pays near-zero compile.
+        from dslabs_tpu.tpu import compile_cache
+
+        compile_cache.setup_for_checkpoint(checkpoint_path)
         self.frontier_cap = frontier_cap
         self.chunk = chunk
         self.max_depth = max_depth

@@ -20,10 +20,17 @@ mapped onto XLA collectives instead of the reference's shared-memory
 ConcurrentHashMap (Search.java:405-505); with a 1-device mesh the
 collectives are identities, which is how the TPU bench runs.
 
-Host involvement per level: one fused scalar readback (frontier counts +
-overflow/terminal counters) to decide the next chunk count and check
-termination.  No state rows cross the host boundary until a terminal
-state must be reported; even the initial carry is built on device.
+Host involvement per level: ONE on-device **superstep** dispatch — a
+``lax.while_loop`` of chunk steps inside a single ``shard_map`` program
+that drains every device's own frontier shard (occupancy-driven trip
+count read from the carry, not the host's worst-case bound) and returns
+the fused scalar stats vector — plus the between-level promote, so at
+most two host dispatches per level where the round-5 driver issued
+``n_chunks + 1`` (one jitted dispatch per chunk plus the stats sync).
+The legacy host-driven per-chunk driver survives behind
+``DSLABS_SHARDED_SUPERSTEP=0`` as the parity oracle (docs/perf.md).  No
+state rows cross the host boundary until a terminal state must be
+reported; even the initial carry is built on device.
 
 Everything on device is int32/uint32 (TPU-native dtypes; no x64).  All
 fixed-capacity structures (routing buckets, frontier shards, visited
@@ -49,10 +56,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from dslabs_tpu.tpu import visited as visited_mod
 from dslabs_tpu.tpu.engine import (CapacityOverflow, SearchOutcome,
                                    TensorProtocol, TensorSearch,
-                                   flatten_state, row_fingerprints,
-                                   state_fingerprints)
+                                   device_get, flatten_state,
+                                   row_fingerprints, state_fingerprints)
 
 __all__ = ["ShardedTensorSearch", "make_mesh"]
+
+
+def _env_on(name: str, default: bool = True) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() not in ("0", "", "off", "false", "no")
 
 OVERFLOW_FACTOR = 2
 # The visited hash table itself lives in dslabs_tpu/tpu/visited.py — ONE
@@ -110,7 +124,9 @@ class ShardedTensorSearch(TensorSearch):
                  ev_spill: Optional[bool] = None,
                  record_trace: bool = False,
                  checkpoint_path: Optional[str] = None,
-                 checkpoint_every: int = 0):
+                 checkpoint_every: int = 0,
+                 superstep: Optional[bool] = None,
+                 aot_warmup: Optional[bool] = None):
         # Frontier checkpointing (SURVEY §5 "dump SoA tensors"): every
         # ``checkpoint_every`` levels the live carry — the OCCUPIED
         # frontier prefix, the occupied visited-table lines, and the
@@ -183,6 +199,22 @@ class ShardedTensorSearch(TensorSearch):
         self._chunk_step = jax.jit(self._build_chunk_step(),
                                    donate_argnums=0)
         self._finish_level = jax.jit(self._build_finish(), donate_argnums=0)
+        # On-device level superstep (default; DSLABS_SHARDED_SUPERSTEP=0
+        # keeps the legacy host-driven per-chunk driver as the parity
+        # oracle).  The superstep fuses each level's whole chunk loop —
+        # lax.while_loop of chunk steps until every device's OWN frontier
+        # shard is drained — into ONE dispatch that also returns the
+        # fused stats vector, so host involvement per level drops from
+        # n_chunks + 1 dispatches to superstep + promote.
+        self.use_superstep = (_env_on("DSLABS_SHARDED_SUPERSTEP", True)
+                              if superstep is None else bool(superstep))
+        self._superstep = jax.jit(self._build_superstep(), donate_argnums=0)
+        # Chunk-step budget per superstep dispatch when a wall-clock
+        # budget is active: bounds device work between host clock checks
+        # so mid-level TIME_EXHAUSTED keeps its round-3 granularity (the
+        # legacy driver blocked every 16 chunks for the same reason).
+        self._superstep_chunks = int(
+            os.environ.get("DSLABS_SUPERSTEP_CHUNKS", "16") or "16")
 
         # ONE fused scalar vector per host sync: each device->host readback
         # over the runtime tunnel costs ~25 ms, and the naive sync did six
@@ -211,9 +243,25 @@ class ShardedTensorSearch(TensorSearch):
 
         self._stats = jax.jit(stats)
 
+        # Explicit AOT warm-up (ISSUE 3): .lower().compile() the hot
+        # programs at construction so compile wall-time is measured
+        # separately from search wall-time (SearchOutcome.compile_secs)
+        # and — with the persistent compile cache wired — a second run of
+        # the same config pays near-zero compile.
+        self.compile_secs = 0.0
+        if (_env_on("DSLABS_AOT_WARMUP", False)
+                if aot_warmup is None else bool(aot_warmup)):
+            self.aot_warmup()
+
     # --------------------------------------------------------- level chunk
 
-    def _build_chunk_step(self):
+    def _make_local_step(self):
+        """The per-device chunk-step body (runs INSIDE shard_map): one
+        chunk expand + key routing + owner dedup + frontier append.
+        Shared by the legacy per-chunk program (_build_chunk_step, one
+        shard_map dispatch per chunk) and the fused level superstep
+        (_build_superstep, a lax.while_loop of these bodies in one
+        dispatch)."""
         p = self.p
         D = self.n_devices
         C = self.cpd
@@ -459,9 +507,16 @@ class ShardedTensorSearch(TensorSearch):
                 out["flag_meta"] = flag_meta
             return out
 
+        return local
+
+    def _has_rt_masks(self) -> bool:
+        return (self.p.deliver_message_rt is not None
+                or self.p.deliver_timer_rt is not None)
+
+    def _build_chunk_step(self):
+        local = self._make_local_step()
         spec = self._carry_specs()
-        if (p.deliver_message_rt is not None
-                or p.deliver_timer_rt is not None):
+        if self._has_rt_masks():
             # Runtime delivery masks ride as a replicated ARGUMENT: every
             # staged phase (different partition/timer gating, same
             # protocol shape) shares one compiled program.
@@ -472,16 +527,115 @@ class ShardedTensorSearch(TensorSearch):
                          in_specs=(spec,), out_specs=spec,
                          check_rep=False)
 
+    # ---------------------------------------------------- level superstep
+
+    def _build_superstep(self):
+        """The fused LEVEL superstep: one shard_map program whose
+        ``lax.while_loop`` iterates chunk steps until every device's OWN
+        frontier shard is drained (including event-window spill passes —
+        a spilled chunk holds its ``j`` back, so the drain condition
+        covers re-passes), bounded by a replicated ``budget`` scalar so
+        a host wall-clock budget keeps mid-level granularity.
+
+        The trip count is occupancy-driven FROM THE CARRY: device d runs
+        ``ceil(cur_n_d / C)`` chunk steps (its actual post-rebalance
+        share) instead of the host's pre-rebalance ``max_n + D - 1``
+        worst case, and the loop condition is the psum of the per-device
+        "still draining" flags — every device executes the same trip
+        count (the body contains collectives) but that count is the max
+        of the ACTUAL needs, not the host's bound.
+
+        Returns ``(carry', stats)`` where ``stats`` is the fused scalar
+        vector — the legacy 8 + n_flags layout (_sync_checks parses both
+        drivers identically) plus two superstep-only slots:
+        ``[..., remaining_devices, steps_taken]``.  Computing the stats
+        in-program (psum/pmax over the mesh axis) folds the level sync
+        into the same dispatch: host involvement per level becomes
+        superstep + promote."""
+        local = self._make_local_step()
+        C = self.cpd
+        ax = self.axis
+
+        def _psum(x):
+            return jax.lax.psum(x, ax)
+
+        def stats_local(c, steps):
+            core = jnp.stack([
+                _psum(c["overflow"][0]),
+                _psum(c["drops"][0]),
+                _psum(c["vis_over"][0]),
+                _psum(c["explored"][0]),
+                jax.lax.pmax(c["vis_n"][0], ax),
+                _psum(c["vis_n"][0]),
+                jax.lax.pmax(c["nxt_n"][0], ax),
+                jax.lax.pmin(c["j"][0], ax),
+            ]).astype(jnp.int32)
+            flags = _psum(c["flag_cnt"]).astype(jnp.int32)
+            remaining = _psum(
+                (c["j"][0] * C < c["cur_n"][0]).astype(jnp.int32))
+            tail = jnp.stack([remaining, steps]).astype(jnp.int32)
+            return jnp.concatenate([core, flags, tail])
+
+        def super_local(carry, budget, masks=None):
+            def cond(st):
+                c, k = st
+                own = c["j"][0] * C < c["cur_n"][0]
+                return (jax.lax.psum(own.astype(jnp.int32), ax) > 0) & (
+                    k < budget)
+
+            def body(st):
+                c, k = st
+                return local(c, masks), k + 1
+
+            carry, k = jax.lax.while_loop(cond, body,
+                                          (carry, jnp.int32(0)))
+            return carry, stats_local(carry, k)
+
+        spec = self._carry_specs()
+        if self._has_rt_masks():
+            return shard_map(
+                lambda c, b, m: super_local(c, b, m), mesh=self.mesh,
+                in_specs=(spec, P(), (P(), P())),
+                out_specs=(spec, P()), check_rep=False)
+        return shard_map(
+            lambda c, b: super_local(c, b), mesh=self.mesh,
+            in_specs=(spec, P()), out_specs=(spec, P()),
+            check_rep=False)
+
+    def _superstep_call(self, carry, budget: int):
+        """Dispatch one superstep through the supervisor boundary.  The
+        dispatched callable BLOCKS on the stats readback (the tiny
+        replicated vector, never rows), so the watchdog bounds the whole
+        fused level step and the per-level host transfers stay scalar."""
+        if budget >= (1 << 30):
+            b = getattr(self, "_budget_full", None)
+            if b is None:
+                b = self._budget_full = jnp.asarray(1 << 30, jnp.int32)
+        else:
+            b = jnp.asarray(budget, jnp.int32)
+        rt = getattr(self, "_rt_masks", None)
+
+        prog = self._prog("superstep", self._superstep)
+
+        def run(c, bb, *masks):
+            c2, stats = (prog(c, bb, masks[0]) if masks
+                         else prog(c, bb))
+            return c2, device_get(stats)
+
+        if rt is not None:
+            return self._dispatch("sharded.superstep", run, carry, b, rt)
+        return self._dispatch("sharded.superstep", run, carry, b)
+
     def _step(self, carry):
         """Dispatch one chunk step, passing the runtime masks when the
         protocol declares them.  Routed through the supervisor's
         dispatch boundary (engine._dispatch) like every hot-loop
         dispatch."""
         rt = getattr(self, "_rt_masks", None)
+        prog = self._prog("step", self._chunk_step)
         if rt is not None:
-            return self._dispatch("sharded.step", self._chunk_step,
-                                  carry, rt)
-        return self._dispatch("sharded.step", self._chunk_step, carry)
+            return self._dispatch("sharded.step", prog, carry, rt)
+        return self._dispatch("sharded.step", prog, carry)
 
     def _build_finish(self):
         """Promote nxt -> cur between levels, REBALANCING the frontier
@@ -548,6 +702,18 @@ class ShardedTensorSearch(TensorSearch):
 
     # ----------------------------------------------------------------- run
 
+    def _root_ids(self, state):
+        """Root row + sanitized key + its owner device and home slot —
+        shared by _init_carry and the AOT warm-up."""
+        rows0 = flatten_state(state)                     # [1, lanes] device
+        fp0 = np.asarray(state_fingerprints(state), np.uint32)  # [1, 4]
+        owner = int(fp0[0, 0]) % self.n_devices
+        key0 = visited_mod.host_sanitize_key(fp0[0])
+        # The root key sits in slot 0 of its home BUCKET — addressing
+        # mirrored from visited.py (bucket keyed by lane 2).
+        home = visited_mod.host_home_slot(key0, self.v_cap)
+        return rows0, key0, owner, home
+
     def _init_carry(self, state) -> dict:
         """Build the sharded carry ON DEVICE: the big buffers (frontier,
         next-frontier, visited table — hundreds of MB) are jnp
@@ -555,14 +721,23 @@ class ShardedTensorSearch(TensorSearch):
         and its key crossing the host boundary.  A host-numpy build +
         device_put shipped ~750 MB through the runtime tunnel and cost
         15-50 s per run() — charged to the bench's measured window."""
+        rows0, key0, owner, home = self._root_ids(state)
+        init = self._prog(("init", owner, home),
+                          self._init_prog(owner, home))
+        return self._dispatch("sharded.init", init, rows0[0],
+                              jnp.asarray(key0))
+
+    def _init_prog(self, owner: int, home: int):
+        """The jitted carry initializer for a given root owner/home slot
+        (both are baked into the traced program).  Cached so the AOT
+        warm-up's compiled program is the one run() actually uses."""
+        cache = getattr(self, "_init_progs", None)
+        if cache is None:
+            cache = self._init_progs = {}
+        fn = cache.get((owner, home))
+        if fn is not None:
+            return fn
         D, F, V, lanes = self.n_devices, self.f_cap, self.v_cap, self.lanes
-        rows0 = flatten_state(state)                     # [1, lanes] device
-        fp0 = np.asarray(state_fingerprints(state), np.uint32)  # [1, 4]
-        owner = int(fp0[0, 0]) % D
-        key0 = visited_mod.host_sanitize_key(fp0[0])
-        # The root key sits in slot 0 of its home BUCKET — addressing
-        # mirrored from visited.py (bucket keyed by lane 2).
-        home = visited_mod.host_home_slot(key0, V)
         nf = len(self._flag_names)
         shard = NamedSharding(self.mesh, P(self.axis))
 
@@ -593,10 +768,93 @@ class ShardedTensorSearch(TensorSearch):
                 out["flag_meta"] = jnp.zeros((D * nf, 9), jnp.uint32)
             return out
 
-        init = jax.jit(build, out_shardings={
+        fn = jax.jit(build, out_shardings={
             k: shard for k in self._carry_specs()})
-        return self._dispatch("sharded.init", init, rows0[0],
-                              jnp.asarray(key0))
+        cache[(owner, home)] = fn
+        return fn
+
+    # ------------------------------------------------------- AOT warm-up
+
+    def _carry_sds(self):
+        """Abstract (ShapeDtypeStruct + NamedSharding) carry pytree for
+        AOT lowering — mirrors the shapes _init_prog builds."""
+        D, F, V, lanes = self.n_devices, self.f_cap, self.v_cap, self.lanes
+        nf = len(self._flag_names)
+        shard = NamedSharding(self.mesh, P(self.axis))
+
+        def sd(shape, dtype=jnp.int32):
+            return jax.ShapeDtypeStruct(shape, dtype, sharding=shard)
+
+        out = {
+            "cur": sd((D * F, lanes)), "cur_n": sd((D,)),
+            "j": sd((D,)), "evp": sd((D,)), "noapp": sd((D,)),
+            "nxt": sd((D * (F + 1), lanes)), "nxt_n": sd((D,)),
+            "visited": sd((D * (V + 1), 4), jnp.uint32),
+            "vis_n": sd((D,)), "explored": sd((D,)),
+            "overflow": sd((D,)), "vis_over": sd((D,)),
+            "drops": sd((D,)),
+            "flag_cnt": sd((D * nf,)),
+            "flag_rows": sd((D * nf, lanes)),
+        }
+        if self.record_trace:
+            out["tmeta"] = sd((D * (F + 1), 9), jnp.uint32)
+            out["flag_meta"] = sd((D * nf, 9), jnp.uint32)
+        return out
+
+    def aot_warmup(self) -> float:
+        """Ahead-of-time compile the hot programs (superstep or legacy
+        chunk step + stats, the level promote, and the default root's
+        carry initializer) via ``.lower().compile()``, so compile cost
+        is paid — and MEASURED — at construction instead of inside the
+        first run's search window.  With the persistent compile cache
+        (DSLABS_COMPILE_CACHE / tpu/compile_cache.py) the second
+        construction of any config hits the cache and this drops to
+        near-zero.  Returns the wall seconds spent; also accumulated on
+        ``self.compile_secs`` and surfaced as
+        ``SearchOutcome.compile_secs``."""
+        import sys
+
+        t0 = time.time()
+        exes = self._aot_exes = getattr(self, "_aot_exes", {})
+        try:
+            sds = self._carry_sds()
+            rt = getattr(self, "_rt_masks", None)
+            if self._has_rt_masks() and rt is None:
+                raise RuntimeError(
+                    "runtime-mask protocol: call set_runtime_masks() "
+                    "before aot_warmup()")
+            mask_args = (rt,) if rt is not None else ()
+            b = jnp.asarray(1 << 30, jnp.int32)
+            # The compiled executables are KEPT and invoked directly by
+            # the dispatch paths (_prog): jit.__call__ does not reuse
+            # .lower().compile() results in this JAX, so calling the jit
+            # again would re-trace and re-compile (the persistent cache
+            # would absorb the XLA half, but not the tracing).
+            if self.use_superstep:
+                exes["superstep"] = self._superstep.lower(
+                    sds, b, *mask_args).compile()
+            else:
+                exes["step"] = self._chunk_step.lower(
+                    sds, *mask_args).compile()
+                exes["stats"] = self._stats.lower(sds).compile()
+            exes["promote"] = self._finish_level.lower(sds).compile()
+            rows0, key0, owner, home = self._root_ids(
+                self.initial_state())
+            exes[("init", owner, home)] = self._init_prog(
+                owner, home).lower(rows0[0], jnp.asarray(key0)).compile()
+        except Exception as e:  # noqa: BLE001 — warm-up must never kill
+            # a run; a cold first dispatch is the graceful fallback.
+            exes.clear()
+            print(f"[dslabs] AOT warm-up skipped: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+        secs = time.time() - t0
+        self.compile_secs = getattr(self, "compile_secs", 0.0) + secs
+        return secs
+
+    def _prog(self, name, default):
+        """The AOT-compiled executable for a program when the warm-up
+        built one (invoked directly — zero retrace), else the lazy jit."""
+        return getattr(self, "_aot_exes", {}).get(name) or default
 
     def _terminal_from_flags(self, carry, explored, vis_total, depth, t0):
         """Resolve the first terminal flag (checkState order) from the
@@ -658,11 +916,12 @@ class ShardedTensorSearch(TensorSearch):
         donated to the next chunk step, so the dump thread must never
         alias it)."""
         # Post-rebalance occupancy bound: ceil-split can give one device
-        # up to max_n + D - 1 rows (run()'s chunk-grid bound).  Rounded
-        # UP to a power of two so the per-shape jitted snapshot programs
-        # number O(log f_cap), not one per frontier size (each is a
-        # synchronous shard_map compile in the level gap).
-        need = min(max_n + self.n_devices - 1, self.f_cap)
+        # up to max_n + D - 1 rows (run()'s chunk-grid bound) — but on a
+        # 1-device mesh the rebalance is an identity, so no slack.
+        # Rounded UP to a power of two so the per-shape jitted snapshot
+        # programs number O(log f_cap), not one per frontier size (each
+        # is a synchronous shard_map compile in the level gap).
+        need = min(max_n + self._rebalance_slack(), self.f_cap)
         m = self.cpd
         while m < need:
             m <<= 1
@@ -864,6 +1123,11 @@ class ShardedTensorSearch(TensorSearch):
         self._trace_root = jax.tree.map(np.asarray, state)
         self._fp_map = {}
         self._deep_samples = None
+        # Structured per-level throughput records (depth, chunks, wall,
+        # explored, unique, next_frontier) — attached to the outcome as
+        # SearchOutcome.levels; DSLABS_LEVEL_TIMING pretty-prints the
+        # same records to stderr as they land.
+        self._level_records: List[dict] = []
         self._root_fp = tuple(np.asarray(
             state_fingerprints(state), np.uint32)[0].tolist())
         if check_initial:
@@ -872,7 +1136,10 @@ class ShardedTensorSearch(TensorSearch):
                 return out
 
         try:
-            return self._run_levels(t0, state, resume)
+            out = self._run_levels(t0, state, resume)
+            out.levels = self._level_records or None
+            out.compile_secs = round(getattr(self, "compile_secs", 0.0), 3)
+            return out
         finally:
             # An async checkpoint still draining must complete before the
             # caller sees the outcome (kill-resume tests depend on the
@@ -919,64 +1186,30 @@ class ShardedTensorSearch(TensorSearch):
                     shard = NamedSharding(self.mesh, P(self.axis))
                     carry["noapp"] = jax.device_put(
                         np.ones(self.n_devices, np.int32), shard)
-                # max_n was read BEFORE the rebalance: a device can end up
-                # with ceil(total/D) <= max_n + D - 1 rows afterwards, so
-                # widen the chunk grid by that bound (at most one extra,
-                # mostly-invalid chunk; never silently skips rows).
-                n_chunks = -(-(max_n + self.n_devices - 1) // self.cpd)
-                t_disp = time.time()
-                for j in range(n_chunks):
-                    carry = self._step(carry)
-                    # Respect the time budget inside long levels too.  The
-                    # partial level runs the same overflow/terminal-flag
-                    # checks as a full level before reporting, so a
-                    # violation or capacity loss in the chunks already
-                    # processed is never masked by TIME_EXHAUSTED.
-                    # Dispatch is async — without the periodic block the
-                    # whole level enqueues in milliseconds and the clock
-                    # check below can never fire mid-level (round-3: a
-                    # 120 s budget overran to 153 s, and the overrun runs
-                    # the SLOWEST, highest-table-load chunks).
-                    if (self.max_secs is not None and j % 16 == 15):
-                        jax.block_until_ready(carry["j"])
-                    if (self.max_secs is not None and j + 1 < n_chunks
-                            and time.time() - t0 > self.max_secs):
-                        out, _, _, drops, _, _ = self._sync_checks(
-                            carry, depth, t0)
-                        if out is not None:
-                            return out
-                        return self._limit_outcome("TIME_EXHAUSTED", carry,
-                                                   depth, t0)
-                t_disp = time.time() - t_disp
-                # ---- the one host sync per level.  With event-window
-                # spill, a chunk that had valid events past its window
-                # held j back — re-dispatch until the slowest device has
-                # completed all its chunks (no extra readbacks when
-                # nothing spilled: j_done rides the same stats vector).
-                while True:
-                    (out, explored, vis_total, drops, max_n,
-                     j_done) = self._sync_checks(carry, depth, t0)
-                    if out is not None:
-                        return out
-                    if not self.ev_spill or j_done >= n_chunks:
-                        break
-                    # Spill rounds respect the time budget too (the
-                    # checks above already ran, so a verdict in the
-                    # completed chunks is never masked).
-                    if (self.max_secs is not None
-                            and time.time() - t0 > self.max_secs):
-                        return self._limit_outcome("TIME_EXHAUSTED",
-                                                   carry, depth, t0)
-                    for _ in range(n_chunks - j_done):
-                        carry = self._step(carry)
+                if self.use_superstep:
+                    (carry, out, explored, vis_total, drops, max_n,
+                     chunks) = self._level_superstep(carry, depth, t0,
+                                                     max_n)
+                else:
+                    (carry, out, explored, vis_total, drops, max_n,
+                     chunks) = self._level_chunks(carry, depth, t0, max_n)
+                if out is not None:
+                    return out
+                self._level_records.append({
+                    "depth": depth, "chunks": int(chunks),
+                    "wall": round(time.time() - t_lvl, 4),
+                    "explored": int(explored), "unique": int(vis_total),
+                    "next_frontier": int(max_n)})
                 if _LEVEL_TIMING:
                     import sys as _sys
-                    dt = time.time() - t_lvl
-                    print(f"[level {depth}] chunks={n_chunks} "
-                          f"dt={dt:.2f}s chunk={dt/max(n_chunks,1)*1e3:.1f}ms "
-                          f"dispatch={t_disp:.2f}s "
-                          f"explored={explored} unique={vis_total} "
-                          f"next={max_n}", flush=True, file=_sys.stderr)
+                    r = self._level_records[-1]
+                    print(f"[level {r['depth']}] chunks={r['chunks']} "
+                          f"dt={r['wall']:.2f}s "
+                          f"chunk={r['wall']/max(r['chunks'],1)*1e3:.1f}ms "
+                          f"explored={r['explored']} "
+                          f"unique={r['unique']} "
+                          f"next={r['next_frontier']}",
+                          flush=True, file=_sys.stderr)
                 if noapp_level:
                     # max_n counted the final level's would-be appends:
                     # zero means the space ended exactly at the depth
@@ -991,8 +1224,9 @@ class ShardedTensorSearch(TensorSearch):
                         visited_overflow=getattr(self, "_vis_over", 0))
                 if self.record_trace:
                     self._spill_tmeta(carry)
-                carry = self._dispatch("sharded.promote",
-                                       self._finish_level, carry)
+                carry = self._dispatch(
+                    "sharded.promote",
+                    self._prog("promote", self._finish_level), carry)
                 if (self.checkpoint_every and self.checkpoint_path
                         and depth % self.checkpoint_every == 0):
                     self._save_checkpoint(carry, depth, time.time() - t0,
@@ -1003,6 +1237,117 @@ class ShardedTensorSearch(TensorSearch):
                 time.time() - t0, dropped=drops,
                 samples=getattr(self, "_deep_samples", None),
                 visited_overflow=getattr(self, "_vis_over", 0))
+
+    def _rebalance_slack(self) -> int:
+        """Post-rebalance occupancy slack over the pre-rebalance max_n:
+        ceil-split can hand one device up to ``max_n + D - 1`` rows — but
+        a 1-device mesh's rebalance is an identity, so the extra
+        (mostly-invalid) chunk the slack would force is pure waste on
+        the TPU bench path and is skipped."""
+        return self.n_devices - 1 if self.n_devices > 1 else 0
+
+    def _level_superstep(self, carry, depth, t0, max_n):
+        """One BFS level via the fused on-device superstep: each
+        dispatch drains up to ``budget`` chunk steps (unbounded when no
+        wall-clock budget is set — the whole level in ONE dispatch) and
+        returns the fused stats in the same program.  Returns
+        ``(carry, outcome_or_none, explored, vis_total, drops, nxt_max,
+        chunk_steps_run)``."""
+        budget = ((1 << 30) if self.max_secs is None
+                  else max(1, self._superstep_chunks))
+        # Watchdog granularity (tpu/supervisor.py): a superstep
+        # legitimately runs a whole level's chunk work in one dispatch,
+        # so the per-dispatch deadline scales by the expected trip count
+        # (2x for event-window spill re-passes).
+        est = -(-(max_n + self._rebalance_slack()) // self.cpd)
+        self._dispatch_deadline_scales = {
+            "superstep": float(max(1, min(budget, 2 * est)))}
+        nf = len(self._flag_names)
+        chunks = 0
+        while True:
+            carry, stats = self._superstep_call(carry, budget)
+            chunks += int(stats[9 + nf])
+            # The checks run BEFORE any time-budget return: a violation
+            # or capacity loss in the chunks already completed is never
+            # masked by TIME_EXHAUSTED (same contract as the legacy
+            # driver's mid-level clock check).
+            (out, explored, vis_total, drops, nxt_max,
+             _j) = self._sync_checks(carry, depth, t0, stats=stats)
+            if out is not None:
+                return (carry, out, explored, vis_total, drops, nxt_max,
+                        chunks)
+            if int(stats[8 + nf]) == 0:     # every device's shard drained
+                return (carry, None, explored, vis_total, drops, nxt_max,
+                        chunks)
+            if (self.max_secs is not None
+                    and time.time() - t0 > self.max_secs):
+                return (carry,
+                        self._limit_outcome("TIME_EXHAUSTED", carry,
+                                            depth, t0),
+                        explored, vis_total, drops, nxt_max, chunks)
+
+    def _level_chunks(self, carry, depth, t0, max_n):
+        """The legacy host-driven per-chunk level driver (one jitted
+        dispatch per chunk + one stats sync) — kept behind
+        ``DSLABS_SHARDED_SUPERSTEP=0`` as the parity oracle the fused
+        superstep is tested against.  Same return contract as
+        :meth:`_level_superstep`."""
+        # max_n was read BEFORE the rebalance: a device can end up with
+        # ceil(total/D) <= max_n + D - 1 rows afterwards, so widen the
+        # chunk grid by that bound (at most one extra, mostly-invalid
+        # chunk; never silently skips rows).  1-device meshes skip the
+        # slack — the rebalance is an identity there.
+        n_chunks = -(-(max_n + self._rebalance_slack()) // self.cpd)
+        chunks = n_chunks
+        for j in range(n_chunks):
+            carry = self._step(carry)
+            # Respect the time budget inside long levels too.  The
+            # partial level runs the same overflow/terminal-flag
+            # checks as a full level before reporting, so a
+            # violation or capacity loss in the chunks already
+            # processed is never masked by TIME_EXHAUSTED.
+            # Dispatch is async — without the periodic block the
+            # whole level enqueues in milliseconds and the clock
+            # check below can never fire mid-level (round-3: a
+            # 120 s budget overran to 153 s, and the overrun runs
+            # the SLOWEST, highest-table-load chunks).
+            if (self.max_secs is not None and j % 16 == 15):
+                jax.block_until_ready(carry["j"])
+            if (self.max_secs is not None and j + 1 < n_chunks
+                    and time.time() - t0 > self.max_secs):
+                (out, explored, vis_total, drops, nxt_max,
+                 _j) = self._sync_checks(carry, depth, t0)
+                if out is None:
+                    out = self._limit_outcome("TIME_EXHAUSTED", carry,
+                                              depth, t0)
+                return (carry, out, explored, vis_total, drops, nxt_max,
+                        j + 1)
+        # ---- the one host sync per level.  With event-window spill, a
+        # chunk that had valid events past its window held j back —
+        # re-dispatch until the slowest device has completed all its
+        # chunks (no extra readbacks when nothing spilled: j_done rides
+        # the same stats vector).
+        while True:
+            (out, explored, vis_total, drops, nxt_max,
+             j_done) = self._sync_checks(carry, depth, t0)
+            if out is not None:
+                return (carry, out, explored, vis_total, drops, nxt_max,
+                        chunks)
+            if not self.ev_spill or j_done >= n_chunks:
+                return (carry, None, explored, vis_total, drops, nxt_max,
+                        chunks)
+            # Spill rounds respect the time budget too (the checks above
+            # already ran, so a verdict in the completed chunks is never
+            # masked).
+            if (self.max_secs is not None
+                    and time.time() - t0 > self.max_secs):
+                return (carry,
+                        self._limit_outcome("TIME_EXHAUSTED", carry,
+                                            depth, t0),
+                        explored, vis_total, drops, nxt_max, chunks)
+            for _ in range(n_chunks - j_done):
+                carry = self._step(carry)
+                chunks += 1
 
     def _spill_tmeta(self, carry) -> None:
         """Fold this level's appended (child_fp, parent_fp, event) rows
@@ -1062,19 +1407,25 @@ class ShardedTensorSearch(TensorSearch):
         events.reverse()
         return events
 
-    def _sync_checks(self, carry, depth, t0):
+    def _sync_checks(self, carry, depth, t0, stats=None):
         """The per-sync check pipeline: semantic overflow (raise) ->
         strict-mode drops (raise) -> terminal flags (checkState order) ->
         visited load factor (raise).  ONE device->host readback (the fused
-        ``_stats`` vector); the expensive flag-row readback happens only
-        when a terminal flag actually fired.  Returns
-        (outcome_or_none, explored, vis_total, drops, nxt_max, j_done)
-        where j_done is the slowest device's completed-chunk count (the
-        spill re-dispatch signal)."""
-        s = np.asarray(self._dispatch("sharded.sync", self._stats, carry))
+        ``_stats`` vector) — or zero when the superstep already returned
+        the vector in-program (``stats``); the expensive flag-row
+        readback happens only when a terminal flag actually fired.
+        Returns (outcome_or_none, explored, vis_total, drops, nxt_max,
+        j_done) where j_done is the slowest device's completed-chunk
+        count (the spill re-dispatch signal)."""
+        if stats is None:
+            s = np.asarray(self._dispatch(
+                "sharded.sync", self._prog("stats", self._stats), carry))
+        else:
+            s = np.asarray(stats)
+        nf = len(self._flag_names)
         (overflow, drops, vis_over, explored, vis_max, vis_total, nxt_max,
          j_done) = (int(x) for x in s[:8])
-        flag_counts = s[8:]
+        flag_counts = s[8:8 + nf]
         # Running total for outcome plumbing (SearchOutcome
         # .visited_overflow): keys the full table degraded to
         # treat-as-fresh — sound, but unique counts may over-report.

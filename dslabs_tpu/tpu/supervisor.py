@@ -240,6 +240,15 @@ class DispatchBoundary:
         optional ``engine`` override renames the tag prefix (the
         supervisor uses the rung name so plans written against the
         ladder vocabulary match)."""
+        # Per-site watchdog deadline scales, read LIVE from the search:
+        # a fused superstep dispatch legitimately runs a whole level's
+        # chunk work, so the sharded engine publishes
+        # ``_dispatch_deadline_scales = {"superstep": <trip count>}``
+        # and the steady-state deadline stretches accordingly
+        # (deadline_secs stays calibrated to single-dispatch
+        # granularity for every other site).
+        self._scales_src = (
+            lambda: getattr(search, "_dispatch_deadline_scales", None))
         if engine is None:
             search._dispatch_hook = self.dispatch
         else:
@@ -292,20 +301,33 @@ class DispatchBoundary:
         # without making CI runs unreproducible.
         return base * (1.0 + p.jitter * (2.0 * self._rng.random() - 1.0))
 
+    def _deadline_scale(self, tag: str) -> float:
+        src = getattr(self, "_scales_src", None)
+        if src is None:
+            return 1.0
+        scales = src()
+        if not scales:
+            return 1.0
+        return float(scales.get(tag.split(".", 1)[-1], 1.0))
+
     def _watchdog_call(self, tag: str, fn, args, rule):
         """Run one dispatch on a watchdog thread; abandon it at the
         deadline.  The first dispatch at each tag gets the compile-
-        inclusive grace deadline (RetryPolicy.first_deadline).  An
-        injected hang waits interruptibly AND checks for abandonment
-        before touching the real dispatch, so an abandoned fault thread
-        exits cleanly instead of racing device work in the background."""
+        inclusive grace deadline (RetryPolicy.first_deadline); sites
+        with a published deadline scale (superstep granularity — see
+        :meth:`DispatchBoundary.install`) stretch the steady-state
+        deadline by that factor.  An injected hang waits interruptibly
+        AND checks for abandonment before touching the real dispatch,
+        so an abandoned fault thread exits cleanly instead of racing
+        device work in the background."""
         release = threading.Event()
         box: List[Tuple[str, object]] = []
         seen = getattr(self, "_seen_tags", None)
         if seen is None:
             seen = self._seen_tags = set()
-        deadline = (self.policy.deadline_secs if tag in seen
-                    else self.policy.first_deadline())
+        scaled = self.policy.deadline_secs * self._deadline_scale(tag)
+        deadline = (scaled if tag in seen
+                    else max(self.policy.first_deadline(), scaled))
         seen.add(tag)
 
         def work():
@@ -400,7 +422,8 @@ class SearchSupervisor:
                  chunk: int = 1 << 10,
                  frontier_cap: int = 1 << 14,
                  visited_cap: int = 1 << 20,
-                 ev_budget=None):
+                 ev_budget=None,
+                 aot_warmup: bool = False):
         for rung in ladder:
             if rung not in ("sharded", "device", "host"):
                 raise ValueError(f"unknown ladder rung {rung!r}")
@@ -418,6 +441,10 @@ class SearchSupervisor:
         self.frontier_cap = frontier_cap
         self.visited_cap = visited_cap
         self.ev_budget = ev_budget
+        # AOT warm-up of the sharded rung's programs at build time —
+        # compile wall-time lands on SearchOutcome.compile_secs instead
+        # of inside the first run's measured window (bench.py).
+        self.aot_warmup = aot_warmup
         self.boundary: Optional[DispatchBoundary] = None
         self.failures: List[EngineFailure] = []
         # Engines are cached per rung so repeated run() calls (e.g. the
@@ -453,7 +480,8 @@ class SearchSupervisor:
                 frontier_cap=self.frontier_cap,
                 visited_cap=self.visited_cap, max_depth=self.max_depth,
                 max_secs=self.max_secs, strict=self.strict,
-                ev_budget=self.ev_budget, **ck)
+                ev_budget=self.ev_budget,
+                aot_warmup=self.aot_warmup, **ck)
         return TensorSearch(
             self.protocol, frontier_cap=self.frontier_cap,
             chunk=self.chunk, max_depth=self.max_depth,

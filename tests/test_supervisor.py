@@ -302,6 +302,64 @@ def test_supervisor_zero_fault_plan_is_transparent():
     assert out.engine == "device"
 
 
+def test_superstep_transient_fault_retries_in_place():
+    """ISSUE 3 satellite: a FaultPlan fault injected INSIDE a superstep
+    dispatch retries exactly as the per-chunk dispatches did.  In
+    superstep mode the sharded rung's dispatch sequence is
+    init, (superstep, promote)*: index 3 IS a superstep dispatch."""
+    proto = _pruned_pingpong()
+    base = _sup(proto).run()
+    sup = _sup(proto, fault_plan=FaultPlan().raise_at(3, count=2),
+               policy=RetryPolicy(max_retries=3, backoff_base=0.001))
+    out = sup.run()
+    assert sup._engines["sharded"].use_superstep, (
+        "test must exercise the fused superstep driver")
+    _same_verdict(out, base)
+    assert out.engine == "sharded"
+    assert out.retries == 2
+    assert out.failovers == 0
+
+
+def test_superstep_fatal_fails_over_and_resumes_checkpoint(tmp_path):
+    """ISSUE 3 satellite: a fatal fault inside a superstep dispatch
+    fails over down the ladder and the next rung resumes from the
+    unified checkpoint at the correct depth — the dispatch-boundary /
+    checkpoint contracts survive the superstep refactor unchanged."""
+    proto = _pruned_pingpong()
+    base = _sup(proto).run()
+    ckpt = str(tmp_path / "ss.npz")
+    # Dispatch 7 = the level-4 superstep (init + 2/level); checkpoints
+    # land after levels 1..3 (async skip-if-busy may skip some, never
+    # all — level gaps outlast the tiny dump).
+    plan = FaultPlan().raise_at(7, error=FatalError, engine="sharded")
+    sup = _sup(proto, fault_plan=plan, checkpoint_path=ckpt,
+               checkpoint_every=1, policy=RetryPolicy(max_retries=0))
+    out = sup.run()
+    assert sup._engines["sharded"].use_superstep
+    _same_verdict(out, base)
+    assert out.engine == "device"
+    assert out.failovers == 1
+    assert 0 < out.resumed_from_depth <= 3
+
+
+def test_superstep_watchdog_deadline_scales_with_trip_count():
+    """The watchdog's steady-state deadline stretches by the published
+    superstep trip-count scale (a fused level step legitimately runs a
+    whole level's chunk work), while other sites keep the single-
+    dispatch deadline."""
+    from dslabs_tpu.tpu.supervisor import DispatchBoundary
+
+    class Search:
+        _dispatch_deadline_scales = {"superstep": 8.0}
+
+    b = DispatchBoundary(RetryPolicy(deadline_secs=2.0))
+    b.install(Search())
+    assert b._deadline_scale("sharded.superstep") == 8.0
+    assert b._deadline_scale("sharded.promote") == 1.0
+    bare = DispatchBoundary(RetryPolicy(deadline_secs=2.0))
+    assert bare._deadline_scale("sharded.superstep") == 1.0
+
+
 def test_install_retry_single_engine():
     """install_retry (the backend's light-touch wrapper): transient
     faults retry in place on a bare engine; exhaustion is a loud
