@@ -25,7 +25,16 @@ bench:           ## TPU states/min benchmark (one JSON line)
 perf-smoke:      ## fast CPU perf gate vs the BASELINE.json floor
 	$(PY) -m pytest tests/ -q -m perf -s -p no:cacheprovider
 
-fault-smoke:     ## injected-fault recovery suite (retry/failover/resume/watchdog) on CPU
+# fault-smoke = the full injected-fault recovery suite: the in-process
+# retry/failover/resume/watchdog paths (tests/test_supervisor.py) PLUS
+# the process-isolation warden's deterministic kill/hang/crash matrix
+# (tests/test_warden.py — child SIGKILLed mid-search resumes from the
+# checkpoint, a hung child is reaped within its heartbeat grace,
+# exit-code classification pinned, .prev-rotation torn-write recovery).
+# Tier-1 keeps only the FAST warden tests (spawn-light, no accelerator);
+# the slowest spawn-heavy variants are additionally marked `slow` and
+# run only here.
+fault-smoke:     ## injected-fault recovery suite (retry/failover/resume/watchdog/warden) on CPU
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m fault -p no:cacheprovider
 
 dryrun:          ## multi-chip sharding dry run on a virtual CPU mesh

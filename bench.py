@@ -9,13 +9,24 @@ the frontier + visited set in HBM with one scalar sync per level.  All
 device arithmetic is int32/uint32 (round 1 crashed the TPU worker inside
 x64-emulated fingerprints; x64 is banned from device code).
 
-Round-5 structure — BOUNDED and DIAGNOSABLE (the round-4 bench hit the
-driver timeout with an empty tail; VERDICT r4 item 1):
+Round-6 structure — BOUNDED, DIAGNOSABLE, and NEVER SILENT (ISSUE 4:
+BENCH_r04 was killed by the external timeout with NO JSON at all, and
+BENCH_r05's preflight hung for 300 s so the CPU fallback never ran):
 
 * A **hard global deadline** (DSLABS_BENCH_DEADLINE_SECS, default 480 s):
   every phase gets min(its own cap, time remaining); when the deadline
   expires the parent prints the best-so-far JSON line and exits 0 — a
   partial result with an attributable error beats a silent rc=124.
+* **Guaranteed last-line JSON**: SIGTERM/SIGINT handlers plus a
+  top-level try/except print the best-so-far result (tagged with the
+  signal / traceback) before exiting 0 — an external ``timeout`` kill
+  can no longer leave an empty tail.
+* **Warden probes**: every phase child heartbeats on stderr and is
+  watched by the shared silence monitor (tpu/warden.py LineWatch) — a
+  WEDGED runtime stops heartbeating and is SIGKILLed at the silence
+  budget (preflight: ~60 s), not at the full phase budget, so the
+  240 s CPU fallback always fits inside the 480 s deadline.  The
+  preflight kill is re-budgeted to <= 120 s total (the BENCH_r05 fix).
 * A **pre-flight** subprocess (tiny matmul) distinguishes a wedged
   accelerator runtime from a slow compile: if 256x256 @ 256x256 cannot
   finish in its window, the bench reports "TPU runtime wedged" instead
@@ -31,10 +42,13 @@ driver timeout with an empty tail; VERDICT r4 item 1):
   one attempt, child-side time bound (a slow run returns a partial rate,
   TIME_EXHAUSTED, instead of a parent kill).  Beam runs only with time
   left and is reported under "beam".
+
+Budget table (vs the 480 s deadline): docs/resilience.md.
 """
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -43,7 +57,23 @@ import traceback
 BASELINE_STATES_PER_MIN = 1e8
 
 DEADLINE_SECS = float(os.environ.get("DSLABS_BENCH_DEADLINE_SECS", 480.0))
-PREFLIGHT_CAP_SECS = 150.0   # import+client init+first tiny compile
+# Preflight: import + client init + one tiny (cached) compile.  Budget
+# + slack is capped at 120 s TOTAL so a wedged preflight can never
+# starve the 240 s CPU fallback out of the 480 s deadline (BENCH_r05
+# hung here for 300 s and the round recorded value 0.0).
+PREFLIGHT_CAP_SECS = 90.0
+PREFLIGHT_KILL_SLACK_SECS = 30.0
+# Heartbeat-silence kill budgets (tpu/warden.py LineWatch): the
+# preflight child heartbeats between its boot stages, so a wedged
+# runtime dies at ~60 s, not at the phase budget; measured phases
+# heartbeat per level/phase and get a LONG leash — their one
+# legitimate silence is a cold-cache XLA compile, which hit ~300 s on
+# the tunnelled TPU runtime (BENCH_r05), and the preflight has already
+# proven the runtime alive before any measured phase runs.
+PREFLIGHT_SILENCE_SECS = float(os.environ.get(
+    "DSLABS_BENCH_PREFLIGHT_SILENCE_SECS", 60.0))
+PHASE_SILENCE_SECS = float(os.environ.get(
+    "DSLABS_BENCH_SILENCE_SECS", 330.0))
 CALIBRATE_CAP_SECS = 240.0
 FALLBACK_CAP_SECS = 240.0    # wedged-TPU CPU-mesh fallback phase
 STRICT_CAP_SECS = 420.0      # child budget cap; parent adds kill slack
@@ -120,21 +150,31 @@ def _persistent_cache():
 # --------------------------------------------------------------- children
 
 def _preflight() -> dict:
-    """Accelerator liveness probe — a THIN CLIENT of the search
-    supervisor's wall-clock watchdog (tpu/supervisor.py
-    ``probe_device``): the tiny matmul runs through the same dispatch
-    boundary the search hot loops use, so a wedged runtime surfaces as
-    a classified, attributable ``DispatchTimeout`` inside this bounded
-    subprocess instead of a bare hang in a 400 s search phase."""
-    if os.environ.get("DSLABS_BENCH_FAKE_WEDGE"):
-        # Test knob: simulate the BENCH_r04/r05 wedge shape so the
-        # cpu-fallback path is exercisable without a broken accelerator.
+    """Accelerator liveness probe — a WARDEN PROBE twice over: the
+    child heartbeats between its boot stages (so the parent's silence
+    monitor kills a wedged runtime in ~60 s, not at the phase budget),
+    and the tiny matmul runs through the same dispatch boundary the
+    search hot loops use (tpu/supervisor.py ``probe_device``), so a
+    wedge that lets heartbeats through still surfaces as a classified,
+    attributable ``DispatchTimeout`` inside this bounded subprocess
+    instead of a bare hang in a 400 s search phase."""
+    wedge = os.environ.get("DSLABS_BENCH_FAKE_WEDGE")
+    if wedge == "hang":
+        # Test knob, hang shape: the child goes SILENT (the true
+        # BENCH_r05 wedge) — only the parent's silence kill ends it.
+        _hb("preflight: simulated wedge (hanging)")
+        time.sleep(100000.0)
+    if wedge:
+        # Test knob, fast shape: the wedge raises immediately so the
+        # cpu-fallback path is exercisable cheaply in CI.
         raise RuntimeError("fake TPU wedge (DSLABS_BENCH_FAKE_WEDGE)")
+    _hb("preflight: boot (import + compile cache)")
     _persistent_cache()
     from dslabs_tpu.tpu.supervisor import probe_device
 
+    _hb("preflight: probe matmul")
     return probe_device(deadline_secs=float(os.environ.get(
-        "DSLABS_PREFLIGHT_DEADLINE_SECS", "120.0")))
+        "DSLABS_PREFLIGHT_DEADLINE_SECS", "60.0")))
 
 
 def _calibrate(max_depth: int = 7) -> dict:
@@ -298,6 +338,7 @@ def _run_strict(ev_budget, budget_secs: float) -> dict:
         "retries": outcome.retries,
         "failovers": outcome.failovers,
         "resumed_from_depth": outcome.resumed_from_depth,
+        "abandoned_threads": outcome.abandoned_threads,
     }
 
 
@@ -366,13 +407,22 @@ def _cpu_fallback(budget_secs: float) -> dict:
 
 # ----------------------------------------------------------------- parent
 
-def _sub(args, child_budget: float, label: str):
-    """Run a bench phase subprocess.  The child's stderr is TEE'd line
-    by line to this process's stderr (live heartbeats in the driver
-    tail) while the last lines are buffered so a failure's JSON error
-    stays attributable; stdout's last line is the phase JSON.  Returns
-    (parsed dict, None) or (None, error string)."""
-    import threading
+_CURRENT_CHILD = None     # live phase Popen, killed by the signal handler
+
+
+def _sub(args, child_budget: float, label: str,
+         kill_slack: float = KILL_SLACK_SECS,
+         silence=None):
+    """Run a bench phase subprocess as a WARDEN PROBE (tpu/warden.py
+    LineWatch): the child's stderr is TEE'd line by line to this
+    process's stderr (live heartbeats in the driver tail) while the
+    last lines are buffered so a failure's JSON error stays
+    attributable, and a child whose heartbeats stop for ``silence``
+    seconds — a wedged runtime — is SIGKILLed immediately instead of
+    at the full budget.  stdout's last line is the phase JSON.
+    Returns (parsed dict, None) or (None, error string)."""
+    global _CURRENT_CHILD
+    from dslabs_tpu.tpu.warden import LineWatch
 
     # The kill slack must never push past the GLOBAL deadline — a
     # driver that enforces DSLABS_BENCH_DEADLINE_SECS externally would
@@ -383,18 +433,16 @@ def _sub(args, child_budget: float, label: str):
         err = f"{label} skipped: global deadline exhausted"
         _hb(f"phase {label}: SKIPPED (deadline)")
         return None, err
-    timeout = min(child_budget + KILL_SLACK_SECS, _remaining() - 5)
+    timeout = min(child_budget + kill_slack, _remaining() - 5)
     _hb(f"phase {label}: start (budget {child_budget:.0f}s, "
-        f"kill at {timeout:.0f}s, deadline in {_remaining():.0f}s)")
+        f"kill at {timeout:.0f}s"
+        + (f", silence kill at {silence:.0f}s" if silence else "")
+        + f", deadline in {_remaining():.0f}s)")
     t0 = time.time()
-    err_tail: list = []
 
-    def _tee(pipe):
-        for line in pipe:
-            sys.stderr.write(line)
-            sys.stderr.flush()
-            err_tail.append(line.rstrip()[:300])
-            del err_tail[:-5]
+    def _tee(line):
+        sys.stderr.write(line)
+        sys.stderr.flush()
 
     try:
         env = dict(os.environ, DSLABS_LEVEL_TIMING="1")
@@ -402,38 +450,39 @@ def _sub(args, child_budget: float, label: str):
             [sys.executable, os.path.abspath(__file__)] + args,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=env, cwd=os.path.dirname(os.path.abspath(__file__)))
-        t = threading.Thread(target=_tee, args=(proc.stderr,),
-                             daemon=True)
-        t.start()
-        # wait() + read() instead of communicate(): communicate would
-        # spawn its OWN stderr drain thread and race the tee for lines.
-        # The child's stdout is one small JSON line printed at exit, so
-        # reading it after wait() cannot deadlock on a full pipe.
-        try:
-            proc.wait(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-            proc.wait()
+        _CURRENT_CHILD = proc
+        watch = LineWatch(proc, proc.stderr, on_line=_tee)
+        status, rc = watch.wait(timeout, silence=silence)
+        if status == "silence":
+            err = (f"{label} wedged: no heartbeat for {silence:.0f}s "
+                   f"(killed at +{time.time() - t0:.0f}s; last stderr: "
+                   f"{' | '.join(watch.tail[-2:])})")
+            _hb(f"phase {label}: WEDGED ({err})")
+            return None, err
+        if status == "total":
             err = (f"{label} killed at {timeout:.0f}s "
                    "(accelerator hang or compile overrun; last stderr: "
-                   f"{' | '.join(err_tail[-2:])})")
+                   f"{' | '.join(watch.tail[-2:])})")
             _hb(f"phase {label}: TIMEOUT ({err})")
             return None, err
+        # The child's stdout is one small JSON line printed at exit, so
+        # reading it after wait() cannot deadlock on a full pipe.
         stdout = proc.stdout.read()
-        t.join(timeout=5.0)
-        if proc.returncode == 0 and stdout.strip():
+        if rc == 0 and stdout.strip():
             out = json.loads(stdout.strip().splitlines()[-1])
             _hb(f"phase {label}: ok in {time.time() - t0:.0f}s")
             return out, None
-        err = f"{label} exited rc={proc.returncode}"
-        if err_tail:
-            err += f" last-stderr={err_tail[-1]}"
+        err = f"{label} exited rc={rc}"
+        if watch.tail:
+            err += f" last-stderr={watch.tail[-1]}"
         _hb(f"phase {label}: FAILED ({err})")
         return None, err
     except Exception:
         err = traceback.format_exc(limit=2).strip().splitlines()[-1][:300]
         _hb(f"phase {label}: ERROR ({err})")
         return None, err
+    finally:
+        _CURRENT_CHILD = None
 
 
 def _load_cal_cache():
@@ -455,9 +504,46 @@ def _store_cal_cache(cal) -> None:
         pass
 
 
+_EMITTED = False
+
+
 def _emit(result: dict) -> None:
+    """Print THE one JSON line (idempotent: the signal handler and the
+    normal path can both reach here; only the first wins)."""
+    global _EMITTED
+    if _EMITTED:
+        return
+    _EMITTED = True
     print(json.dumps(result))
     sys.stdout.flush()
+
+
+def _install_signal_emitters(result: dict) -> None:
+    """Guarantee the last-line JSON even under an external kill: an
+    external ``timeout``'s SIGTERM (the BENCH_r04 rc=124 shape, empty
+    output) or a ^C now prints the best-so-far result — tagged with
+    the signal — kills the live phase child, and exits 0."""
+
+    def _on_signal(signum, frame):
+        name = signal.Signals(signum).name
+        result.setdefault(
+            "error", f"killed by {name} (external timeout?) at "
+                     f"+{time.time() - _T0:.0f}s")
+        result["total_secs"] = round(time.time() - _T0, 1)
+        child = _CURRENT_CHILD
+        if child is not None:
+            try:
+                child.kill()
+            except OSError:
+                pass
+        _emit(result)
+        # os._exit: the handler may be interrupting arbitrary frames
+        # (a child wait, a JSON dump) — unwind nothing, the line is
+        # already out and exit code 0 tells the driver we reported.
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
 
 
 def _set_headline(result: dict, phase: dict, kind: str, platform: str,
@@ -474,9 +560,12 @@ def _set_headline(result: dict, phase: dict, kind: str, platform: str,
     for k in ("compile_secs", "aot_compile_secs"):
         if phase.get(k) is not None:
             result[k] = phase[k]
-    # Robustness counters ride the headline (ISSUE 2): the perf
-    # trajectory shows what recovery, if any, the number absorbed.
-    for k in ("retries", "failovers", "resumed_from_depth"):
+    # Robustness counters ride the headline (ISSUE 2/4): the perf
+    # trajectory shows what recovery, if any, the number absorbed —
+    # abandoned_threads included, so in-process watchdog degradation
+    # (leaked wedged-dispatch threads) is visible in the JSON.
+    for k in ("retries", "failovers", "resumed_from_depth",
+              "abandoned_threads"):
         result[k] = phase.get(k, 0)
 
 
@@ -487,11 +576,18 @@ def main() -> None:
         "value": 0.0, "unit": "states/min", "vs_baseline": 0.0,
         "deadline_secs": DEADLINE_SECS,
     }
+    _install_signal_emitters(result)
 
-    # ---- phase 0: pre-flight (wedge detection + platform probe)
+    # ---- phase 0: pre-flight (wedge detection + platform probe).
+    # Kill budget <= 120 s TOTAL (cap 90 + slack 30) and a ~60 s
+    # heartbeat-silence kill: a wedged runtime dies in about a minute
+    # and the 240 s CPU fallback always has deadline left (the
+    # BENCH_r05 failure had the preflight eat 300 of 480 s).
     pf, pf_err = _sub(["--preflight"],
                       min(PREFLIGHT_CAP_SECS, max(_remaining() - 30, 30)),
-                      "preflight")
+                      "preflight",
+                      kill_slack=PREFLIGHT_KILL_SLACK_SECS,
+                      silence=PREFLIGHT_SILENCE_SECS)
     if pf is None:
         result["error"] = (
             "TPU runtime wedged or unreachable: pre-flight 256x256 "
@@ -504,7 +600,7 @@ def main() -> None:
             ["--cpu-fallback",
              str(min(FALLBACK_CAP_SECS, max(_remaining() - 30, 60.0)))],
             min(FALLBACK_CAP_SECS, max(_remaining() - 20, 60.0)),
-            "cpu-fallback")
+            "cpu-fallback", silence=PHASE_SILENCE_SECS)
         if fb is not None:
             result["backend"] = fb.get("backend", "cpu-fallback")
             result["cpu_fallback"] = fb
@@ -530,7 +626,8 @@ def main() -> None:
         beam, beam_err = _sub(
             ["--rung", "64", str(1 << 12), str(1 << 18), "30.0",
              str(FALLBACK_EV_BUDGET[0]), str(FALLBACK_EV_BUDGET[1])],
-            min(BEAM_CAP_SECS, max(_remaining() - 15, 45)), "beam-cpu")
+            min(BEAM_CAP_SECS, max(_remaining() - 15, 45)), "beam-cpu",
+            silence=PHASE_SILENCE_SECS)
         if beam:
             _set_headline(result, beam, "BFS (beam)", platform, n_dev)
             result["beam"] = beam
@@ -550,7 +647,7 @@ def main() -> None:
         # (raise DSLABS_BENCH_DEADLINE_SECS for the fully-calibrated
         # run); otherwise the round-3 measured fallback budgets hold.
         cal, cal_err = _sub(["--calibrate"], CALIBRATE_CAP_SECS,
-                            "calibrate")
+                            "calibrate", silence=PHASE_SILENCE_SECS)
         if cal is not None:
             _store_cal_cache(cal)
             result["calibration"] = cal
@@ -572,7 +669,7 @@ def main() -> None:
     if budget > 60:
         strict, strict_err = _sub(
             ["--strict", str(ev[0]), str(ev[1]), str(budget)],
-            budget, "strict")
+            budget, "strict", silence=PHASE_SILENCE_SECS)
         if strict is not None:
             result["strict"] = strict
             _set_headline(result, strict, "strict BFS", platform, n_dev)
@@ -593,7 +690,7 @@ def main() -> None:
         beam, beam_err = _sub(
             ["--rung", str(chunk), str(f_cap), str(v_cap),
              str(run_secs), str(ev[0]), str(ev[1])], budget,
-            f"beam-{chunk}")
+            f"beam-{chunk}", silence=PHASE_SILENCE_SECS)
         if beam is not None:
             break
     if beam is not None:
@@ -635,12 +732,17 @@ if __name__ == "__main__":
         sys.exit(0)
     try:
         main()
-    except Exception:
+    except BaseException:
+        # The last line of defense for "bench never reports nothing":
+        # ANY escape from main (SystemExit from a signal handler
+        # already emitted; everything else lands here) still prints a
+        # tagged, parsable JSON line and exits 0.
         tb = traceback.format_exc(limit=3)
-        print(json.dumps({
+        _emit({
             "metric": "lab3-paxos strict BFS unique states/min "
                       "(tensor backend)",
             "value": 0.0, "unit": "states/min", "vs_baseline": 0.0,
             "error": tb.strip().splitlines()[-1][:300],
-        }))
+            "total_secs": round(time.time() - _T0, 1),
+        })
         sys.exit(0)

@@ -27,9 +27,16 @@ fingerprint mismatch is refused LOUDLY (:class:`CheckpointMismatch`
 names both fingerprints); a checkpoint is never resumed silently into
 a search it does not describe.
 
-Writes are atomic (tmp + ``os.replace``): a kill mid-write leaves the
-previous complete dump.  :class:`AsyncCheckpointWriter` is the shared
-skip-if-busy background drain (one in-flight dump, never a queue).
+Writes are torn-write-proof twice over: the dump is written to a tmp
+file and ``os.replace``d into place (a kill mid-write leaves the
+previous complete dump), the PREVIOUS dump is rotated to ``<path>.prev``
+first, and every dump carries a CRC32 content checksum.  The loader
+verifies the checksum and falls back to the rotated ``.prev`` dump WITH
+A LOUD WARNING on any truncation/corruption — a machine dying mid-write
+(the warden's SIGKILL included, tpu/warden.py) costs at most one
+checkpoint interval, never the run.  :class:`AsyncCheckpointWriter` is
+the shared skip-if-busy background drain (one in-flight dump, never a
+queue).
 """
 
 from __future__ import annotations
@@ -37,13 +44,16 @@ from __future__ import annotations
 import dataclasses
 import os
 import threading
+import warnings
+import zlib
 from typing import Optional
 
 import numpy as np
 
-__all__ = ["FORMAT_VERSION", "CheckpointMismatch", "SearchCheckpoint",
-           "config_fingerprint", "save", "load", "peek_fingerprint",
-           "AsyncCheckpointWriter", "default_compile_cache_dir"]
+__all__ = ["FORMAT_VERSION", "CheckpointMismatch", "CheckpointCorrupt",
+           "SearchCheckpoint", "config_fingerprint", "save", "load",
+           "peek_fingerprint", "peek_depth", "AsyncCheckpointWriter",
+           "default_compile_cache_dir"]
 
 
 def default_compile_cache_dir(checkpoint_path) -> "Optional[str]":
@@ -59,7 +69,7 @@ def default_compile_cache_dir(checkpoint_path) -> "Optional[str]":
         os.path.dirname(os.path.abspath(checkpoint_path)),
         "compile_cache")
 
-FORMAT_VERSION = "dslabs-search-ckpt-v6"
+FORMAT_VERSION = "dslabs-search-ckpt-v7"
 
 
 class CheckpointMismatch(RuntimeError):
@@ -68,6 +78,13 @@ class CheckpointMismatch(RuntimeError):
     Raised instead of silently resuming (or silently ignoring) a dump
     from a different protocol/capacity configuration — the message
     names BOTH fingerprints so the divergent knob is attributable."""
+
+
+class CheckpointCorrupt(RuntimeError):
+    """Every candidate dump (main and the rotated ``.prev``) failed its
+    checksum/read — there is nothing sound to resume.  Raised loudly
+    instead of resuming a torn dump or silently starting from the
+    root."""
 
 
 @dataclasses.dataclass
@@ -97,8 +114,26 @@ def config_fingerprint(protocol, strict: bool,
                  protocol.timer_cap, bool(strict), bool(record_trace)))
 
 
+def _content_checksum(host: dict) -> np.uint32:
+    """CRC32 over every entry's name, dtype/shape, and raw bytes (sorted
+    key order; the ``checksum`` entry itself excluded) — the torn-write
+    detector the loader verifies before trusting a dump."""
+    crc = 0
+    for key in sorted(host):
+        if key == "checksum":
+            continue
+        arr = np.asarray(host[key])
+        crc = zlib.crc32(key.encode(), crc)
+        crc = zlib.crc32(repr((arr.dtype.str, arr.shape)).encode(), crc)
+        crc = zlib.crc32(np.ascontiguousarray(arr).tobytes(), crc)
+    return np.uint32(crc & 0xFFFFFFFF)
+
+
 def save(path: str, ckpt: SearchCheckpoint) -> None:
-    """Atomic dump: write to ``path + '.tmp'``, then ``os.replace``."""
+    """Atomic checksummed dump with one-deep rotation: write to
+    ``path + '.tmp'``, rotate any existing dump to ``path + '.prev'``,
+    then ``os.replace`` the tmp into place.  A kill at ANY point leaves
+    at least one complete, checksum-verifiable dump on disk."""
     host = {
         "config": np.bytes_(ckpt.fingerprint.encode()),
         "depth": np.int64(ckpt.depth),
@@ -111,56 +146,134 @@ def save(path: str, ckpt: SearchCheckpoint) -> None:
     }
     if ckpt.fp_map is not None and len(ckpt.fp_map):
         host["fp_map"] = np.asarray(ckpt.fp_map, np.int64)
+    host["checksum"] = _content_checksum(host)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         np.savez(f, **host)
+    if os.path.exists(path):
+        os.replace(path, path + ".prev")
     os.replace(tmp, path)
+
+
+def _candidates(path: str):
+    """Load order: the main dump, then the rotated previous dump."""
+    return (path, path + ".prev")
 
 
 def peek_fingerprint(path: str) -> Optional[str]:
     """The dump's fingerprint WITHOUT loading the arrays (callers that
     only need a resumability boolean must not pay the full load), or
-    None when the file is missing/unreadable/not a checkpoint."""
-    if not path or not os.path.exists(path):
+    None when no readable dump exists.  An unreadable/truncated main
+    dump falls through to ``.prev`` — resumability must track what the
+    loader would actually resume."""
+    if not path:
         return None
+    for cand in _candidates(path):
+        if not os.path.exists(cand):
+            continue
+        try:
+            with np.load(cand) as z:
+                if "config" in z.files:
+                    return z["config"].item().decode()
+        except Exception:
+            continue
+    return None
+
+
+def peek_depth(path: str) -> Optional[int]:
+    """The dump's checkpointed depth without loading the state arrays
+    (the warden's heartbeat reports it as the durable-resume point), or
+    None when no readable dump exists."""
+    if not path:
+        return None
+    for cand in _candidates(path):
+        if not os.path.exists(cand):
+            continue
+        try:
+            with np.load(cand) as z:
+                if "depth" in z.files:
+                    return int(z["depth"])
+        except Exception:
+            continue
+    return None
+
+
+def _load_verified(path: str) -> dict:
+    """Read EVERY entry of a dump and verify the content checksum.
+    Raises :class:`CheckpointCorrupt` on truncation, unreadable zip
+    content, a missing checksum, or a checksum mismatch."""
     try:
         with np.load(path) as z:
-            if "config" not in z.files:
-                return None
-            return z["config"].item().decode()
-    except Exception:
-        return None
+            data = {k: z[k] for k in z.files}
+    except Exception as e:
+        raise CheckpointCorrupt(
+            f"{path}: unreadable/truncated checkpoint "
+            f"({type(e).__name__}: {e})") from e
+    if "config" not in data:
+        raise CheckpointCorrupt(
+            f"{path}: not a search checkpoint (no config fingerprint)")
+    if "checksum" not in data:
+        raise CheckpointCorrupt(
+            f"{path}: no content checksum (pre-{FORMAT_VERSION} or "
+            "torn dump)")
+    want = int(np.uint32(data["checksum"]))
+    got = int(_content_checksum(data))
+    if want != got:
+        raise CheckpointCorrupt(
+            f"{path}: content checksum mismatch (stored {want:#010x}, "
+            f"computed {got:#010x}) — torn or corrupted dump")
+    return data
 
 
 def load(path: str, fingerprint: str) -> Optional[SearchCheckpoint]:
-    """Load and VERIFY a dump: ``None`` when no file exists; a loud
+    """Load and VERIFY a dump.  ``None`` when no file exists; a loud
     :class:`CheckpointMismatch` (naming both fingerprints) when the
-    dump belongs to a different configuration."""
-    if not path or not os.path.exists(path):
+    dump belongs to a different configuration.  A corrupt/truncated
+    main dump (failed checksum, unreadable zip) falls back to the
+    rotated ``.prev`` dump with a LOUD warning — one checkpoint
+    interval lost, never the run; when every candidate is corrupt the
+    loader raises :class:`CheckpointCorrupt` instead of silently
+    restarting from the root."""
+    if not path:
         return None
-    with np.load(path) as z:
-        if "config" not in z.files:
-            raise CheckpointMismatch(
-                f"{path}: not a search checkpoint (no config "
-                "fingerprint)")
-        found = z["config"].item().decode()
+    errors = []
+    seen_any = False
+    for cand in _candidates(path):
+        if not os.path.exists(cand):
+            continue
+        seen_any = True
+        try:
+            data = _load_verified(cand)
+        except CheckpointCorrupt as e:
+            warnings.warn(
+                f"checkpoint {cand} failed verification ({e}); "
+                "falling back to the rotated previous dump",
+                RuntimeWarning, stacklevel=2)
+            errors.append(e)
+            continue
+        found = data["config"].item().decode()
         if found != fingerprint:
             raise CheckpointMismatch(
-                f"refusing to resume {path}: checkpoint fingerprint\n"
+                f"refusing to resume {cand}: checkpoint fingerprint\n"
                 f"  {found}\ndoes not match the live search's\n"
                 f"  {fingerprint}\n(dump from a different protocol/"
                 "capacity config — delete the file or fix the config)")
         return SearchCheckpoint(
             fingerprint=found,
-            depth=int(z["depth"]),
-            explored=int(z["explored"]),
-            elapsed=float(z["elapsed"]),
-            frontier=np.asarray(z["frontier"], np.int32),
-            visited_keys=np.asarray(z["visited_keys"], np.uint32),
-            vis_over=int(z["vis_over"]) if "vis_over" in z.files else 0,
-            dropped=int(z["dropped"]) if "dropped" in z.files else 0,
-            fp_map=(np.asarray(z["fp_map"], np.int64)
-                    if "fp_map" in z.files else None))
+            depth=int(data["depth"]),
+            explored=int(data["explored"]),
+            elapsed=float(data["elapsed"]),
+            frontier=np.asarray(data["frontier"], np.int32),
+            visited_keys=np.asarray(data["visited_keys"], np.uint32),
+            vis_over=int(data["vis_over"]) if "vis_over" in data else 0,
+            dropped=int(data["dropped"]) if "dropped" in data else 0,
+            fp_map=(np.asarray(data["fp_map"], np.int64)
+                    if "fp_map" in data else None))
+    if not seen_any:
+        return None
+    raise CheckpointCorrupt(
+        f"no readable checkpoint at {path} (main and .prev both failed "
+        "verification): " + "; ".join(str(e) for e in errors))
 
 
 class AsyncCheckpointWriter:

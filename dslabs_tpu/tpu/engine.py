@@ -214,6 +214,16 @@ class SearchOutcome:
     failovers: int = 0               # ladder rungs abandoned before this one
     resumed_from_depth: int = 0      # checkpoint depth resumed from (0=root)
     engine: Optional[str] = None     # ladder rung that produced the verdict
+    # Process-isolation accounting (tpu/warden.py): children the warden
+    # spawned beyond the first on the way to this verdict, and
+    # dispatches SIGKILLed mid-flight after heartbeat silence.  Zero in
+    # in-process mode.
+    child_restarts: int = 0
+    killed_dispatches: int = 0
+    # In-process watchdog leak accounting: watchdog-abandoned daemon
+    # threads STILL BLOCKED when the verdict landed (each one pins a
+    # wedged XLA dispatch; process isolation is the leak-free mode).
+    abandoned_threads: int = 0
     # Structured per-level throughput records from the sharded driver
     # (dicts of depth / chunks / wall / explored / unique /
     # next_frontier) — the bench emits them as its throughput series;
@@ -1552,6 +1562,9 @@ class TensorSearch:
                                      len(visited[0]), depth,
                                      time.time() - t0)
             depth += 1
+            # Live depth for supervision heartbeats (the dispatch
+            # observer reads it — tpu/supervisor.py, tpu/warden.py).
+            self._current_depth = depth
             if self.record_trace:
                 self._levels.append({"parent_rows": parent_rows,
                                      "event_ids": []})
@@ -2036,6 +2049,8 @@ class TensorSearch:
                     "DEPTH_EXHAUSTED", last[0], last[1], depth,
                     time.time() - t0, visited_overflow=last[2])
             depth += 1
+            # Live depth for supervision heartbeats (tpu/warden.py).
+            self._current_depth = depth
             # A checkpoint-due wave skips the speculative next-wave
             # dispatch: the snapshot must see the carry at a clean wave
             # boundary, not mid-way through wave depth+1.

@@ -1173,6 +1173,8 @@ class ShardedTensorSearch(TensorSearch):
                     return self._limit_outcome("TIME_EXHAUSTED", carry,
                                                depth, t0)
                 depth += 1
+                # Live depth for supervision heartbeats (tpu/warden.py).
+                self._current_depth = depth
                 t_lvl = time.time()
                 # Final depth-limited level: count/check fresh successors
                 # without building the next frontier (it would never be

@@ -35,6 +35,16 @@ production training/inference stack assumes:
   the same boundary makes every recovery path exercisable in CI on CPU
   ("dispatch k of engine E raises", "dispatch j hangs") — see
   tests/test_supervisor.py and ``make fault-smoke``.
+* **Process isolation.**  The in-process watchdog can only ABANDON a
+  wedged dispatch (the blocked daemon thread leaks — counted on
+  ``SearchOutcome.abandoned_threads`` and warned about past
+  ``DSLABS_ABANDONED_WARN``).  ``SearchSupervisor(
+  process_isolation=True, protocol_factory="module:callable")`` runs
+  the ladder through the dispatch warden instead (tpu/warden.py): each
+  rung is a SPAWNED CHILD heartbeating over a pipe, a silent child is
+  SIGKILLed and reaped, and the next rung's child resumes from the
+  unified checkpoint — nothing leaks, and a hard runtime wedge cannot
+  take the supervising process down.
 
 Every recovery ends in the normal ``SearchOutcome`` end-condition
 vocabulary — never a silent partial verdict — with ``retries``,
@@ -45,9 +55,11 @@ outcome.
 from __future__ import annotations
 
 import dataclasses
+import os
 import random
 import threading
 import time
+import warnings
 from typing import Dict, List, Optional, Tuple
 
 from dslabs_tpu.tpu import checkpoint as ckpt_mod
@@ -56,6 +68,13 @@ __all__ = ["TransientDeviceError", "DispatchTimeout", "EngineFailure",
            "SupervisorExhausted", "RetryPolicy", "FaultRule", "FaultPlan",
            "DispatchBoundary", "SearchSupervisor", "classify_failure",
            "install_retry", "probe_device"]
+
+# In-process watchdog abandonment LEAKS a blocked daemon thread (a
+# wedged XLA runtime cannot be interrupted from Python).  Past this many
+# still-blocked threads the boundary warns that the process is
+# degrading and process isolation (tpu/warden.py) is the right mode.
+ABANDONED_WARN_THRESHOLD = int(os.environ.get("DSLABS_ABANDONED_WARN",
+                                              "2"))
 
 
 class TransientDeviceError(RuntimeError):
@@ -226,7 +245,8 @@ class DispatchBoundary:
     """
 
     def __init__(self, policy: Optional[RetryPolicy] = None,
-                 plan: Optional[FaultPlan] = None):
+                 plan: Optional[FaultPlan] = None,
+                 observer=None):
         self.policy = policy or RetryPolicy()
         self.plan = plan
         self.retries = 0
@@ -234,6 +254,22 @@ class DispatchBoundary:
         self.counts: Dict[str, int] = {}
         self._engine_retries: Dict[str, int] = {}
         self._rng = random.Random(self.policy.seed)
+        # Optional per-dispatch observer, called as
+        # ``observer(phase, tag, index, depth)`` with phase ``"start"``
+        # before the wrapped call and ``"done"`` after it returns — the
+        # warden child's heartbeat emitter rides here (tpu/warden.py).
+        # Observer exceptions flow through the normal classification.
+        self.observer = observer
+        # Watchdog-abandoned daemon threads (the in-process mode's
+        # unavoidable leak: a wedged XLA dispatch cannot be interrupted
+        # from Python, only abandoned).  Tracked so the degradation is
+        # VISIBLE — SearchOutcome.abandoned_threads, bench JSON — and
+        # warned about past ABANDONED_WARN_THRESHOLD.
+        self.abandoned: List[threading.Thread] = []
+
+    def abandoned_alive(self) -> int:
+        """Watchdog-abandoned daemon threads still blocked right now."""
+        return sum(1 for t in self.abandoned if t.is_alive())
 
     def install(self, search, engine: Optional[str] = None) -> None:
         """Route ``search``'s dispatches through this boundary.  The
@@ -249,6 +285,10 @@ class DispatchBoundary:
         # granularity for every other site).
         self._scales_src = (
             lambda: getattr(search, "_dispatch_deadline_scales", None))
+        # Live BFS depth for the observer's heartbeats: every run loop
+        # publishes ``_current_depth`` as levels complete.
+        self._depth_src = (
+            lambda: int(getattr(search, "_current_depth", 0)))
         if engine is None:
             search._dispatch_hook = self.dispatch
         else:
@@ -259,6 +299,10 @@ class DispatchBoundary:
 
     # ------------------------------------------------------------ dispatch
 
+    def _depth(self) -> int:
+        src = getattr(self, "_depth_src", None)
+        return src() if src is not None else 0
+
     def dispatch(self, tag: str, fn, *args):
         engine = tag.split(".", 1)[0]
         passthrough = _passthrough_types()
@@ -267,6 +311,12 @@ class DispatchBoundary:
             self.counts[engine] = idx + 1
             rule = self.plan.match(engine, idx) if self.plan else None
             try:
+                if self.observer is not None:
+                    # Observer runs INSIDE the try: a fault it raises
+                    # (the warden test matrix injects there) is
+                    # classified like any dispatch failure, and a retry
+                    # re-announces the attempt.
+                    self.observer("start", tag, idx, self._depth())
                 if rule is not None and rule.kind == "raise":
                     # Raised BEFORE fn runs: the dispatch args (donated
                     # carries included) are untouched, so a retry of the
@@ -274,8 +324,12 @@ class DispatchBoundary:
                     raise rule.error(f"{rule.message} "
                                      f"[{engine} dispatch {idx}]")
                 if self.policy.deadline_secs is not None:
-                    return self._watchdog_call(tag, fn, args, rule)
-                return fn(*args)
+                    out = self._watchdog_call(tag, fn, args, rule)
+                else:
+                    out = fn(*args)
+                if self.observer is not None:
+                    self.observer("done", tag, idx, self._depth())
+                return out
             except passthrough:
                 raise
             except DispatchTimeout as e:
@@ -346,6 +400,23 @@ class DispatchBoundary:
         th.join(deadline)
         if th.is_alive():
             release.set()
+            # The leak is unavoidable in-process (Python cannot
+            # interrupt a blocked XLA call) but must never be
+            # invisible: count the still-blocked threads, warn past
+            # the threshold, and let the supervisor surface the live
+            # count on SearchOutcome.abandoned_threads.
+            self.abandoned = [t for t in self.abandoned if t.is_alive()]
+            self.abandoned.append(th)
+            n_alive = len(self.abandoned)
+            if n_alive >= ABANDONED_WARN_THRESHOLD:
+                warnings.warn(
+                    f"{n_alive} watchdog-abandoned dispatch threads "
+                    "are still blocked in this process (a wedged XLA "
+                    "runtime cannot be interrupted from Python); the "
+                    "in-process ladder is degrading — use process "
+                    "isolation (tpu/warden.py, SearchSupervisor("
+                    "process_isolation=True)) for hang-proof recovery",
+                    RuntimeWarning, stacklevel=2)
             raise DispatchTimeout(
                 f"dispatch {tag!r} exceeded its {deadline}s deadline "
                 "(wedged device); abandoned")
@@ -423,7 +494,13 @@ class SearchSupervisor:
                  frontier_cap: int = 1 << 14,
                  visited_cap: int = 1 << 20,
                  ev_budget=None,
-                 aot_warmup: bool = False):
+                 aot_warmup: bool = False,
+                 dispatch_observer=None,
+                 process_isolation: bool = False,
+                 protocol_factory: Optional[str] = None,
+                 factory_kwargs: Optional[dict] = None,
+                 protocol_transform: Optional[str] = None,
+                 warden_kwargs: Optional[dict] = None):
         for rung in ladder:
             if rung not in ("sharded", "device", "host"):
                 raise ValueError(f"unknown ladder rung {rung!r}")
@@ -445,6 +522,21 @@ class SearchSupervisor:
         # compile wall-time lands on SearchOutcome.compile_secs instead
         # of inside the first run's measured window (bench.py).
         self.aot_warmup = aot_warmup
+        self.dispatch_observer = dispatch_observer
+        # Process isolation (tpu/warden.py): the accelerator-facing
+        # search loop runs in a SPAWNED CHILD supervised over a pipe —
+        # a wedged runtime is SIGKILLed and the next rung's child
+        # resumes from the unified checkpoint, instead of the
+        # in-process watchdog's leaked-thread abandonment.  The child
+        # rebuilds the protocol from ``protocol_factory``
+        # ("module:callable" + ``factory_kwargs``, optionally piped
+        # through ``protocol_transform``) because live protocol
+        # objects hold closures a process boundary cannot carry.
+        self.process_isolation = process_isolation
+        self.protocol_factory = protocol_factory
+        self.factory_kwargs = factory_kwargs
+        self.protocol_transform = protocol_transform
+        self.warden_kwargs = warden_kwargs
         self.boundary: Optional[DispatchBoundary] = None
         self.failures: List[EngineFailure] = []
         # Engines are cached per rung so repeated run() calls (e.g. the
@@ -500,8 +592,13 @@ class SearchSupervisor:
         """Run the search to a verdict across the ladder.  ``resume``
         opts in to resuming the FIRST rung from an existing checkpoint;
         failover rungs always resume when a matching dump exists (that
-        is the point of the checkpoint)."""
-        self.boundary = DispatchBoundary(self.policy, self.fault_plan)
+        is the point of the checkpoint).  With ``process_isolation``
+        set, the whole ladder runs warden-supervised child processes
+        instead (identical verdict semantics; see tpu/warden.py)."""
+        if self.process_isolation:
+            return self._run_isolated(resume=resume, initial=initial)
+        self.boundary = DispatchBoundary(self.policy, self.fault_plan,
+                                         observer=self.dispatch_observer)
         self.failures = []
         for i, rung in enumerate(self.ladder):
             search = self._build(rung)
@@ -518,5 +615,42 @@ class SearchSupervisor:
             out.failovers = len(self.failures)
             out.resumed_from_depth = getattr(
                 search, "_resumed_from_depth", 0)
+            out.abandoned_threads = self.boundary.abandoned_alive()
             return out
         raise SupervisorExhausted(self.failures)
+
+    def _run_isolated(self, resume: bool, initial=None):
+        """The process-isolation mode: delegate the ladder to a
+        :class:`~dslabs_tpu.tpu.warden.Warden` (one spawned child per
+        rung, heartbeat-supervised, SIGKILL on wedge, resume from the
+        unified checkpoint).  The warden's failure chain lands on
+        ``self.failures`` so both modes report recovery the same way."""
+        from dslabs_tpu.tpu.warden import Warden
+
+        if initial is not None:
+            raise ValueError(
+                "process_isolation cannot ship an in-memory initial "
+                "state across the process boundary; encode it in the "
+                "protocol_factory instead")
+        if not self.protocol_factory:
+            raise ValueError(
+                "process_isolation=True requires protocol_factory="
+                "'module:callable' (+ factory_kwargs) — a live protocol "
+                "object cannot cross the spawn boundary")
+        warden = Warden(
+            factory=self.protocol_factory,
+            factory_kwargs=self.factory_kwargs,
+            transform=self.protocol_transform,
+            ladder=self.ladder, policy=self.policy,
+            checkpoint_path=self.checkpoint_path,
+            checkpoint_every=self.checkpoint_every,
+            strict=self.strict, max_depth=self.max_depth,
+            max_secs=self.max_secs, chunk=self.chunk,
+            frontier_cap=self.frontier_cap,
+            visited_cap=self.visited_cap, ev_budget=self.ev_budget,
+            aot_warmup=self.aot_warmup,
+            **(self.warden_kwargs or {}))
+        try:
+            return warden.run(resume=resume)
+        finally:
+            self.failures = warden.failures

@@ -1,12 +1,17 @@
 """bench.py contract smoke tests: whatever happens — wedged runtime,
-exhausted deadline, healthy run — the bench must exit 0 with exactly one
-parseable JSON line on stdout (round-4's BENCH_r04.json was rc=124 with
-an empty tail; the round-5 rework makes that shape impossible)."""
+exhausted deadline, external kill, healthy run — the bench must exit 0
+with exactly one parseable JSON line on stdout (round-4's BENCH_r04.json
+was rc=124 with an empty tail; round 5 bounded the phases, and the
+ISSUE-4 warden rework adds the guarantees for the two shapes that still
+escaped: an external SIGTERM kill of the parent, and a preflight that
+hangs SILENTLY and used to eat the CPU fallback's budget)."""
 
 import json
 import os
+import signal
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -38,6 +43,51 @@ def test_bench_exhausted_deadline_still_emits_json():
     out = _run({"DSLABS_BENCH_DEADLINE_SECS": "1"}, timeout=240)
     assert out["value"] == 0.0
     assert "error" in out
+
+
+def test_bench_external_kill_still_emits_json():
+    """ACCEPTANCE (the BENCH_r04 shape): an external ``timeout``-style
+    SIGTERM mid-run must still produce rc=0 and a parsable last-line
+    JSON naming the signal — never an empty tail."""
+    env = dict(os.environ, DSLABS_FORCE_CPU="1",
+               DSLABS_BENCH_DEADLINE_SECS="400")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.Popen(
+        [sys.executable, BENCH], stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, env=env, cwd=ROOT)
+    # Let the run get into its first phase, then kill like a driver
+    # timeout would.
+    t0 = time.time()
+    for line in proc.stderr:
+        if "phase preflight: start" in line or time.time() - t0 > 60:
+            break
+    time.sleep(1.0)
+    proc.send_signal(signal.SIGTERM)
+    out, _ = proc.communicate(timeout=60)
+    assert proc.returncode == 0
+    lines = [ln for ln in out.strip().splitlines() if ln]
+    assert len(lines) == 1, out
+    parsed = json.loads(lines[0])
+    assert "error" in parsed and "SIGTERM" in parsed["error"], parsed
+    assert "total_secs" in parsed
+
+
+def test_bench_wedged_preflight_fast_kill_lands_fallback_value():
+    """ACCEPTANCE (the BENCH_r05 shape): a preflight that hangs
+    SILENTLY (DSLABS_BENCH_FAKE_WEDGE=hang) is SIGKILLed at the
+    heartbeat-silence budget — seconds, not the 300 s that starved
+    BENCH_r05 — and the CPU fallback still lands a REAL tagged
+    states/min value, never 0.0."""
+    out = _run({"DSLABS_BENCH_FAKE_WEDGE": "hang",
+                "DSLABS_BENCH_PREFLIGHT_SILENCE_SECS": "8",
+                "DSLABS_FALLBACK_DEPTH": "6",
+                "DSLABS_BENCH_DEADLINE_SECS": "400"}, timeout=380)
+    assert out["backend"] == "cpu-fallback"
+    assert out["value"] > 0, out
+    assert "error" in out and "wedged" in out["error"]
+    # The kill must be silence-driven (fast), leaving the fallback its
+    # full budget — the whole run fits well under the deadline.
+    assert out["total_secs"] < 350, out
 
 
 @pytest.mark.skipif(not os.environ.get("DSLABS_SLOW_TESTS"),

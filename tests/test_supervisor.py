@@ -299,6 +299,8 @@ def test_supervisor_zero_fault_plan_is_transparent():
     out = _sup(proto, ladder=("device",), chunk=64).run()
     _same_verdict(out, bare)
     assert (out.retries, out.failovers, out.resumed_from_depth) == (0, 0, 0)
+    assert (out.abandoned_threads, out.child_restarts,
+            out.killed_dispatches) == (0, 0, 0)
     assert out.engine == "device"
 
 
@@ -358,6 +360,34 @@ def test_superstep_watchdog_deadline_scales_with_trip_count():
     assert b._deadline_scale("sharded.promote") == 1.0
     bare = DispatchBoundary(RetryPolicy(deadline_secs=2.0))
     assert bare._deadline_scale("sharded.superstep") == 1.0
+
+
+def test_abandoned_thread_accounting_and_warning():
+    """ISSUE 4 satellite: the in-process watchdog can only ABANDON a
+    wedged dispatch, leaking a blocked daemon thread.  The boundary
+    counts the still-blocked threads (surfaced as
+    SearchOutcome.abandoned_threads / bench JSON) and warns past the
+    threshold so in-process-mode degradation is visible."""
+    import time as _time
+
+    from dslabs_tpu.tpu.supervisor import DispatchBoundary
+
+    b = DispatchBoundary(RetryPolicy(max_retries=0, deadline_secs=0.2,
+                                     deadline_first_secs=0.2))
+
+    def _block():
+        # A genuinely blocked call (ignores the fault plan's release
+        # event) — the wedged-XLA shape the watchdog cannot interrupt.
+        _time.sleep(6.0)
+
+    with pytest.raises(EngineFailure):
+        b.dispatch("device.step", _block)
+    assert b.abandoned_alive() == 1
+    assert b.timeouts == 1
+    with pytest.warns(RuntimeWarning, match="abandoned"):
+        with pytest.raises(EngineFailure):
+            b.dispatch("device.step", _block)
+    assert b.abandoned_alive() == 2
 
 
 def test_install_retry_single_engine():
