@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast perf-smoke fault-smoke lab0 lab1 lab2 lab3 lab4 bench dryrun handout clean
+.PHONY: test test-fast perf-smoke fault-smoke swarm-smoke lab0 lab1 lab2 lab3 lab4 bench dryrun handout clean
 
 test:            ## full acceptance + parity suite
 	$(PY) -m pytest tests/ -q
@@ -36,6 +36,16 @@ perf-smoke:      ## fast CPU perf gate vs the BASELINE.json floor
 # run only here.
 fault-smoke:     ## injected-fault recovery suite (retry/failover/resume/watchdog/warden) on CPU
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m fault -p no:cacheprovider
+
+# swarm-smoke = the whole swarm-explorer suite (tests/test_swarm.py)
+# INCLUDING the deep-narrow paxos/lab4 scenarios that tier-1 skips
+# (marked slow+perf): determinism, verdict parity, dedup sharing,
+# frontier-seeding resume parity, dispatch-seam fault injection, loud
+# overflow accounting, and the portfolio acceptance (BFS alone
+# TIME_EXHAUSTED vs portfolio violation with a minimized,
+# replay-verified witness).
+swarm-smoke:     ## swarm explorer suite incl. slow deep-narrow scenarios, on CPU
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_swarm.py -q -p no:cacheprovider
 
 dryrun:          ## multi-chip sharding dry run on a virtual CPU mesh
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

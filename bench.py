@@ -41,7 +41,9 @@ BENCH_r05's preflight hung for 300 s so the CPU fallback never ran):
   semantics: BFS never silently narrows; dropped=0 enforced fatally),
   one attempt, child-side time bound (a slow run returns a partial rate,
   TIME_EXHAUSTED, instead of a parent kill).  Beam runs only with time
-  left and is reported under "beam".
+  left and is reported under "beam"; the **swarm explorer's** deep-probe
+  rates (walkers/sec, unique-states/min, deepest depth — tpu/swarm.py)
+  ride under "swarm" with the same always-reports guarantees.
 
 Budget table (vs the 480 s deadline): docs/resilience.md.
 """
@@ -78,6 +80,7 @@ CALIBRATE_CAP_SECS = 240.0
 FALLBACK_CAP_SECS = 240.0    # wedged-TPU CPU-mesh fallback phase
 STRICT_CAP_SECS = 420.0      # child budget cap; parent adds kill slack
 BEAM_CAP_SECS = 300.0
+SWARM_CAP_SECS = 150.0       # swarm-explorer phase (ISSUE 5)
 # Parent backstop beyond the child's budget.  Generous on purpose: the
 # child's time checks are level-granular (a slow level can overrun
 # max_secs by ~30 s, sharded.py round-3 note), the strict child floors
@@ -405,6 +408,50 @@ def _cpu_fallback(budget_secs: float) -> dict:
     }
 
 
+def _run_swarm(budget_secs: float) -> dict:
+    """Swarm-explorer throughput phase (ISSUE 5, tpu/swarm.py): a
+    diversified random-walk fleet over the full mesh on the bench
+    protocol, reporting walkers/sec, unique-states/min, and the
+    deepest depth reached — the deep-probe half of the portfolio the
+    strict/beam BFS phases cannot measure.  Same always-reports
+    guarantees as every phase: child-side time bound, heartbeats on
+    stderr, one JSON line on stdout."""
+    import jax
+
+    _persistent_cache()
+
+    from dslabs_tpu.tpu.sharded import make_mesh
+    from dslabs_tpu.tpu.swarm import SwarmSearch
+
+    t_phase = time.time()
+    mesh = make_mesh(len(jax.devices()))
+    sw = SwarmSearch(
+        _bench_protocol(), mesh=mesh,
+        walkers_per_device=int(os.environ.get("DSLABS_SWARM_WALKERS",
+                                              "256")),
+        max_steps=int(os.environ.get("DSLABS_SWARM_STEPS", "128")),
+        steps_per_round=64, seed=0, visited_cap=1 << 22)
+    _hb("swarm: fleet built, compiling round program")
+    sw.max_secs = max(20.0, budget_secs - (time.time() - t_phase) - 10)
+    outcome = sw.run()
+    sd = outcome.swarm or {}
+    return {
+        "value": sd.get("unique_per_min", 0.0),
+        "walkers_per_sec": sd.get("walkers_per_sec", 0.0),
+        "unique_per_min": sd.get("unique_per_min", 0.0),
+        "deepest": sd.get("deepest", outcome.depth),
+        "unique": outcome.unique_states,
+        "explored": outcome.states_explored,
+        "end": outcome.end_condition,
+        "rounds": sd.get("rounds", 0),
+        "restarts": outcome.walker_restarts,
+        "overflow_restarts": outcome.swarm_overflow,
+        "vis_over": outcome.visited_overflow,
+        "elapsed": round(outcome.elapsed_secs, 2),
+        "compile_secs": outcome.compile_secs,
+    }
+
+
 # ----------------------------------------------------------------- parent
 
 _CURRENT_CHILD = None     # live phase Popen, killed by the signal handler
@@ -633,6 +680,13 @@ def main() -> None:
             result["beam"] = beam
         else:
             result["error"] = beam_err
+        if _remaining() > 75:
+            swarm, swarm_err = _sub(
+                ["--swarm", str(min(60.0, _remaining() - 15))],
+                min(60.0, _remaining() - 10), "swarm-cpu",
+                silence=PHASE_SILENCE_SECS)
+            if swarm is not None:
+                result["swarm"] = swarm
         _emit(result)
         return
 
@@ -701,6 +755,21 @@ def main() -> None:
         result["error"] = "; ".join(
             str(e) for e in (strict_err, beam_err) if e)
 
+    # ---- phase 4: the swarm explorer's deep-probe rates (walkers/sec,
+    # unique-states/min, deepest depth) — the portfolio's other half.
+    # Never the headline; skipped rather than raced when the deadline
+    # is nearly spent.
+    budget = min(SWARM_CAP_SECS, _remaining() - KILL_SLACK_SECS - 10)
+    if budget > 45:
+        swarm, swarm_err = _sub(["--swarm", str(budget)], budget,
+                                "swarm", silence=PHASE_SILENCE_SECS)
+        if swarm is not None:
+            result["swarm"] = swarm
+        else:
+            result["swarm_error"] = swarm_err
+    else:
+        result["swarm_error"] = "skipped: deadline nearly exhausted"
+
     result["total_secs"] = round(time.time() - _T0, 1)
     _emit(result)
 
@@ -718,6 +787,11 @@ if __name__ == "__main__":
         budget = (float(sys.argv[4]) if len(sys.argv) > 4
                   else STRICT_CAP_SECS)
         print(json.dumps(_run_strict(ev, budget)))
+        sys.exit(0)
+    if len(sys.argv) >= 2 and sys.argv[1] == "--swarm":
+        budget = (float(sys.argv[2]) if len(sys.argv) > 2
+                  else SWARM_CAP_SECS)
+        print(json.dumps(_run_swarm(budget)))
         sys.exit(0)
     if len(sys.argv) >= 2 and sys.argv[1] == "--calibrate":
         print(json.dumps(_calibrate()))

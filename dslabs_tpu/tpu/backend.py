@@ -502,58 +502,102 @@ def _bind_protocol(binding, settings, net_cap, timer_cap,
 
 
 def _rollout_probe(binding, settings, state):
-    """RandomDFS-style deep probe before a dfs-routed BFS: random event
-    walks on the single-device twin reach depth d in O(d) steps, so the
-    deep-narrow violations the object RandomDFS could hit inside a time
-    budget are covered BEFORE the level-by-level search starts (the
-    round-4 advisor's dfs-coverage gap, engine.random_rollouts).
-    Returns (search, outcome, history) on a terminal hit, else None —
-    capacity overflows skip the probe (the BFS ladder handles caps)."""
+    """Swarm deep probe before a dfs-routed BFS: a diversified
+    random-walk fleet (tpu/swarm.py ``SwarmSearch`` — ONE walker
+    implementation; the ad-hoc per-backend rollout loop is retired)
+    reaches depth d in O(d) steps, so the deep-narrow violations the
+    object RandomDFS could hit inside a time budget are covered BEFORE
+    the level-by-level search starts.  This function keeps only the
+    BUDGET ACCOUNTING — walker mechanics, dedup, overflow-restart
+    counting, and the minimize/replay witness pipeline all live in the
+    swarm subsystem.  Returns ((search, outcome, history), probe_secs)
+    on a terminal hit, else (None, probe_secs) — capacity overflows
+    skip the probe (the BFS ladder owns caps)."""
+    import time
+
     import jax
 
-    from dslabs_tpu.tpu.engine import CapacityOverflow, TensorSearch
+    from dslabs_tpu.tpu.engine import CapacityOverflow
+    from dslabs_tpu.tpu.sharded import make_mesh
+    from dslabs_tpu.tpu.swarm import SwarmSearch
     from dslabs_tpu.utils.flags import GlobalSettings
-
-    import time
 
     t_probe = time.time()
     try:
         binding.check_settings(settings)
         net_cap, timer_cap = binding.initial_caps()
-        # Probe at the capacity ladder's TOP rung outright: rollouts
+        # Probe at the capacity ladder's TOP rung outright: walkers
         # hold K rows, not a frontier, so the wide caps cost nothing —
-        # and an overflowed step is a silent walker restart here, which
-        # at base caps would fence every walker below the very depths
-        # the probe exists to reach.
+        # and at base caps every truncated step would restart a walker
+        # below the very depths the probe exists to reach (the
+        # truncation count is loud now: SearchOutcome.swarm_overflow).
         top = len(_LADDER) - 1
         protocol, marr, tarr = _bind_protocol(
             binding, settings, net_cap << top, timer_cap + 2 * top,
             with_goals=False)
-        search = TensorSearch(protocol, chunk=1)
+        rel = (settings.max_depth - state.depth
+               if settings.depth_limited() else 192)
+        if rel <= 0:
+            return None, time.time() - t_probe
+        search = SwarmSearch(protocol, mesh=make_mesh(1),
+                             walkers_per_device=128,
+                             max_steps=min(rel, 192), seed=0)
         from dslabs_tpu.tpu.supervisor import install_retry
 
         install_retry(search)
         search.set_runtime_masks(marr, tarr)
         root, history = binding.derive_root(search, state)
-        rel = (settings.max_depth - state.depth
-               if settings.depth_limited() else 192)
-        if rel <= 0:
-            return None
         budget = 10.0 * GlobalSettings.time_scale
         if settings.max_time_secs is not None:
             budget = min(budget, settings.max_time_secs / 3
                          * GlobalSettings.time_scale)
-        outcome = search.random_rollouts(
-            n_walkers=128, n_steps=min(rel, 192), seed=0,
+        search.max_secs = budget
+        outcome = search.run(
             initial=(jax.tree.map(jax.numpy.asarray, root)
                      if root is not None else None),
-            max_secs=budget)
+            check_initial=False)
     except CapacityOverflow:
         return None, time.time() - t_probe
     if outcome.end_condition in ("INVARIANT_VIOLATED",
                                  "EXCEPTION_THROWN"):
         return (search, outcome, history), time.time() - t_probe
     return None, time.time() - t_probe
+
+
+def _object_minimize_verify(obj, pred, result):
+    """Probe witnesses run the OBJECT pipeline too (ISSUE 5): the
+    replayed object state is minimized with search/minimize.py (the
+    reference TraceMinimizer discipline) and the minimized event
+    history is INDEPENDENTLY replayed with search/replay.py under the
+    violated predicate — a probe verdict ships only after the tensor
+    witness (already minimized/replay-verified in tpu/swarm.py) is
+    confirmed end-to-end on the object twin.  Returns the minimized
+    ``(state, predicate_result)``; any divergence is a loud
+    NoTensorTwin, never a silently-wrong trace."""
+    from dslabs_tpu.search.minimize import minimize_trace
+    from dslabs_tpu.search.replay import replay_trace
+    from dslabs_tpu.search.results import EndCondition
+    from dslabs_tpu.search.settings import SearchSettings
+
+    mini = minimize_trace(obj, result)
+    r2 = pred.check(mini)
+    if r2.value:
+        raise NoTensorTwin(
+            f"object minimization broke the violation of "
+            f"{pred.name!r} (minimizer/predicate divergence)")
+    events = []
+    s = mini
+    while s.previous is not None:
+        events.insert(0, s.previous_event)
+        s = s.previous
+    replayed = replay_trace(s, events,
+                            SearchSettings().add_invariant(pred))
+    if replayed.end_condition is not EndCondition.INVARIANT_VIOLATED:
+        raise NoTensorTwin(
+            f"replaying the minimized witness did not reproduce the "
+            f"violation of {pred.name!r} "
+            f"(got {replayed.end_condition})")
+    return mini, r2
 
 
 def tensor_bfs(initial_state, settings=None, _probe_first=False):
@@ -613,6 +657,13 @@ def tensor_bfs(initial_state, settings=None, _probe_first=False):
                 f"twin/object divergence: tensor invariant violation "
                 f"{outcome.predicate_name!r} holds on the replayed "
                 "object state")
+        if trip is not None:
+            # Probe (swarm) witnesses: object-level minimize + replay
+            # verification on top of the tensor-level pipeline the
+            # swarm already ran (outcome.witness).
+            obj, r = _object_minimize_verify(obj, pred, r)
+            if outcome.witness is not None:
+                outcome.witness.object_verified = True
         results.invariant_violated(obj, r)
         results.end_condition = EndCondition.INVARIANT_VIOLATED
     elif end == "EXCEPTION_THROWN":

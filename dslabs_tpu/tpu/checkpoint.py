@@ -15,6 +15,16 @@ search's SEMANTIC state, not any engine's carry layout:
   depth / explored / elapsed / vis_over / dropped   scalars
   fp_map        [M, 9]     int64   optional trace chain (sharded
                                    record_trace mode)
+  extra__<name> arrays             optional engine-extension arrays
+                                   (``SearchCheckpoint.extra``): state a
+                                   non-BFS driver needs beyond the core
+                                   layout — the swarm explorer
+                                   (tpu/swarm.py) stores walker depths,
+                                   event histories, PRNG keys, and the
+                                   restart seed pool here.  Covered by
+                                   the content checksum like every
+                                   other entry; loaders that do not
+                                   know a key simply ignore it.
 
 Every dump carries a **config fingerprint** of the search it belongs
 to: the protocol's packed-lane shape (protocol name, node/message/timer
@@ -100,6 +110,9 @@ class SearchCheckpoint:
     vis_over: int = 0
     dropped: int = 0
     fp_map: Optional[np.ndarray] = None   # [M, 9] int64 trace chain
+    # Engine-extension arrays (saved as ``extra__<name>`` entries): the
+    # swarm explorer's walker state rides here — see module docstring.
+    extra: Optional[dict] = None
 
 
 def config_fingerprint(protocol, strict: bool,
@@ -146,6 +159,8 @@ def save(path: str, ckpt: SearchCheckpoint) -> None:
     }
     if ckpt.fp_map is not None and len(ckpt.fp_map):
         host["fp_map"] = np.asarray(ckpt.fp_map, np.int64)
+    for name, arr in (ckpt.extra or {}).items():
+        host[f"extra__{name}"] = np.asarray(arr)
     host["checksum"] = _content_checksum(host)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
@@ -268,7 +283,10 @@ def load(path: str, fingerprint: str) -> Optional[SearchCheckpoint]:
             vis_over=int(data["vis_over"]) if "vis_over" in data else 0,
             dropped=int(data["dropped"]) if "dropped" in data else 0,
             fp_map=(np.asarray(data["fp_map"], np.int64)
-                    if "fp_map" in data else None))
+                    if "fp_map" in data else None),
+            extra=({k[len("extra__"):]: np.asarray(v)
+                    for k, v in data.items()
+                    if k.startswith("extra__")} or None))
     if not seen_any:
         return None
     raise CheckpointCorrupt(

@@ -235,6 +235,26 @@ class SearchOutcome:
     # warm persistent compile cache (tpu/compile_cache.py) is visible
     # as this number dropping to near-zero on the second run.
     compile_secs: float = 0.0
+    # Swarm-explorer accounting (tpu/swarm.py, docs/swarm.md).  A
+    # random walker RESTARTS (root/frontier re-seed) on dead ends,
+    # prunes, its depth bound, or — the loud bugfix of the old silent
+    # rollout behaviour — a capacity-truncated step; the truncated-step
+    # count is swarm_overflow (strict swarms raise instead, matching
+    # the visited-overflow contract), and the total restart count is
+    # walker_restarts.  ``swarm`` carries the fleet's throughput stats
+    # (walkers/sec, unique-states/min, deepest depth) for the bench.
+    walker_restarts: int = 0
+    swarm_overflow: int = 0
+    swarm: Optional[dict] = None
+    # The verified counterexample (tpu/swarm.py ``Witness``): minimized
+    # event trace + replay-verification flags.  Populated by swarm /
+    # rollout violations before the verdict is returned — no tensor
+    # verdict ships an unminimized or unreplayed trace.
+    witness: Optional[object] = None
+    # Portfolio-mode cancellation marker (tpu/supervisor.py): this
+    # outcome was cut short because the OTHER portfolio lane already
+    # landed a terminal verdict — never a standalone verdict.
+    cancelled: bool = False
 
 
 # ----------------------------------------------------------------- hashing
@@ -787,6 +807,15 @@ class TensorSearch:
             return fn(*args)
         return hook(tag, fn, *args)
 
+    def _cancelled(self) -> bool:
+        """Portfolio-lane cancellation (tpu/supervisor.py portfolio
+        mode): when the OTHER lane lands a terminal verdict first, the
+        supervisor sets this event and every run loop returns a
+        TIME_EXHAUSTED-shaped outcome (marked ``cancelled``) at its
+        next boundary instead of burning the rest of its budget."""
+        ev = getattr(self, "_cancel_event", None)
+        return ev is not None and ev.is_set()
+
     # -------------------------------------------------------- checkpointing
 
     def _ckpt_fingerprint(self) -> str:
@@ -1320,157 +1349,44 @@ class TensorSearch:
                         n_steps: int = 64, seed: int = 0,
                         initial: Optional[dict] = None,
                         max_secs: Optional[float] = None) -> SearchOutcome:
-        """RandomDFS-style DEEP probes on the tensor engine: ``n_walkers``
-        parallel random walks of up to ``n_steps`` events each, restarting
-        from the root on dead ends / prunes / the depth bound — a walker
-        reaches depth d in O(d) steps where BFS must exhaust every
-        shallower level first (RandomDFS.java via SURVEY §2.4; the
-        round-4 advisor's dfs-coverage gap).
+        """RandomDFS-style DEEP probes: ``n_walkers`` parallel random
+        walks of up to ``n_steps`` events each — a walker reaches depth
+        d in O(d) steps where BFS must exhaust every shallower level
+        first (RandomDFS.java via SURVEY §2.4).
 
-        Each visited state is checked against the protocol's invariants
-        and exception lane; the first hit returns INVARIANT_VIOLATED /
-        EXCEPTION_THROWN with the walker's root-first event trace (the
-        same trace contract as the BFS, so tpu/trace.py replay works
-        unchanged).  No violation -> TIME_EXHAUSTED with the probe
-        statistics.  Coverage is probabilistic by design — the exhaustive
-        verdicts (SPACE/DEPTH_EXHAUSTED) are BFS-only."""
-        import time
+        Since ISSUE 5 this is a thin single-device client of the swarm
+        explorer (tpu/swarm.py ``SwarmSearch``) — ONE walker
+        implementation, so the probe gains the swarm's shared-table
+        dedup, loud overflow-restart accounting (the old loop restarted
+        capacity-truncated walkers silently), and the witness pipeline:
+        a violation's trace is minimized and replay-verified before the
+        verdict returns (``SearchOutcome.witness``).  Verdict
+        vocabulary is unchanged: INVARIANT_VIOLATED / EXCEPTION_THROWN
+        with a root-first event trace (the tpu/trace.py contract), else
+        TIME_EXHAUSTED — exhaustive verdicts stay BFS-only."""
+        from dslabs_tpu.tpu.sharded import make_mesh
+        from dslabs_tpu.tpu.swarm import SwarmSearch
 
-        p = self.p
-        state = (jax.tree.map(jnp.asarray, initial)
-                 if initial is not None else self.initial_state())
-        self._trace_root = jax.tree.map(np.asarray, state)
-        root_row = flatten_state(state)[0]
-        K = n_walkers
-        inv_names = list(p.invariants)
-        masks = getattr(self, "_rt_masks", None)
-
-        def probe_step(rows, depths, hists, key):
-            """One random event per walker: (rows', depths', hists',
-            viol [K, n_inv], exc [K])."""
-            valid_k = jnp.ones((K,), bool)
-            msg_ids, tmr_ids, _ = self._event_tables(rows, valid_k,
-                                                     masks=masks)
-            # Grid ids: message slot i -> i; timer grid j -> net_cap + j.
-            ids = jnp.concatenate(
-                [msg_ids, jnp.where(tmr_ids >= 0, tmr_ids + p.net_cap,
-                                    -1)], axis=1)           # [K, B]
-            ok = ids >= 0
-            logits = jnp.where(ok, 0.0, -jnp.inf)
-            pick = jax.random.categorical(key, logits, axis=-1)  # [K]
-            ev = jnp.take_along_axis(ids, pick[:, None],
-                                     axis=1)[:, 0]
-            any_ok = ok.any(axis=1)
-            ev = jnp.where(any_ok, ev, 0)
-            succ, s_ok, s_over = jax.vmap(self._step_one)(
-                rows, ev)
-            # A capacity-overflowed successor is TRUNCATED — checking
-            # invariants on it would be unsound; treat as a dead end
-            # (the walker restarts; probes are probabilistic anyway).
-            advance = any_ok & s_ok & (s_over == 0)
-            sstate = self.unflatten_rows(succ)
-            exc = advance & (sstate["exc"] != 0)
-            viols = []
-            for name in inv_names:
-                holds = jax.vmap(p.invariants[name])(sstate)
-                viols.append(advance & ~holds)
-            viol = (jnp.stack(viols, axis=1) if viols
-                    else jnp.zeros((K, 0), bool))
-            pruned = jnp.zeros((K,), bool)
-            for fn in p.prunes.values():
-                pruned = pruned | jax.vmap(fn)(sstate)
-            # Record the event BEFORE deciding restarts: a violating
-            # successor's trace must include the step that reached it.
-            hists2 = jnp.where(
-                (jnp.arange(n_steps)[None, :] == depths[:, None])
-                & advance[:, None], ev[:, None], hists)
-            depths2 = depths + advance.astype(jnp.int32)
-            # Restart: dead end, prune, or the step bound (violations
-            # and exceptions are terminal — resolved host-side first).
-            restart = (~advance | pruned | (depths2 >= n_steps))
-            rows2 = jnp.where(restart[:, None], root_row[None, :], succ)
-            depths2 = jnp.where(restart, 0, depths2)
-            hists2 = jnp.where(restart[:, None], -1, hists2)
-            return rows2, depths2, hists2, succ, viol, exc
-
-        jstep = jax.jit(probe_step)
-        rows = jnp.broadcast_to(root_row, (K, root_row.shape[0]))
-        depths = jnp.zeros((K,), jnp.int32)
-        hists = jnp.full((K, n_steps), -1, jnp.int32)
-        key = jax.random.PRNGKey(seed)
-        # Warm-up: compile the probe program OUTSIDE the wall budget
-        # (the reference charges neither JIT nor class loading to
-        # maxTime) — the discarded step runs on throwaway copies.
-        jax.block_until_ready(jstep(rows, depths, hists, key))
-        t0 = time.time()
-        explored = 0
-        deepest = 0
-        for step in range(n_steps):
-            if max_secs is not None and time.time() - t0 > max_secs:
-                break
-            key, sub = jax.random.split(key)
-            # hists BEFORE the step still hold the PARENT path; the
-            # violating walker's full trace = parent path + this event,
-            # which is exactly post-step hists before its restart wipe —
-            # so snapshot the step outputs for host-side resolution.
-            prev_hists, prev_depths = hists, depths
-            rows, depths, hists, succ, viol, exc = jstep(
-                rows, depths, hists, sub)
-            flags = np.asarray(jnp.concatenate(
-                [exc[:, None], viol], axis=1))
-            explored += K
-            deepest = max(deepest, int(np.asarray(prev_depths).max())
-                          + 1)
-            if flags.any():
-                w = int(np.argwhere(flags.any(axis=1))[0, 0])
-                # The violating walker's trace = its pre-step path (the
-                # post-step history may have been wiped by a concurrent
-                # restart decision) + the final edge, re-derived by
-                # replaying the path and matching the successor.
-                d = int(np.asarray(prev_depths)[w])
-                trace = [int(x) for x in np.asarray(prev_hists)[w][:d]]
-                st = jax.tree.map(np.asarray, self.unflatten_rows(
-                    np.asarray(succ)[w][None]))
-                trace.append(self._match_final_event(root_row, trace,
-                                                     st))
-                elapsed = time.time() - t0
-                # unique_states: walkers do not dedup, so the honest
-                # figure is the walked-state count (RandomDFS's
-                # states-handed-to-checkState is also non-deduped).
-                if flags[w, 0]:
-                    return SearchOutcome(
-                        "EXCEPTION_THROWN", explored, explored, d + 1,
-                        elapsed, violating_state=st,
-                        exception_code=int(st["exc"][0]), trace=trace)
-                pname = inv_names[int(np.argwhere(flags[w, 1:])[0, 0])]
-                return SearchOutcome(
-                    "INVARIANT_VIOLATED", explored, explored, d + 1,
-                    elapsed, violating_state=st, predicate_name=pname,
-                    trace=trace)
-        return SearchOutcome("TIME_EXHAUSTED", explored, explored,
-                             deepest, time.time() - t0)
-
-    def _match_final_event(self, root_row, trace, succ_state) -> int:
-        """Find the grid event id whose application to the end of
-        ``trace`` (replayed from ``root_row``) produces ``succ_state`` —
-        the last edge of a rollout violation (host-side, once per found
-        violation)."""
-        row = np.asarray(root_row)
-        step = jax.jit(self._step_one)
-        for ev in trace:
-            row = np.asarray(step(jnp.asarray(row), jnp.asarray(ev))[0])
-        want = np.asarray(flatten_state(jax.tree.map(
-            jnp.asarray, succ_state)))[0]
-        G = self.p.net_cap + self.p.n_nodes * self.p.timer_cap
-        rows = jnp.broadcast_to(jnp.asarray(row), (G, row.shape[0]))
-        succs, oks, _ = jax.vmap(self._step_one)(rows, jnp.arange(G))
-        hits = np.asarray(oks) & (np.asarray(succs)
-                                  == want[None, :]).all(axis=1)
-        if hits.any():
-            return int(np.argwhere(hits)[0, 0])
-        raise RuntimeError(
-            "rollout trace reconstruction failed: no event reproduces "
-            "the violating successor (engine bug)")
+        sw = SwarmSearch(
+            self.p, mesh=make_mesh(1), walkers_per_device=n_walkers,
+            max_steps=n_steps, seed=seed, max_secs=max_secs,
+            visited_cap=min(self.visited_cap, 1 << 18),
+            ev_budget=(self._ev_msg, self._ev_tmr))
+        rt = getattr(self, "_rt_masks", None)
+        if rt is not None:
+            sw.set_runtime_masks(*rt)
+        # The probe inherits this engine's supervision boundary (the
+        # backend installs transient retry on the engine, and the probe
+        # must ride the same seam).
+        hook = getattr(self, "_dispatch_hook", None)
+        if hook is not None:
+            sw._dispatch_hook = hook
+        out = sw.run(initial=initial, check_initial=False)
+        # Expose the walk root for tpu/trace.py replay on THIS engine
+        # too (decode_trace reads search._trace_root off whichever
+        # search object the caller holds).
+        self._trace_root = sw._trace_root
+        return out
 
     def run(self, check_initial: bool = True,
             initial: Optional[dict] = None,
@@ -1557,10 +1473,13 @@ class TensorSearch:
                 return SearchOutcome("DEPTH_EXHAUSTED", explored,
                                      len(visited[0]), depth,
                                      time.time() - t0)
-            if self.max_secs is not None and time.time() - t0 > self.max_secs:
+            if (self.max_secs is not None
+                    and time.time() - t0 > self.max_secs) \
+                    or self._cancelled():
                 return SearchOutcome("TIME_EXHAUSTED", explored,
                                      len(visited[0]), depth,
-                                     time.time() - t0)
+                                     time.time() - t0,
+                                     cancelled=self._cancelled())
             depth += 1
             # Live depth for supervision heartbeats (the dispatch
             # observer reads it — tpu/supervisor.py, tpu/warden.py).
@@ -1956,16 +1875,14 @@ class TensorSearch:
         cur = np.zeros((cap, lanes), np.int32)
         if n:
             cur[:n] = ck.frontier
-        keys = jnp.asarray(ck.visited_keys)
-        table, ins, unres = visited_mod.insert(
-            visited_mod.empty_table(V), keys,
-            jnp.ones((keys.shape[0],), bool))
-        n_unres = int(np.asarray(jnp.sum(unres)))
+        table, n_ins, n_unres = visited_mod.build_table(
+            V, ck.visited_keys)
         if n_unres:
             raise CapacityOverflow(
                 f"{self.p.name}: visited_cap={V} too small to rebuild "
                 f"the checkpoint's visited set ({n_unres} of "
-                f"{keys.shape[0]} keys unresolved); raise visited_cap")
+                f"{len(ck.visited_keys)} keys unresolved); raise "
+                "visited_cap")
         return {
             "cur": jnp.asarray(cur),
             "cur_n": jnp.asarray([n], jnp.int32),
@@ -1974,8 +1891,7 @@ class TensorSearch:
             "nxt": jnp.zeros((cap + 1, lanes), jnp.int32),
             "nxt_n": jnp.zeros((1,), jnp.int32),
             "visited": table,
-            "vis_n": jnp.asarray([int(np.asarray(jnp.sum(ins)))],
-                                 jnp.int32),
+            "vis_n": jnp.asarray([n_ins], jnp.int32),
             "explored": jnp.asarray([ck.explored], jnp.int32),
             "overflow": jnp.zeros((1,), jnp.int32),
             "vis_over": jnp.asarray([ck.vis_over], jnp.int32),
@@ -2040,10 +1956,12 @@ class TensorSearch:
         spec = 0           # chunks of the current wave already dispatched
         while True:
             if (self.max_secs is not None
-                    and time.time() - t0 > self.max_secs):
+                    and time.time() - t0 > self.max_secs) \
+                    or self._cancelled():
                 return SearchOutcome(
                     "TIME_EXHAUSTED", last[0], last[1], depth,
-                    time.time() - t0, visited_overflow=last[2])
+                    time.time() - t0, visited_overflow=last[2],
+                    cancelled=self._cancelled())
             if self.max_depth is not None and depth >= self.max_depth:
                 return SearchOutcome(
                     "DEPTH_EXHAUSTED", last[0], last[1], depth,

@@ -1169,9 +1169,12 @@ class ShardedTensorSearch(TensorSearch):
                     return self._limit_outcome("DEPTH_EXHAUSTED", carry,
                                                depth, t0)
                 if (self.max_secs is not None
-                        and time.time() - t0 > self.max_secs):
-                    return self._limit_outcome("TIME_EXHAUSTED", carry,
-                                               depth, t0)
+                        and time.time() - t0 > self.max_secs) \
+                        or self._cancelled():
+                    out = self._limit_outcome("TIME_EXHAUSTED", carry,
+                                              depth, t0)
+                    out.cancelled = self._cancelled()
+                    return out
                 depth += 1
                 # Live depth for supervision heartbeats (tpu/warden.py).
                 self._current_depth = depth
@@ -1282,10 +1285,12 @@ class ShardedTensorSearch(TensorSearch):
                 return (carry, None, explored, vis_total, drops, nxt_max,
                         chunks)
             if (self.max_secs is not None
-                    and time.time() - t0 > self.max_secs):
-                return (carry,
-                        self._limit_outcome("TIME_EXHAUSTED", carry,
-                                            depth, t0),
+                    and time.time() - t0 > self.max_secs) \
+                    or self._cancelled():
+                out = self._limit_outcome("TIME_EXHAUSTED", carry,
+                                          depth, t0)
+                out.cancelled = self._cancelled()
+                return (carry, out,
                         explored, vis_total, drops, nxt_max, chunks)
 
     def _level_chunks(self, carry, depth, t0, max_n):

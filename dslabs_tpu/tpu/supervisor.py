@@ -46,6 +46,16 @@ production training/inference stack assumes:
   unified checkpoint — nothing leaks, and a hard runtime wedge cannot
   take the supervising process down.
 
+* **Portfolio mode.**  ``SearchSupervisor(portfolio=True)`` runs the
+  device-sharded swarm explorer (tpu/swarm.py) as a CONCURRENT lane
+  beside the BFS ladder — the reference's BFS + RandomDFS portfolio
+  (SURVEY §2.4) on the accelerator.  The first terminal verdict
+  (violation / exception / goal) wins and the losing lane is cancelled
+  at its next loop boundary; exhaustive BFS verdicts stay
+  authoritative.  Swarm witnesses arrive minimized and
+  replay-verified (``SearchOutcome.witness``); swarm rounds
+  checkpoint/resume beside the BFS dump.  See docs/swarm.md.
+
 Every recovery ends in the normal ``SearchOutcome`` end-condition
 vocabulary — never a silent partial verdict — with ``retries``,
 ``failovers``, ``engine``, and ``resumed_from_depth`` reported on the
@@ -500,7 +510,9 @@ class SearchSupervisor:
                  protocol_factory: Optional[str] = None,
                  factory_kwargs: Optional[dict] = None,
                  protocol_transform: Optional[str] = None,
-                 warden_kwargs: Optional[dict] = None):
+                 warden_kwargs: Optional[dict] = None,
+                 portfolio: bool = False,
+                 swarm_kwargs: Optional[dict] = None):
         for rung in ladder:
             if rung not in ("sharded", "device", "host"):
                 raise ValueError(f"unknown ladder rung {rung!r}")
@@ -537,6 +549,20 @@ class SearchSupervisor:
         self.factory_kwargs = factory_kwargs
         self.protocol_transform = protocol_transform
         self.warden_kwargs = warden_kwargs
+        # Portfolio mode (ISSUE 5, docs/swarm.md): run the swarm
+        # explorer (tpu/swarm.py) as a CONCURRENT lane beside the BFS
+        # ladder — BFS proves shallow exhaustiveness while diversified
+        # deep walkers hunt deep-narrow violations; the first TERMINAL
+        # verdict (violation / exception / goal) wins and the losing
+        # lane is cancelled at its next loop boundary.  Exhaust
+        # verdicts stay BFS-authoritative (a swarm TIME_EXHAUSTED never
+        # outranks a BFS SPACE/DEPTH_EXHAUSTED).
+        self.portfolio = portfolio
+        self.swarm_kwargs = swarm_kwargs
+        if portfolio and process_isolation:
+            raise ValueError(
+                "portfolio=True and process_isolation=True are "
+                "mutually exclusive (the swarm lane runs in-process)")
         self.boundary: Optional[DispatchBoundary] = None
         self.failures: List[EngineFailure] = []
         # Engines are cached per rung so repeated run() calls (e.g. the
@@ -597,12 +623,23 @@ class SearchSupervisor:
         instead (identical verdict semantics; see tpu/warden.py)."""
         if self.process_isolation:
             return self._run_isolated(resume=resume, initial=initial)
+        if self.portfolio:
+            return self._run_portfolio(resume, initial, check_initial)
+        return self._run_ladder(resume, initial, check_initial)
+
+    def _run_ladder(self, resume, initial, check_initial, cancel=None):
+        """The in-process failover ladder (the pre-portfolio ``run``
+        body).  ``cancel`` (a threading.Event) is the portfolio lane's
+        first-verdict-wins cut — installed on every rung so a cancelled
+        BFS returns at its next level boundary."""
         self.boundary = DispatchBoundary(self.policy, self.fault_plan,
                                          observer=self.dispatch_observer)
         self.failures = []
         for i, rung in enumerate(self.ladder):
             search = self._build(rung)
             self.boundary.install(search, engine=rung)
+            if cancel is not None:
+                search._cancel_event = cancel
             do_resume = (resume or i > 0) and self._resumable(search)
             try:
                 out = search.run(check_initial=check_initial,
@@ -618,6 +655,94 @@ class SearchSupervisor:
             out.abandoned_threads = self.boundary.abandoned_alive()
             return out
         raise SupervisorExhausted(self.failures)
+
+    # ------------------------------------------------------ portfolio
+
+    def _build_swarm(self):
+        from dslabs_tpu.tpu.swarm import SwarmSearch
+
+        kw = dict(self.swarm_kwargs or {})
+        kw.setdefault("mesh", self.mesh)
+        kw.setdefault("visited_cap", self.visited_cap)
+        kw.setdefault("strict", False)
+        kw.setdefault("max_secs", self.max_secs)
+        kw.setdefault("ev_budget", self.ev_budget)
+        if self.checkpoint_path:
+            # Swarm rounds checkpoint beside the BFS dump (their
+            # fingerprints differ — neither can resume the other's).
+            kw.setdefault("checkpoint_path",
+                          self.checkpoint_path + ".swarm")
+            kw.setdefault("checkpoint_every", self.checkpoint_every)
+        return SwarmSearch(self.protocol, **kw)
+
+    def _run_portfolio(self, resume, initial, check_initial):
+        """BFS ladder + swarm fleet as concurrent lanes; first terminal
+        verdict wins, the loser is cancelled at its next loop boundary.
+        Lane outcomes and errors land on ``self.lanes`` so a portfolio
+        verdict is always attributable."""
+        import threading
+
+        _TERMINAL = ("INVARIANT_VIOLATED", "EXCEPTION_THROWN",
+                     "GOAL_FOUND")
+        cancel = threading.Event()
+        lanes: Dict[str, object] = {}
+        self.lanes = lanes
+
+        def record(name, out):
+            lanes[name] = out
+            if out.end_condition in _TERMINAL:
+                lanes.setdefault("winner", name)
+                cancel.set()
+
+        def bfs_lane():
+            try:
+                out = self._run_ladder(resume, initial, check_initial,
+                                       cancel=cancel)
+                record("bfs", out)
+                # Exhaustive BFS verdicts are authoritative: nothing
+                # the swarm could still find would change them, so
+                # stop the walkers.  (TIME_EXHAUSTED is not — the
+                # swarm keeps its remaining budget.)
+                if out.end_condition in ("SPACE_EXHAUSTED",
+                                         "DEPTH_EXHAUSTED"):
+                    lanes.setdefault("winner", "bfs")
+                    cancel.set()
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                lanes["bfs_err"] = e
+
+        def swarm_lane():
+            try:
+                sw = self._build_swarm()
+                boundary = DispatchBoundary(self.policy,
+                                            self.fault_plan)
+                boundary.install(sw, engine="swarm")
+                sw._cancel_event = cancel
+                out = sw.run(resume=resume, initial=initial,
+                             check_initial=False)
+                out.engine = "swarm"
+                out.retries = boundary.retries
+                record("swarm", out)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                lanes["swarm_err"] = e
+
+        threads = [threading.Thread(target=bfs_lane, daemon=True,
+                                    name="dslabs-portfolio-bfs"),
+                   threading.Thread(target=swarm_lane, daemon=True,
+                                    name="dslabs-portfolio-swarm")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        winner = lanes.get("winner")
+        if winner is not None:
+            return lanes[winner]
+        # No terminal verdict: BFS's exhaust outcome is the richer
+        # report; a crashed BFS lane falls back to the swarm's.
+        if "bfs" in lanes:
+            return lanes["bfs"]
+        if "swarm" in lanes:
+            return lanes["swarm"]
+        raise lanes.get("bfs_err") or lanes.get("swarm_err")
 
     def _run_isolated(self, resume: bool, initial=None):
         """The process-isolation mode: delegate the ladder to a

@@ -1,0 +1,1051 @@
+"""Device-sharded swarm explorer: diversified random-walk fleets.
+
+The checking power of the reference comes from a BFS + RandomDFS
+*portfolio* (SURVEY §2.4): BFS proves shallow exhaustiveness, random
+deep probes hit the deep-narrow violations BFS cannot reach inside a
+budget.  This module is the accelerator-native second half of that
+portfolio, in the spirit of swarm verification (Holzmann & Joshi,
+*Swarm Verification Techniques*): a fleet of DIVERSIFIED random walkers
+runs as ONE ``shard_map`` program across the device mesh, and every
+witness it produces is minimized and independently replay-verified
+before the verdict is returned.
+
+Architecture
+============
+
+* **One fused superstep per round.**  Each device owns a block of
+  ``walkers_per_device`` walkers (state rows + depths + per-walker
+  event histories).  A round is a single dispatched ``shard_map``
+  program whose ``lax.while_loop`` runs up to ``steps_per_round`` walk
+  steps — event-table build, one random event pick per walker, one
+  vmapped transition, invariant/goal/exception flags, visited-table
+  insert, restart resolution — and stops EARLY when any device raises a
+  terminal flag (the first-hit stop is a ``psum``'d flag count in the
+  loop condition, so the whole fleet halts within one step of the first
+  hit).  Host involvement per round is one dispatch + one scalar stats
+  readback, through the same ``_dispatch`` seam as the BFS drivers — so
+  supervisor retry/watchdog/FaultPlan, warden process isolation, and
+  the persistent compile cache all apply unchanged.
+
+* **Diversification axes** (what makes a swarm beat N copies of one
+  walker): every walker gets (1) its own PRNG stream (per-device key,
+  per-walker categorical picks), (2) its own DEPTH BOUND from a
+  schedule spanning ``[min_steps, max_steps]`` — short-leash walkers
+  resample shallow prefixes while long-leash walkers commit deep, and
+  (3) its own event-pick TEMPERATURE and message/timer affinity — cold
+  walkers follow their kind bias almost deterministically, hot walkers
+  pick uniformly, so the fleet covers timer-storm and message-storm
+  schedules that a uniform picker visits exponentially rarely.
+
+* **Shared dedup** through the one open-addressing table implementation
+  (tpu/visited.py): every advanced successor inserts its 128-bit
+  fingerprint into the device's table, so fleets do not re-count each
+  other's states (``unique_states`` is fresh inserts, never the walked
+  count) and BFS coverage can be pre-seeded (below).  An optional
+  ``revisit_patience`` restarts a walker whose last N steps all landed
+  on already-visited states — restart steering away from covered
+  territory.  A full table degrades exactly like the BFS engines
+  (visited.py contract): unresolved keys count as fresh, surfaced on
+  ``SearchOutcome.visited_overflow`` (strict swarms raise).
+
+* **Frontier seeding** (the BFS+swarm hybrid): ``frontier_seed`` names
+  a mid-BFS unified checkpoint (tpu/checkpoint.py); walkers then
+  restart from the dumped FRONTIER rows instead of the root, and the
+  dump's visited keys pre-seed every device's table — the swarm probes
+  strictly PAST the exhaustively-proven region.  Witness traces are
+  recorded relative to the walker's seed state (the staged-search
+  ``initial=`` contract; ``_trace_root`` is set per hit).
+
+* **Witness pipeline.**  A violation's root-first event trace comes
+  straight from the walker's recorded history (no re-derivation), then
+  :func:`minimize_event_trace` shrinks it to a fixpoint (the
+  TraceMinimizer.java:32-109 discipline, executed in tensor space with
+  one fused replay program per candidate) and :func:`replay_events`
+  re-applies the minimized trace from the seed state, asserting every
+  event applies and the predicate result reproduces.  The verdict is
+  returned only with a verified :class:`Witness` attached
+  (``SearchOutcome.witness``) — never an unminimized or unreplayed
+  trace.  The object-level double-check (search/minimize.py +
+  search/replay.py on the replayed object twin) rides in the search
+  backend (tpu/backend.py) where an object root exists.
+
+* **Rounds checkpoint/resume** like BFS levels: the walker rows,
+  depths, histories, PRNG keys, seed pool, and table keys dump into the
+  unified checkpoint format (``SearchCheckpoint.extra``), so a killed
+  swarm resumes mid-flight with an IDENTICAL continuation (the PRNG
+  state is part of the dump) — supervisor failover semantics unchanged.
+
+Env knobs (docs/swarm.md): DSLABS_SWARM_WALKERS, DSLABS_SWARM_STEPS,
+DSLABS_SWARM_ROUND, DSLABS_SWARM_PATIENCE, DSLABS_SWARM_RESTART_WARN,
+DSLABS_SWARM_OVERFLOW_WARN.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+import warnings
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dslabs_tpu.tpu import checkpoint as ckpt_mod
+from dslabs_tpu.tpu import visited as visited_mod
+from dslabs_tpu.tpu.engine import (CapacityOverflow, SearchOutcome,
+                                   TensorProtocol, TensorSearch,
+                                   device_get, flatten_state,
+                                   row_fingerprints)
+
+__all__ = ["SwarmSearch", "Witness", "minimize_event_trace",
+           "replay_events"]
+
+# Warn thresholds for the loud-degradation counters (satellite of
+# ISSUE 5: the old rollout probe restarted capacity-truncated walkers
+# SILENTLY).  Any overflow restart is worth a warning by default;
+# ordinary restarts are the walkers' job, so that bar is high.
+RESTART_WARN = int(os.environ.get("DSLABS_SWARM_RESTART_WARN",
+                                  str(1 << 20)))
+OVERFLOW_WARN = int(os.environ.get("DSLABS_SWARM_OVERFLOW_WARN", "0"))
+
+_TERMINAL = ("INVARIANT_VIOLATED", "EXCEPTION_THROWN", "GOAL_FOUND")
+
+
+# ------------------------------------------------------------- witnesses
+
+@dataclasses.dataclass
+class Witness:
+    """A minimized, replay-verified counterexample (or goal trace).
+
+    ``trace`` is the minimized root-first grid-event-id list (the
+    tpu/trace.py contract, relative to the walk's seed state);
+    ``raw_trace`` is the walker's original history.  ``replay_verified``
+    is True iff re-applying ``trace`` from the seed state applied every
+    event and reproduced the predicate result — swarm verdicts refuse
+    to ship otherwise."""
+
+    end_condition: str
+    predicate_name: Optional[str]
+    exception_code: int
+    raw_trace: List[int]
+    trace: List[int]
+    minimized: bool
+    replay_verified: bool
+    minimize_passes: int = 0
+    # Set by the search backend when the object-level pipeline
+    # (search/minimize.py + search/replay.py) also confirmed the
+    # witness on the replayed object twin.
+    object_verified: Optional[bool] = None
+
+    def __len__(self) -> int:
+        return len(self.trace)
+
+
+def _replay_prog(search: TensorSearch, length: int):
+    """One fused replay program for padded event lists of ``length``:
+    a ``lax.scan`` of ``_step_one`` where ``ev < 0`` rows are inert
+    padding and the first inapplicable/overflowed event FREEZES the
+    state (TraceMinimizer.java:95-108 ``applyEvents`` semantics — later
+    events are not applied).  Returns ``(final_row, applied[L])``.
+    Cached per padded length (lengths are padded to powers of two so
+    the program count stays O(log L))."""
+    cache = getattr(search, "_swarm_replay_progs", None)
+    if cache is None:
+        cache = search._swarm_replay_progs = {}
+    fn = cache.get(length)
+    if fn is not None:
+        return fn
+
+    def prog(row0, evs):
+        def step(carry, ev):
+            row, alive = carry
+            do = alive & (ev >= 0)
+            succ, ok, over = search._step_one(row, jnp.maximum(ev, 0))
+            good = do & ok & (over == 0)
+            row2 = jnp.where(good, succ, row)
+            alive2 = jnp.where(ev >= 0, alive & good, alive)
+            return (row2, alive2), good
+
+        (row, _alive), applied = jax.lax.scan(
+            step, (row0, jnp.bool_(True)), evs)
+        return row, applied
+
+    fn = cache[length] = jax.jit(prog)
+    return fn
+
+
+def _pad_len(n: int) -> int:
+    length = 8
+    while length < n:
+        length <<= 1
+    return length
+
+
+def replay_events(search: TensorSearch, root_row: np.ndarray,
+                  events: List[int]) -> Tuple[np.ndarray, int]:
+    """Replay ``events`` (grid event ids, root-first) from ``root_row``
+    ([lanes] int32).  Returns ``(final_row, n_applied)`` where
+    ``n_applied`` counts the applied prefix — application stops at the
+    first undeliverable/overflowed event, like the reference
+    minimizer's ``applyEvents``.  Replay is UNMASKED by design: the
+    reference minimizer replays under default settings (all delivery
+    permitted, search/minimize.py module docstring), and runtime masks
+    gate validity, never the transition."""
+    L = _pad_len(max(len(events), 1))
+    evs = np.full((L,), -1, np.int32)
+    evs[:len(events)] = np.asarray(events, np.int32)
+    row, applied = _replay_prog(search, L)(
+        jnp.asarray(root_row, jnp.int32), jnp.asarray(evs))
+    applied = np.asarray(applied)[:len(events)]
+    n_applied = int(applied.sum()) if applied.all() else \
+        int(np.argmin(applied))
+    return np.asarray(row), n_applied
+
+
+def _verdict_check(search: TensorSearch, end_condition: str,
+                   predicate_name: Optional[str], exception_code: int):
+    """-> fn(final_row) -> bool: does this state reproduce the verdict
+    (same-truth-value / same-exception-code discipline of
+    search/minimize.py)?"""
+    p = search.p
+
+    def check(row: np.ndarray) -> bool:
+        st = search.unflatten_rows(jnp.asarray(row, jnp.int32)[None])
+        if end_condition == "EXCEPTION_THROWN":
+            return int(np.asarray(st["exc"])[0]) == exception_code
+        preds = (p.invariants if end_condition == "INVARIANT_VIOLATED"
+                 else p.goals)
+        holds = bool(np.asarray(jax.vmap(preds[predicate_name])(st))[0])
+        return (not holds if end_condition == "INVARIANT_VIOLATED"
+                else holds)
+
+    return check
+
+
+def minimize_event_trace(search: TensorSearch, root_row: np.ndarray,
+                         events: List[int], check,
+                         max_passes: int = 6) -> Tuple[List[int], int]:
+    """Shrink an event trace to a (bounded) fixpoint: for each event,
+    try replaying the trace WITHOUT it; keep the deletion when the end
+    state still reproduces the predicate result (``check``) — the
+    TraceMinimizer.java:33-61 loop, executed in tensor space with one
+    fused replay dispatch per candidate.  ``max_passes`` bounds the
+    fixpoint (each pass is O(L) replays); random-walk traces converge
+    in 2-3 passes in practice.  Returns ``(minimized, passes_run)``."""
+    events = list(events)
+    passes = 0
+    changed = True
+    while changed and passes < max_passes:
+        changed = False
+        passes += 1
+        i = 0
+        while i < len(events):
+            cand = events[:i] + events[i + 1:]
+            row, _n = replay_events(search, root_row, cand)
+            if check(row):
+                events = cand
+                changed = True
+            else:
+                i += 1
+    return events, passes
+
+
+def build_witness(search: TensorSearch, root_row: np.ndarray,
+                  raw_trace: List[int], end_condition: str,
+                  predicate_name: Optional[str], exception_code: int,
+                  minimize: bool = True,
+                  verify: bool = True) -> Witness:
+    """The swarm witness pipeline: minimize (optional) then
+    replay-verify.  A failed verification is a LOUD RuntimeError — a
+    swarm verdict never ships a trace that does not independently
+    reproduce its predicate result."""
+    check = _verdict_check(search, end_condition, predicate_name,
+                           exception_code)
+    trace, passes = (minimize_event_trace(search, root_row, raw_trace,
+                                          check)
+                     if minimize else (list(raw_trace), 0))
+    verified = False
+    if verify:
+        row, n_applied = replay_events(search, root_row, trace)
+        if n_applied < len(trace):
+            # check() accepted a prefix mid-minimization; the dangling
+            # suffix is dead weight — trim and re-verify.
+            trace = trace[:n_applied]
+            row, n_applied = replay_events(search, root_row, trace)
+        verified = n_applied == len(trace) and check(row)
+        if not verified:
+            raise RuntimeError(
+                f"swarm witness failed replay verification "
+                f"({end_condition}, predicate={predicate_name!r}, "
+                f"{n_applied}/{len(trace)} events applied) — walker "
+                "history or transition replay is corrupt (engine bug)")
+    return Witness(end_condition=end_condition,
+                   predicate_name=predicate_name,
+                   exception_code=exception_code,
+                   raw_trace=list(raw_trace), trace=trace,
+                   minimized=minimize, replay_verified=verified,
+                   minimize_passes=passes)
+
+
+# ------------------------------------------------------------ the swarm
+
+class SwarmSearch(TensorSearch):
+    """Diversified random-walk fleets over a device mesh (module
+    docstring).  ``run()`` returns the standard :class:`SearchOutcome`:
+    INVARIANT_VIOLATED / EXCEPTION_THROWN / GOAL_FOUND with a verified
+    :class:`Witness`, else TIME_EXHAUSTED with the fleet statistics on
+    ``outcome.swarm`` — exhaustive verdicts remain BFS-only by design.
+    """
+
+    def __init__(self, protocol: TensorProtocol, mesh=None,
+                 walkers_per_device: Optional[int] = None,
+                 max_steps: Optional[int] = None,
+                 min_steps: Optional[int] = None,
+                 steps_per_round: Optional[int] = None,
+                 max_rounds: Optional[int] = None,
+                 max_secs: Optional[float] = None,
+                 seed: int = 0,
+                 temperature: Tuple[float, float] = (0.25, 4.0),
+                 kind_affinity: float = 2.0,
+                 revisit_patience: Optional[int] = None,
+                 visited_cap: int = 1 << 18,
+                 strict: bool = False,
+                 ev_budget=None,
+                 frontier_seed: Optional[str] = None,
+                 checkpoint_path: Optional[str] = None,
+                 checkpoint_every: int = 0,
+                 minimize: bool = True,
+                 replay_verify: bool = True):
+        if mesh is None:
+            from dslabs_tpu.tpu.sharded import make_mesh
+
+            mesh = make_mesh(len(jax.devices()))
+        self.mesh = mesh
+        self.axis = mesh.axis_names[0]
+        self.n_devices = int(mesh.devices.size)
+        self.walkers = int(walkers_per_device
+                           or os.environ.get("DSLABS_SWARM_WALKERS", 128))
+        self.max_steps = int(max_steps
+                             or os.environ.get("DSLABS_SWARM_STEPS", 96))
+        self.min_steps = int(min_steps if min_steps is not None
+                             else max(4, self.max_steps // 4))
+        self.steps_per_round = int(
+            steps_per_round or os.environ.get("DSLABS_SWARM_ROUND", 64))
+        self.max_rounds = max_rounds
+        self.seed = int(seed)
+        self.temperature = (float(temperature[0]), float(temperature[1]))
+        self.kind_affinity = float(kind_affinity)
+        # Restart steering: a walker whose last ``patience`` steps all
+        # landed on already-visited states restarts (it is re-treading
+        # covered territory).  <= 0 disables — the safe default: from a
+        # root INSIDE a large covered region, a small patience would
+        # fence walkers below the fresh frontier.  Enable alongside
+        # frontier seeding, where restarts land PAST the covered region.
+        if revisit_patience is None:
+            revisit_patience = int(os.environ.get(
+                "DSLABS_SWARM_PATIENCE", "0"))
+        self.revisit_patience = int(revisit_patience)
+        self.frontier_seed = frontier_seed
+        self.minimize = minimize
+        self.replay_verify = replay_verify
+        super().__init__(protocol, frontier_cap=max(self.walkers, 2),
+                         chunk=self.walkers, max_secs=max_secs,
+                         ev_budget=ev_budget, visited_cap=visited_cap,
+                         strict=strict,
+                         checkpoint_path=checkpoint_path,
+                         checkpoint_every=checkpoint_every)
+        self._round = jax.jit(self._build_round(), donate_argnums=0)
+        self.compile_secs = 0.0
+        # Watchdog granularity (tpu/supervisor.py): one round dispatch
+        # legitimately runs up to steps_per_round walk steps.
+        self._dispatch_deadline_scales = {
+            "round": float(max(1, self.steps_per_round))}
+
+    # --------------------------------------------------- diversification
+
+    def _schedules(self):
+        """Host-built per-walker diversification arrays over the WHOLE
+        fleet (D * K walkers): depth bounds, temperatures, kind
+        affinities.  Deterministic functions of the config — never
+        checkpointed, always regenerated."""
+        n = self.n_devices * self.walkers
+        bounds = np.linspace(self.min_steps, self.max_steps, n)
+        bounds = np.ceil(bounds).astype(np.int32).clip(1, self.max_steps)
+        t_lo, t_hi = self.temperature
+        temps = np.geomspace(max(t_lo, 1e-3), max(t_hi, 1e-3),
+                             n).astype(np.float32)
+        # Affinity alternates sign across the fleet so half the walkers
+        # chase timer-heavy schedules and half message-heavy ones, at
+        # every temperature rung.
+        affin = np.where(np.arange(n) % 2 == 0, 1.0, -1.0)
+        affin = (affin * self.kind_affinity).astype(np.float32)
+        return bounds, temps, affin
+
+    def _dev_keys(self) -> np.ndarray:
+        """[D, 2] uint32 per-device PRNG keys (fold_in by device)."""
+        base = jax.random.PRNGKey(self.seed)
+        return np.stack([np.asarray(jax.random.fold_in(base, d))
+                         for d in range(self.n_devices)]).astype(
+            np.uint32)
+
+    # -------------------------------------------------------- seed pool
+
+    def _seed_pool(self, state) -> Tuple[np.ndarray, np.ndarray,
+                                         np.ndarray]:
+        """-> (seeds [D, P, lanes], seeds_n [D], preseed_keys [M, 4]).
+
+        Root mode: every device's pool is the one root row, no
+        pre-seeded keys.  Frontier mode (``frontier_seed`` = a BFS
+        checkpoint path): the dumped frontier rows split contiguously
+        across devices (distinct seeds per device = another
+        diversification axis) and the dump's visited keys pre-seed
+        EVERY device's table (tables are device-local; replication
+        maximizes sharing)."""
+        root = np.asarray(flatten_state(state))[0]
+        D = self.n_devices
+        if not self.frontier_seed:
+            seeds = np.broadcast_to(root, (D, 1, self.lanes)).copy()
+            return seeds, np.ones((D,), np.int32), np.zeros((0, 4),
+                                                            np.uint32)
+        ck = self._load_bfs_seed(self.frontier_seed)
+        rows = ck.frontier
+        if not len(rows):
+            rows = root[None]
+        per = max(1, -(-len(rows) // D))
+        seeds = np.zeros((D, per, self.lanes), np.int32)
+        seeds_n = np.zeros((D,), np.int32)
+        for d in range(D):
+            part = rows[d * per:(d + 1) * per]
+            if not len(part):
+                # A device with no frontier share falls back to the
+                # root (never an empty pool).
+                part = root[None]
+            seeds[d, :len(part)] = part
+            seeds_n[d] = len(part)
+        return seeds, seeds_n, np.asarray(ck.visited_keys, np.uint32)
+
+    def _load_bfs_seed(self, path: str):
+        """Load a BFS dump for frontier seeding.  The dump may have
+        been written by a strict or beam, trace-recording or plain
+        search — any fingerprint whose PROTOCOL half matches ours is a
+        sound seed (we only consume frontier rows + visited keys)."""
+        last = None
+        for strict in (True, False):
+            for rt in (False, True):
+                fp = ckpt_mod.config_fingerprint(self.p, strict, rt)
+                try:
+                    ck = ckpt_mod.load(path, fp)
+                except ckpt_mod.CheckpointMismatch as e:
+                    last = e
+                    continue
+                if ck is not None:
+                    return ck
+        if last is not None:
+            raise last
+        raise FileNotFoundError(
+            f"frontier_seed: no BFS checkpoint at {path}")
+
+    # ------------------------------------------------------ the programs
+
+    def _carry_specs(self):
+        ax = self.axis
+        keys = ["rows", "depths", "hists", "streak", "seed_idx",
+                "bounds", "temps", "affin", "key", "seeds", "seeds_n",
+                "visited", "explored", "fresh", "revisit", "restarts",
+                "over", "vis_over", "deepest",
+                "hit_cnt", "hit_rows", "hit_hist", "hit_depth",
+                "hit_seed"]
+        return {k: P(ax) for k in keys}
+
+    def _build_walk_step(self):
+        """One walk step for this device's K walkers (runs INSIDE the
+        round's shard_map/while_loop)."""
+        p = self.p
+        K = self.walkers
+        S = self.max_steps
+        patience = self.revisit_patience
+
+        def walk(c, masks=None):
+            rows, depths, hists = c["rows"], c["depths"], c["hists"]
+            key, sub, sub2 = jax.random.split(c["key"][0], 3)
+            msg_ids, tmr_ids, _rem = self._event_tables(
+                rows, jnp.ones((K,), bool), masks=masks)
+            ids = jnp.concatenate(
+                [msg_ids, jnp.where(tmr_ids >= 0, tmr_ids + p.net_cap,
+                                    -1)], axis=1)            # [K, B]
+            ok = ids >= 0
+            # Diversified pick: kind-affinity bias over valid events,
+            # scaled by each walker's temperature (cold = committed to
+            # its bias, hot = uniform), resolved by one categorical
+            # draw per walker.
+            is_tmr = (jnp.arange(ids.shape[1])
+                      >= self._ev_msg)[None, :]               # [1, B]
+            bias = (c["affin"][:, None]
+                    * jnp.where(is_tmr, 1.0, -1.0)
+                    / c["temps"][:, None])
+            logits = jnp.where(ok, bias, -jnp.inf)
+            pick = jax.random.categorical(sub, logits, axis=-1)  # [K]
+            ev = jnp.take_along_axis(ids, pick[:, None], axis=1)[:, 0]
+            any_ok = ok.any(axis=1)
+            ev = jnp.where(any_ok, ev, 0)
+            succ, s_ok, s_over = jax.vmap(self._step_one)(rows, ev)
+            # A capacity-overflowed successor is TRUNCATED — checking
+            # predicates on it would be unsound.  The walker restarts,
+            # and the truncation is COUNTED (c["over"]) — the old
+            # rollout probe's silent-restart bug, fixed.
+            over = any_ok & s_ok & (s_over != 0)
+            advance = any_ok & s_ok & ~over
+            sstate = self.unflatten_rows(succ)
+
+            # Terminal flags, checkState order (exception -> invariant
+            # -> goal; shared _flag_names layout with the BFS drivers).
+            hit_list = [advance & (sstate["exc"] != 0)]
+            for n in p.invariants:
+                hit_list.append(advance
+                                & ~jax.vmap(p.invariants[n])(sstate))
+            for n in p.goals:
+                hit_list.append(advance & jax.vmap(p.goals[n])(sstate))
+            hits = jnp.stack(hit_list)                        # [nf, K]
+            pruned = jnp.zeros((K,), bool)
+            for fn in p.prunes.values():
+                pruned = pruned | jax.vmap(fn)(sstate)
+
+            # History records the event BEFORE restart resolution: a
+            # violating successor's trace must include its final edge.
+            hists2 = jnp.where(
+                (jnp.arange(S)[None, :] == depths[:, None])
+                & advance[:, None], ev[:, None], hists)
+            depths2 = depths + advance.astype(jnp.int32)
+
+            # Shared dedup: fingerprints of advanced successors insert
+            # into this device's table (visited.py contract: unresolved
+            # = table full = treated as fresh, counted).
+            fp = row_fingerprints(succ)
+            table, ins, unres = visited_mod.insert(
+                c["visited"], fp, advance)
+            revisit = advance & ~ins & ~unres
+            streak2 = jnp.where(revisit, c["streak"] + 1,
+                                jnp.zeros_like(c["streak"]))
+            if patience > 0:
+                rv_restart = streak2 >= patience
+            else:
+                rv_restart = jnp.zeros((K,), bool)
+
+            # First-hit capture per flag (one walker's full history),
+            # taken from the PRE-restart arrays.
+            cnts = jnp.sum(hits, axis=1).astype(jnp.int32)
+            idxs = jnp.argmax(hits, axis=1)
+            freshf = (c["hit_cnt"] == 0) & (cnts > 0)
+            hit_rows = jnp.where(freshf[:, None], succ[idxs],
+                                 c["hit_rows"])
+            hit_hist = jnp.where(freshf[:, None], hists2[idxs],
+                                 c["hit_hist"])
+            hit_depth = jnp.where(freshf, depths2[idxs], c["hit_depth"])
+            hit_seed = jnp.where(freshf, c["seed_idx"][idxs],
+                                 c["hit_seed"])
+
+            # Restarts: dead end / truncated step / prune / depth bound
+            # / revisit patience -> re-seed from the pool.
+            restart = (~advance | pruned | (depths2 >= c["bounds"])
+                       | rv_restart)
+            nsd = jnp.maximum(c["seeds_n"][0], 1)
+            ridx = jax.random.randint(sub2, (K,), 0, nsd)
+            new_rows = c["seeds"][ridx]
+            rows2 = jnp.where(restart[:, None], new_rows, succ)
+            depths3 = jnp.where(restart, 0, depths2)
+            hists3 = jnp.where(restart[:, None], -1, hists2)
+            streak3 = jnp.where(restart, 0, streak2)
+            seed_idx2 = jnp.where(restart, ridx, c["seed_idx"])
+
+            def bump(name, val):
+                return c[name].at[0].add(val.astype(jnp.int32))
+
+            return {
+                "rows": rows2, "depths": depths3, "hists": hists3,
+                "streak": streak3, "seed_idx": seed_idx2,
+                "bounds": c["bounds"], "temps": c["temps"],
+                "affin": c["affin"], "key": key[None],
+                "seeds": c["seeds"], "seeds_n": c["seeds_n"],
+                "visited": table,
+                "explored": bump("explored", jnp.sum(advance)),
+                "fresh": bump("fresh", jnp.sum(ins)),
+                "revisit": bump("revisit", jnp.sum(revisit)),
+                "restarts": bump("restarts", jnp.sum(restart)),
+                "over": bump("over", jnp.sum(over)),
+                "vis_over": bump("vis_over", jnp.sum(unres)),
+                "deepest": c["deepest"].at[0].max(
+                    jnp.max(depths2).astype(jnp.int32)),
+                "hit_cnt": c["hit_cnt"] + cnts,
+                "hit_rows": hit_rows, "hit_hist": hit_hist,
+                "hit_depth": hit_depth, "hit_seed": hit_seed,
+            }
+
+        return walk
+
+    def _build_round(self):
+        """The fused ROUND superstep: up to ``budget`` walk steps in one
+        ``lax.while_loop``, stopping early when ANY device's flag count
+        goes nonzero (psum'd first-hit stop).  Returns (carry', stats)
+        with the psum'd scalar stats in-program, so host involvement
+        per round is one dispatch."""
+        walk = self._build_walk_step()
+        ax = self.axis
+
+        def stats_local(c, k):
+            def ps(x):
+                return jax.lax.psum(x, ax)
+
+            core = jnp.stack([
+                ps(c["explored"][0]), ps(c["fresh"][0]),
+                ps(c["revisit"][0]), ps(c["restarts"][0]),
+                ps(c["over"][0]), ps(c["vis_over"][0]),
+                jax.lax.pmax(c["deepest"][0], ax), k,
+            ]).astype(jnp.int32)
+            return jnp.concatenate([core,
+                                    ps(c["hit_cnt"]).astype(jnp.int32)])
+
+        def round_local(carry, budget, masks=None):
+            def cond(st):
+                c, k = st
+                hit = jnp.sum(c["hit_cnt"])
+                return (k < budget) & (jax.lax.psum(hit, ax) == 0)
+
+            def body(st):
+                c, k = st
+                return walk(c, masks), k + 1
+
+            carry, k = jax.lax.while_loop(cond, body,
+                                          (carry, jnp.int32(0)))
+            return carry, stats_local(carry, k)
+
+        spec = self._carry_specs()
+        if (self.p.deliver_message_rt is not None
+                or self.p.deliver_timer_rt is not None):
+            return shard_map(
+                lambda c, b, m: round_local(c, b, m), mesh=self.mesh,
+                in_specs=(spec, P(), (P(), P())),
+                out_specs=(spec, P()), check_rep=False)
+        return shard_map(
+            lambda c, b: round_local(c, b), mesh=self.mesh,
+            in_specs=(spec, P()), out_specs=(spec, P()),
+            check_rep=False)
+
+    def _round_call(self, carry, budget: int):
+        """Dispatch one round through the supervisor seam; the
+        dispatched callable blocks on the scalar stats readback so the
+        watchdog bounds the fused round."""
+        b = jnp.asarray(budget, jnp.int32)
+        rt = getattr(self, "_rt_masks", None)
+
+        def run(c, bb, *masks):
+            c2, stats = (self._round(c, bb, masks[0]) if masks
+                         else self._round(c, bb))
+            return c2, device_get(stats)
+
+        if rt is not None:
+            return self._dispatch("swarm.round", run, carry, b, rt)
+        return self._dispatch("swarm.round", run, carry, b)
+
+    # ------------------------------------------------------------- carry
+
+    def _init_carry(self, state):
+        """Build the fleet carry: host-side small arrays + one jitted
+        shard_map finisher that builds each device's table (pre-seeded
+        when frontier seeding is on) and places walkers round-robin
+        over the seed pool."""
+        D, K, S, V = (self.n_devices, self.walkers, self.max_steps,
+                      self.visited_cap)
+        lanes = self.lanes
+        nf = len(self._flag_names)
+        seeds, seeds_n, pre_keys = self._seed_pool(state)
+        pool = seeds.shape[1]
+        bounds, temps, affin = self._schedules()
+        m = len(pre_keys)
+        # Pre-seed keys replicate to every device's table.
+        pk = np.zeros((D, max(m, 1), 4), np.uint32)
+        pv = np.zeros((D, max(m, 1)), bool)
+        if m:
+            pk[:] = pre_keys[None]
+            pv[:] = True
+        shard = NamedSharding(self.mesh, P(self.axis))
+        dev_in = {k: jax.device_put(v, shard) for k, v in {
+            "seeds": seeds.reshape(D * pool, lanes),
+            "seeds_n": seeds_n,
+            "bounds": bounds, "temps": temps, "affin": affin,
+            "key": self._dev_keys(),
+            "pkeys": pk.reshape(-1, 4), "pval": pv.reshape(-1),
+        }.items()}
+
+        def local(s):
+            table, ins, unres = visited_mod.insert(
+                visited_mod.empty_table(V), s["pkeys"], s["pval"])
+            nsd = jnp.maximum(s["seeds_n"][0], 1)
+            idx0 = (jnp.arange(K, dtype=jnp.int32) % nsd)
+            out = {
+                "rows": s["seeds"][idx0],
+                "depths": jnp.zeros((K,), jnp.int32),
+                "hists": jnp.full((K, S), -1, jnp.int32),
+                "streak": jnp.zeros((K,), jnp.int32),
+                "seed_idx": idx0,
+                "bounds": s["bounds"], "temps": s["temps"],
+                "affin": s["affin"], "key": s["key"],
+                "seeds": s["seeds"], "seeds_n": s["seeds_n"],
+                "visited": table,
+                "explored": jnp.zeros((1,), jnp.int32),
+                "fresh": jnp.zeros((1,), jnp.int32),
+                "revisit": jnp.zeros((1,), jnp.int32),
+                "restarts": jnp.zeros((1,), jnp.int32),
+                "over": jnp.zeros((1,), jnp.int32),
+                "vis_over": jnp.zeros((1,), jnp.int32),
+                "deepest": jnp.zeros((1,), jnp.int32),
+                "hit_cnt": jnp.zeros((nf,), jnp.int32),
+                "hit_rows": jnp.zeros((nf, lanes), jnp.int32),
+                "hit_hist": jnp.full((nf, S), -1, jnp.int32),
+                "hit_depth": jnp.zeros((nf,), jnp.int32),
+                "hit_seed": jnp.zeros((nf,), jnp.int32),
+            }
+            return out, jnp.sum(unres).astype(jnp.int32)[None]
+
+        ax = self.axis
+        in_spec = {k: P(ax) for k in dev_in}
+        fn = jax.jit(shard_map(local, mesh=self.mesh,
+                               in_specs=(in_spec,),
+                               out_specs=(self._carry_specs(), P(ax)),
+                               check_rep=False))
+
+        def build(inputs):
+            carry, unres = fn(inputs)
+            return carry, device_get(unres)
+
+        carry, unres = self._dispatch("swarm.init", build, dev_in)
+        n_unres = int(np.asarray(unres).sum())
+        if n_unres:
+            raise CapacityOverflow(
+                f"{self.p.name}: visited_cap={V}/device too small to "
+                f"pre-seed {m} BFS keys ({n_unres} unresolved); raise "
+                "visited_cap")
+        return carry
+
+    # ------------------------------------------------------- checkpoints
+
+    def _ckpt_fingerprint(self) -> str:
+        """Swarm dumps are their own config family: a BFS engine must
+        never resume one (and vice versa), and the walker-array shapes
+        (D, K, S) plus the PRNG seed are part of the identity — resume
+        is a bit-exact continuation."""
+        base = ckpt_mod.config_fingerprint(self.p, self.strict, False)
+        return (f"swarm:{base}:D{self.n_devices}:K{self.walkers}"
+                f":S{self.max_steps}:seed{self.seed}")
+
+    def _save_swarm_ckpt(self, carry, rounds: int, elapsed: float
+                         ) -> None:
+        """Host copies at the round boundary (before the next round's
+        dispatch donates the buffers), file write drained async — the
+        engine checkpoint discipline."""
+        D, K, S, V = (self.n_devices, self.walkers, self.max_steps,
+                      self.visited_cap)
+        vis = np.asarray(carry["visited"]).reshape(D, V + 1, 4)[:, :-1]
+        occ = ~(vis == visited_mod.MAXU32).all(axis=2)
+        vdev = occ.sum(axis=1).astype(np.int64)
+        keys = vis[occ]
+        extra = {
+            "depths": np.asarray(carry["depths"]),
+            "hists": np.asarray(carry["hists"]),
+            "streak": np.asarray(carry["streak"]),
+            "seed_idx": np.asarray(carry["seed_idx"]),
+            "key": np.asarray(carry["key"]),
+            "seeds": np.asarray(carry["seeds"]),
+            "seeds_n": np.asarray(carry["seeds_n"]),
+            "vdev": vdev,
+            "counters": np.stack([
+                np.asarray(carry[k]).reshape(-1)
+                for k in ("explored", "fresh", "revisit", "restarts",
+                          "over", "vis_over", "deepest")]),
+        }
+        ck = ckpt_mod.SearchCheckpoint(
+            fingerprint=self._ckpt_fingerprint(), depth=rounds,
+            explored=int(np.asarray(carry["explored"]).sum()),
+            elapsed=elapsed,
+            frontier=np.asarray(carry["rows"]),
+            visited_keys=keys,
+            vis_over=int(np.asarray(carry["vis_over"]).sum()),
+            extra=extra)
+        self._ckpt_writer.kick(
+            lambda: ckpt_mod.save(self.checkpoint_path, ck))
+
+    def _load_swarm_ckpt(self):
+        """-> (carry, rounds, elapsed) or None.  Rebuilds the full
+        fleet carry — walker rows/depths/histories, PRNG keys, seed
+        pool, per-device tables re-inserted from the dumped key groups
+        — so the continuation is bit-exact (the resume-parity test)."""
+        ck = self._load_ckpt()
+        if ck is None:
+            return None
+        if ck.extra is None:
+            raise ckpt_mod.CheckpointCorrupt(
+                f"{self.checkpoint_path}: swarm checkpoint has no "
+                "extra__ walker arrays")
+        D, K, S, V = (self.n_devices, self.walkers, self.max_steps,
+                      self.visited_cap)
+        lanes = self.lanes
+        nf = len(self._flag_names)
+        x = ck.extra
+        vdev = np.asarray(x["vdev"], np.int64)
+        kmax = int(max(vdev.max(initial=0), 1))
+        kbuf = np.zeros((D, kmax, 4), np.uint32)
+        kval = np.zeros((D, kmax), bool)
+        off = 0
+        for d in range(D):
+            n = int(vdev[d])
+            kbuf[d, :n] = ck.visited_keys[off:off + n]
+            kval[d, :n] = True
+            off += n
+        counters = np.asarray(x["counters"], np.int32)
+        shard = NamedSharding(self.mesh, P(self.axis))
+        bounds, temps, affin = self._schedules()
+        dev_in = {k: jax.device_put(v, shard) for k, v in {
+            "rows": np.asarray(ck.frontier, np.int32),
+            "depths": np.asarray(x["depths"], np.int32),
+            "hists": np.asarray(x["hists"], np.int32),
+            "streak": np.asarray(x["streak"], np.int32),
+            "seed_idx": np.asarray(x["seed_idx"], np.int32),
+            "key": np.asarray(x["key"], np.uint32),
+            "seeds": np.asarray(x["seeds"], np.int32),
+            "seeds_n": np.asarray(x["seeds_n"], np.int32),
+            "bounds": bounds, "temps": temps, "affin": affin,
+            "pkeys": kbuf.reshape(-1, 4), "pval": kval.reshape(-1),
+            "counters": counters.T.copy(),          # [D, 7]
+        }.items()}
+
+        def local(s):
+            table, ins, unres = visited_mod.insert(
+                visited_mod.empty_table(V), s["pkeys"], s["pval"])
+            cnt = s["counters"][0]
+            out = {
+                "rows": s["rows"], "depths": s["depths"],
+                "hists": s["hists"], "streak": s["streak"],
+                "seed_idx": s["seed_idx"],
+                "bounds": s["bounds"], "temps": s["temps"],
+                "affin": s["affin"], "key": s["key"],
+                "seeds": s["seeds"], "seeds_n": s["seeds_n"],
+                "visited": table,
+                "explored": cnt[0][None], "fresh": cnt[1][None],
+                "revisit": cnt[2][None], "restarts": cnt[3][None],
+                "over": cnt[4][None], "vis_over": cnt[5][None],
+                "deepest": cnt[6][None],
+                "hit_cnt": jnp.zeros((nf,), jnp.int32),
+                "hit_rows": jnp.zeros((nf, lanes), jnp.int32),
+                "hit_hist": jnp.full((nf, S), -1, jnp.int32),
+                "hit_depth": jnp.zeros((nf,), jnp.int32),
+                "hit_seed": jnp.zeros((nf,), jnp.int32),
+            }
+            return out, jnp.sum(unres).astype(jnp.int32)[None]
+
+        ax = self.axis
+        in_spec = {k: P(ax) for k in dev_in}
+        fn = jax.jit(shard_map(local, mesh=self.mesh,
+                               in_specs=(in_spec,),
+                               out_specs=(self._carry_specs(), P(ax)),
+                               check_rep=False))
+        with self.mesh:
+            carry, unres = fn(dev_in)
+        if int(np.asarray(unres).sum()):
+            raise CapacityOverflow(
+                f"{self.p.name}: visited_cap={V}/device too small to "
+                "rebuild the swarm checkpoint's table; raise "
+                "visited_cap")
+        return carry, ck.depth, ck.elapsed
+
+    # --------------------------------------------------------------- run
+
+    def run(self, check_initial: bool = True,
+            initial: Optional[dict] = None,
+            resume: bool = False) -> SearchOutcome:
+        """Run the swarm to a verdict.  ``initial`` (a batch-1 state
+        pytree) roots the walk at an arbitrary state (the staged-search
+        contract); ``resume=True`` continues from ``checkpoint_path``
+        bit-exactly.  Compile time is excluded from the wall budget
+        (the reference charges neither JIT nor class loading to
+        maxTime) and reported on ``outcome.compile_secs``."""
+        state = (jax.tree.map(jnp.asarray, initial)
+                 if initial is not None else self.initial_state())
+        self._trace_root = jax.tree.map(np.asarray, state)
+        t0 = time.time()
+        if check_initial:
+            out = self._check_initial(state, t0)
+            if out is not None:
+                return out
+        try:
+            with self.mesh:
+                return self._run_rounds(state, resume)
+        finally:
+            w = getattr(self, "_ckpt_writer_obj", None)
+            if w is not None:
+                w.join()
+
+    def _run_rounds(self, state, resume: bool) -> SearchOutcome:
+        resumed = (self._load_swarm_ckpt()
+                   if resume and self.checkpoint_path else None)
+        if resumed is not None:
+            carry, rounds, prev_elapsed = resumed
+            self._resumed_from_depth = rounds
+        else:
+            carry = self._init_carry(state)
+            rounds, prev_elapsed = 0, 0.0
+        # Warm-up: a zero-step round compiles the fused program OUTSIDE
+        # the wall budget; the persistent compile cache makes the
+        # second construction near-free.
+        t_c = time.time()
+        carry, _ = self._round_call(carry, 0)
+        self.compile_secs += time.time() - t_c
+        t0 = time.time() - prev_elapsed
+        stats = None
+        while True:
+            cancelled = self._cancelled()
+            timed_out = (self.max_secs is not None
+                         and time.time() - t0 > self.max_secs)
+            round_cap = (self.max_rounds is not None
+                         and rounds >= self.max_rounds)
+            if cancelled or timed_out or round_cap:
+                return self._exhaust_outcome(stats, rounds, t0,
+                                             cancelled)
+            rounds += 1
+            # Live "depth" for supervision heartbeats = round count.
+            self._current_depth = rounds
+            carry, stats = self._round_call(carry,
+                                            self.steps_per_round)
+            stats = np.asarray(stats)
+            vis_over = int(stats[5])
+            over = int(stats[4])
+            # Terminal flags BEFORE the strict capacity guards: a
+            # violation found this round is a valid verdict even if
+            # the table filled alongside it (the _sync_checks order).
+            nf = len(self._flag_names)
+            if stats[8:8 + nf].any():
+                return self._resolve_hit(carry, stats, rounds, t0)
+            if self.strict and vis_over:
+                raise CapacityOverflow(
+                    f"{self.p.name}: swarm visited table full "
+                    f"({vis_over} unresolved keys, cap "
+                    f"{self.visited_cap}/device); raise visited_cap "
+                    "or run strict=False")
+            if self.strict and over:
+                raise CapacityOverflow(
+                    f"{self.p.name}: {over} walker steps truncated by "
+                    "net/timer caps (strict swarm); raise the caps")
+            if (self.checkpoint_path and self.checkpoint_every
+                    and rounds % self.checkpoint_every == 0):
+                self._save_swarm_ckpt(carry, rounds, time.time() - t0)
+
+    def _stats_dict(self, stats, rounds: int, elapsed: float) -> dict:
+        (explored, fresh, revisit, restarts, over, vis_over,
+         deepest, _steps) = (int(x) for x in stats[:8])
+        el = max(elapsed, 1e-9)
+        return {
+            "walkers": self.n_devices * self.walkers,
+            "rounds": rounds, "explored": explored, "unique": fresh,
+            "revisits": revisit, "restarts": restarts,
+            "overflow_restarts": over, "vis_over": vis_over,
+            "deepest": deepest,
+            "walkers_per_sec": round(explored / el, 1),
+            "unique_per_min": round(fresh / el * 60.0, 1),
+        }
+
+    def _finish_outcome(self, out: SearchOutcome,
+                        sd: dict) -> SearchOutcome:
+        out.swarm = sd
+        out.walker_restarts = sd["restarts"]
+        out.swarm_overflow = sd["overflow_restarts"]
+        out.visited_overflow = sd["vis_over"]
+        out.compile_secs = round(self.compile_secs, 3)
+        out.resumed_from_depth = getattr(self, "_resumed_from_depth", 0)
+        if out.swarm_overflow > OVERFLOW_WARN:
+            warnings.warn(
+                f"{self.p.name}: {out.swarm_overflow} walker steps "
+                "were capacity-truncated and restarted (net/timer caps "
+                "too small for the walked region) — deep coverage is "
+                "degraded; raise the caps or run a strict swarm",
+                RuntimeWarning, stacklevel=3)
+        if out.walker_restarts > RESTART_WARN:
+            warnings.warn(
+                f"{self.p.name}: {out.walker_restarts} walker restarts "
+                "(> DSLABS_SWARM_RESTART_WARN) — walkers are churning; "
+                "raise max_steps or seed from a deeper frontier",
+                RuntimeWarning, stacklevel=3)
+        return out
+
+    def _exhaust_outcome(self, stats, rounds: int, t0,
+                         cancelled: bool) -> SearchOutcome:
+        elapsed = time.time() - t0
+        if stats is None:
+            stats = np.zeros((8 + len(self._flag_names),), np.int64)
+        sd = self._stats_dict(stats, rounds, elapsed)
+        out = SearchOutcome(
+            "TIME_EXHAUSTED", sd["explored"], sd["unique"],
+            sd["deepest"], elapsed, cancelled=cancelled)
+        return self._finish_outcome(out, sd)
+
+    def _resolve_hit(self, carry, stats, rounds: int,
+                     t0) -> SearchOutcome:
+        """First-hit resolution: ONE readback of the capture arrays,
+        checkState flag order, then the witness pipeline (minimize +
+        replay-verify) before the verdict is returned."""
+        D, K, S = self.n_devices, self.walkers, self.max_steps
+        nf = len(self._flag_names)
+        data = self._dispatch(
+            "swarm.flags", device_get_tree,
+            {k: carry[k] for k in ("hit_cnt", "hit_rows", "hit_hist",
+                                   "hit_depth", "hit_seed", "seeds",
+                                   "seeds_n")})
+        cnts = data["hit_cnt"].reshape(D, nf)
+        rows = data["hit_rows"].reshape(D, nf, self.lanes)
+        hist = data["hit_hist"].reshape(D, nf, S)
+        depth = data["hit_depth"].reshape(D, nf)
+        seed_i = data["hit_seed"].reshape(D, nf)
+        pool = data["seeds"].reshape(D, -1, self.lanes)
+        elapsed = time.time() - t0
+        sd = self._stats_dict(stats, rounds, elapsed)
+        for fi, fname in enumerate(self._flag_names):
+            devs = np.nonzero(cnts[:, fi])[0]
+            if not len(devs):
+                continue
+            d = int(devs[0])
+            raw = [int(e) for e in hist[d, fi][:int(depth[d, fi])]]
+            seed_row = pool[d, int(seed_i[d, fi])]
+            # The walk root this witness replays from (tpu/trace.py
+            # contract): the walker's seed state — the run root for
+            # root-started fleets, a frontier row under seeding.
+            self._trace_root = jax.tree.map(
+                np.asarray, self.unflatten_rows(seed_row[None]))
+            st = jax.tree.map(np.asarray,
+                              self.unflatten_rows(rows[d, fi][None]))
+            if fname == "exc":
+                end, pname = "EXCEPTION_THROWN", None
+                code = int(st["exc"][0])
+            else:
+                kind, pname = fname.split(":", 1)
+                end = ("INVARIANT_VIOLATED" if kind == "inv"
+                       else "GOAL_FOUND")
+                code = 0
+            wit = build_witness(self, seed_row, raw, end, pname, code,
+                                minimize=self.minimize,
+                                verify=self.replay_verify)
+            out = SearchOutcome(
+                end, sd["explored"], sd["unique"],
+                int(depth[d, fi]), elapsed,
+                violating_state=(st if end != "GOAL_FOUND" else None),
+                goal_state=(st if end == "GOAL_FOUND" else None),
+                predicate_name=pname, exception_code=code,
+                trace=wit.trace, witness=wit)
+            return self._finish_outcome(out, sd)
+        raise AssertionError("swarm hit counts fired without a flag")
+
+
+def device_get_tree(tree):
+    """Readback funnel for pytrees (mirrors engine.device_get, which
+    tests monkeypatch to audit transfer sizes)."""
+    return jax.tree.map(device_get, tree)

@@ -37,7 +37,8 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["BKT", "MAXU32", "empty_table", "sanitize_keys",
-           "host_sanitize_key", "host_home_slot", "insert"]
+           "host_sanitize_key", "host_home_slot", "insert",
+           "build_table"]
 
 # Slots per bucket: the probe loop reads whole buckets (one aligned
 # 128-byte line of 8 x 16-byte keys).
@@ -81,6 +82,21 @@ def host_home_slot(key: np.ndarray, cap: int) -> int:
     owner-routing-biased in the sharded engine, see sharded.py)."""
     check_cap(cap)
     return (int(key[2]) & (cap // BKT - 1)) * BKT
+
+
+def build_table(cap: int, keys) -> Tuple[jnp.ndarray, int, int]:
+    """A fresh table with ``keys`` ([K, 4] uint32) pre-inserted — the
+    HOST-SIDE rebuild/pre-seed entry point (engine.py
+    ``_carry_from_ckpt``; the sharded and swarm drivers re-insert
+    inside their shard_map initialisers instead, where the table must
+    be built per device).  Returns ``(table, n_inserted,
+    n_unresolved)``; callers treat a nonzero unresolved count as
+    CapacityOverflow (the table cannot hold the key set)."""
+    keys = jnp.asarray(keys, jnp.uint32).reshape(-1, 4)
+    table, ins, unres = insert(empty_table(cap), keys,
+                               jnp.ones((keys.shape[0],), bool))
+    return (table, int(np.asarray(jnp.sum(ins))),
+            int(np.asarray(jnp.sum(unres))))
 
 
 def _probe_iter(table, keys, bkt_i, ps, unres, idx, V, RT, batch_n):
