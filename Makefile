@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast perf-smoke fault-smoke swarm-smoke lab0 lab1 lab2 lab3 lab4 bench dryrun handout clean
+.PHONY: test test-fast perf-smoke fault-smoke swarm-smoke capacity-smoke lab0 lab1 lab2 lab3 lab4 bench dryrun handout clean
 
 test:            ## full acceptance + parity suite
 	$(PY) -m pytest tests/ -q
@@ -46,6 +46,17 @@ fault-smoke:     ## injected-fault recovery suite (retry/failover/resume/watchdo
 # replay-verified witness).
 swarm-smoke:     ## swarm explorer suite incl. slow deep-narrow scenarios, on CPU
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_swarm.py -q -p no:cacheprovider
+
+# capacity-smoke = the host-RAM spill-tier suite (tests/test_spill.py):
+# strict DEPTH_EXHAUSTED exact unique/explored parity with the device
+# visited table capped at ~1/8 of the state count (single-device AND
+# sharded engines), SIGKILL-mid-spill resume parity, the supervisor's
+# CapacityOverflow->spill-retry capacity ladder, spill-dispatch fault
+# injection, and the foreign-checkpoint refusal — plus the bench's
+# `--spill` phase shape (states/min at 1/8 capacity vs uncapped) via
+# `python bench.py --spill` if you want the number itself.
+capacity-smoke:  ## host-RAM spill tier + capacity-ladder suite on CPU
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m capacity -p no:cacheprovider
 
 dryrun:          ## multi-chip sharding dry run on a virtual CPU mesh
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -41,9 +41,13 @@ BENCH_r05's preflight hung for 300 s so the CPU fallback never ran):
   semantics: BFS never silently narrows; dropped=0 enforced fatally),
   one attempt, child-side time bound (a slow run returns a partial rate,
   TIME_EXHAUSTED, instead of a parent kill).  Beam runs only with time
-  left and is reported under "beam"; the **swarm explorer's** deep-probe
-  rates (walkers/sec, unique-states/min, deepest depth — tpu/swarm.py)
-  ride under "swarm" with the same always-reports guarantees.
+  left and is reported under "beam" (dropped_states is a first-class
+  field, warned past DSLABS_DROPPED_WARN); the **swarm explorer's**
+  deep-probe rates (walkers/sec, unique-states/min, deepest depth —
+  tpu/swarm.py) ride under "swarm", and the **capacity ladder's**
+  1/8-visited-capacity spill rate vs uncapped (exact-parity flag,
+  spill counters — tpu/spill.py) under "spill", all with the same
+  always-reports guarantees.
 
 Budget table (vs the 480 s deadline): docs/resilience.md.
 """
@@ -81,6 +85,7 @@ FALLBACK_CAP_SECS = 240.0    # wedged-TPU CPU-mesh fallback phase
 STRICT_CAP_SECS = 420.0      # child budget cap; parent adds kill slack
 BEAM_CAP_SECS = 300.0
 SWARM_CAP_SECS = 150.0       # swarm-explorer phase (ISSUE 5)
+SPILL_CAP_SECS = 120.0       # capacity-ladder phase (ISSUE 6)
 # Parent backstop beyond the child's budget.  Generous on purpose: the
 # child's time checks are level-granular (a slow level can overrun
 # max_secs by ~30 s, sharded.py round-3 note), the strict child floors
@@ -266,6 +271,10 @@ def _run_rung(chunk_per_device: int, frontier_cap: int, visited_cap: int,
         "depth": outcome.depth,
         "end": outcome.end_condition,
         "dropped": outcome.dropped,
+        # Beam drops under their roadmap name (ISSUE 6 satellite: the
+        # BENCH_r03 5.8M-drop shape is a first-class JSON field, and
+        # the engine warns loudly past DSLABS_DROPPED_WARN).
+        "dropped_states": outcome.dropped_states,
         "elapsed": elapsed,
         "compile_secs": round(compile_secs, 1),
         "aot_compile_secs": outcome.compile_secs,
@@ -334,6 +343,7 @@ def _run_strict(ev_budget, budget_secs: float) -> dict:
         "depth": outcome.depth,
         "end": outcome.end_condition,
         "dropped": outcome.dropped,
+        "dropped_states": outcome.dropped_states,
         "elapsed": time.time() - t0,
         "compile_secs": round(compile_secs, 1),
         "aot_compile_secs": outcome.compile_secs,
@@ -449,6 +459,72 @@ def _run_swarm(budget_secs: float) -> dict:
         "vis_over": outcome.visited_overflow,
         "elapsed": round(outcome.elapsed_secs, 2),
         "compile_secs": outcome.compile_secs,
+    }
+
+
+def _run_spill(budget_secs: float) -> dict:
+    """Capacity-ladder phase (ISSUE 6, tpu/spill.py): a strict lab1
+    BFS measured twice on the identical protocol/depth — uncapped,
+    then with the device visited table capped at ~1/8 of the measured
+    unique-state count and the host-RAM spill tier enabled — so the
+    round records what graceful degradation under HBM exhaustion
+    costs: states/min both ways, exact unique/explored parity flag,
+    spill counters, and ``dropped_states`` (must be 0 — the whole
+    point).  Same always-reports guarantees as every phase: child-side
+    time bound, heartbeats on stderr, one JSON line on stdout."""
+    import dataclasses
+    import math
+
+    _persistent_cache()
+
+    from dslabs_tpu.tpu.engine import TensorSearch
+    from dslabs_tpu.tpu.protocols.clientserver import \
+        make_clientserver_protocol
+
+    t_phase = time.time()
+    proto = dataclasses.replace(
+        make_clientserver_protocol(n_clients=3, w=4), goals={})
+    depth = int(os.environ.get("DSLABS_SPILL_DEPTH", "11"))
+
+    def run_one(visited_cap, spill, chunk):
+        search = TensorSearch(proto, chunk=chunk, frontier_cap=1 << 15,
+                              max_depth=2, visited_cap=visited_cap,
+                              spill=spill)
+        t_c = time.time()
+        search.run()          # warm-up: compile outside the window
+        compile_secs = time.time() - t_c
+        search.max_depth = depth
+        search.max_secs = max(
+            20.0, (budget_secs - (time.time() - t_phase)) / 2)
+        t0 = time.time()
+        out = search.run()
+        return out, max(time.time() - t0, 1e-9), compile_secs
+
+    _hb("spill: uncapped reference run")
+    un, dt_u, cs_u = run_one(1 << 20, False, 2048)
+    cap = 1 << max(3, int(math.floor(
+        math.log2(max(un.unique_states // 8, 8)))))
+    _hb(f"spill: capped run (visited_cap {cap} ~ "
+        f"{cap / max(un.unique_states, 1):.2f} of "
+        f"{un.unique_states} states)")
+    sp, dt_s, cs_s = run_one(cap, True, 16)
+    parity = (un.end_condition == sp.end_condition
+              and un.unique_states == sp.unique_states
+              and un.states_explored == sp.states_explored)
+    return {
+        "value": sp.unique_states / dt_s * 60.0,
+        "uncapped_per_min": round(un.unique_states / dt_u * 60.0, 1),
+        "visited_cap": cap,
+        "capped_fraction": round(cap / max(un.unique_states, 1), 4),
+        "end": sp.end_condition, "depth": sp.depth,
+        "unique": sp.unique_states, "explored": sp.states_explored,
+        "exact_parity": parity,
+        "spilled_keys": sp.spilled_keys,
+        "host_tier_hits": sp.host_tier_hits,
+        "respilled_frontier": sp.respilled_frontier,
+        "dropped_states": sp.dropped_states,
+        "compile_secs": round(cs_u + cs_s, 1),
+        "total_secs": round(time.time() - t_phase, 1),
     }
 
 
@@ -687,6 +763,13 @@ def main() -> None:
                 silence=PHASE_SILENCE_SECS)
             if swarm is not None:
                 result["swarm"] = swarm
+        if _remaining() > 75:
+            spill_res, _spill_err = _sub(
+                ["--spill", str(min(90.0, _remaining() - 15))],
+                min(90.0, _remaining() - 10), "spill-cpu",
+                silence=PHASE_SILENCE_SECS)
+            if spill_res is not None:
+                result["spill"] = spill_res
         _emit(result)
         return
 
@@ -770,6 +853,21 @@ def main() -> None:
     else:
         result["swarm_error"] = "skipped: deadline nearly exhausted"
 
+    # ---- phase 5: the capacity ladder (states/min at 1/8 visited
+    # capacity with the host-RAM spill tier vs uncapped, exact-parity
+    # flag, dropped_states == 0).  Never the headline; skipped rather
+    # than raced when the deadline is nearly spent.
+    budget = min(SPILL_CAP_SECS, _remaining() - KILL_SLACK_SECS - 10)
+    if budget > 45:
+        spill_res, spill_err = _sub(["--spill", str(budget)], budget,
+                                    "spill", silence=PHASE_SILENCE_SECS)
+        if spill_res is not None:
+            result["spill"] = spill_res
+        else:
+            result["spill_error"] = spill_err
+    else:
+        result["spill_error"] = "skipped: deadline nearly exhausted"
+
     result["total_secs"] = round(time.time() - _T0, 1)
     _emit(result)
 
@@ -792,6 +890,11 @@ if __name__ == "__main__":
         budget = (float(sys.argv[2]) if len(sys.argv) > 2
                   else SWARM_CAP_SECS)
         print(json.dumps(_run_swarm(budget)))
+        sys.exit(0)
+    if len(sys.argv) >= 2 and sys.argv[1] == "--spill":
+        budget = (float(sys.argv[2]) if len(sys.argv) > 2
+                  else SPILL_CAP_SECS)
+        print(json.dumps(_run_spill(budget)))
         sys.exit(0)
     if len(sys.argv) >= 2 and sys.argv[1] == "--calibrate":
         print(json.dumps(_calibrate()))

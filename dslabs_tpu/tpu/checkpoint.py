@@ -21,10 +21,22 @@ search's SEMANTIC state, not any engine's carry layout:
                                    layout — the swarm explorer
                                    (tpu/swarm.py) stores walker depths,
                                    event histories, PRNG keys, and the
-                                   restart seed pool here.  Covered by
+                                   restart seed pool here, and the
+                                   host-RAM spill tier (tpu/spill.py)
+                                   its running counters as
+                                   ``extra__spill_stats``.  Covered by
                                    the content checksum like every
                                    other entry; loaders that do not
                                    know a key simply ignore it.
+
+Spill-mode dumps (tpu/spill.py, docs/capacity.md) stay TIER-AGNOSTIC
+on purpose: ``visited_keys`` stores the exact-deduplicated UNION of
+the device table and the host tier and ``frontier`` includes every
+host-spooled segment, so a non-spill engine resumes a spill dump (if
+its table fits the key set), a spill engine resumes any dump (all keys
+load into the tier, the device epoch restarts empty), and the host
+tier inherits the CRC32 checksum + ``.prev`` rotation below without
+any format change — kill-mid-spill resume is bit-exact.
 
 Every dump carries a **config fingerprint** of the search it belongs
 to: the protocol's packed-lane shape (protocol name, node/message/timer

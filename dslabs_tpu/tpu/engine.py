@@ -70,6 +70,12 @@ __all__ = ["TensorProtocol", "TensorState", "TensorSearch", "SearchOutcome",
            "device_get"]
 
 
+def _visited_warn() -> float:
+    from dslabs_tpu.tpu.spill import visited_warn_threshold
+
+    return visited_warn_threshold()
+
+
 def device_get(x) -> np.ndarray:
     """The device->host readback funnel for the device-resident run loop.
 
@@ -255,6 +261,23 @@ class SearchOutcome:
     # outcome was cut short because the OTHER portfolio lane already
     # landed a terminal verdict — never a standalone verdict.
     cancelled: bool = False
+    # Host-RAM spill-tier accounting (tpu/spill.py, docs/capacity.md):
+    # keys evicted from the device visited table to the host tier,
+    # re-discoveries the level-boundary refilter removed (each one a
+    # corrected duplicate count), and frontier rows that took the
+    # host-spool detour instead of being dropped.  All zero when the
+    # spill tier never engaged.
+    spilled_keys: int = 0
+    host_tier_hits: int = 0
+    respilled_frontier: int = 0
+
+    @property
+    def dropped_states(self) -> int:
+        """Beam-truncation drop COUNT under its roadmap name (ISSUE 6
+        satellite: surfaced everywhere, never a boolean) — the same
+        number as ``dropped``; the alias exists so bench JSON, docs,
+        and the DSLABS_DROPPED_WARN threshold all speak one name."""
+        return self.dropped
 
 
 # ----------------------------------------------------------------- hashing
@@ -701,8 +724,32 @@ class TensorSearch:
                  strict: bool = True,
                  use_host_visited: bool = False,
                  checkpoint_path: Optional[str] = None,
-                 checkpoint_every: int = 0):
+                 checkpoint_every: int = 0,
+                 spill=None):
         self.p = protocol
+        # Host-RAM spill tier (tpu/spill.py, docs/capacity.md): when
+        # enabled, a full visited table EVICTS to a host fingerprint
+        # set (and would-be frontier drops take a host spool detour)
+        # instead of raising CapacityOverflow — strict searches stay
+        # exact, just slower.  ``spill`` is False/None (off; env
+        # DSLABS_SPILL=1 flips the default), True, or a
+        # spill.SpillConfig.  Off by default: the overflow contract
+        # (strict raises) is load-bearing for existing callers; the
+        # supervisor's capacity ladder opts in on their behalf.
+        from dslabs_tpu.tpu import spill as spill_mod
+
+        if spill is None:
+            spill = spill_mod.spill_env_default()
+        if isinstance(spill, spill_mod.SpillConfig):
+            self._spill = spill_mod.SpillManager(spill)
+        elif spill:
+            self._spill = spill_mod.SpillManager()
+        else:
+            self._spill = None
+        if self._spill is not None and record_trace:
+            raise ValueError(
+                "spill + record_trace is unsupported (trace spills are "
+                "host-side already; run the trace pass uncapped)")
         # Unified checkpoint/resume (tpu/checkpoint.py): every
         # ``checkpoint_every`` completed waves the live search state —
         # occupied frontier rows + occupied visited-table lines +
@@ -1624,6 +1671,15 @@ class TensorSearch:
         p = self.p
         C = self.chunk
         lanes = self.lanes
+        # Spill mode (tpu/spill.py): a chunk that would overflow the
+        # frontier buffer or leave table keys unresolved ABORTS — every
+        # carry entry (the visited table included) reverts to its
+        # pre-chunk state and an abort code rides the f_drop stats slot
+        # (bit 0 = frontier full, bit 1 = table full).  The host drains
+        # nxt to the spool / evicts the table to the host tier, then
+        # re-dispatches the SAME chunk against exactly the state it
+        # first saw — nothing is ever dropped or double-counted.
+        spill_on = self._spill is not None
 
         def step(carry, masks):
             cur, cur_n = carry["cur"], carry["cur_n"][0]
@@ -1678,7 +1734,12 @@ class TensorSearch:
             fresh = inserted | unresolved
 
             # ---- frontier-compact append of fresh, un-pruned successors
-            sel = fresh & ~pruned
+            # Spill mode appends pruned-but-fresh rows TOO: every fresh
+            # insert must reach the host refilter so a post-eviction
+            # re-discovery of a pruned state is charged to dup_epoch
+            # (the drain recomputes the prune mask host-side and drops
+            # the rows before they can be re-expanded).
+            sel = fresh if spill_on else fresh & ~pruned
             spos = jnp.cumsum(sel) - 1
             nxt_n = carry["nxt_n"][0]
             sdst = jnp.where(sel & (nxt_n + spos < cap), nxt_n + spos, cap)
@@ -1703,6 +1764,19 @@ class TensorSearch:
                 "flag_cnt": carry["flag_cnt"] + cnts,
                 "flag_rows": flag_rows,
             }
+            if spill_on:
+                tbl_full = jnp.any(unresolved)
+                front_full = (nxt_n + jnp.sum(sel).astype(jnp.int32)
+                              ) > cap
+                abort = tbl_full | front_full
+                code = (front_full.astype(jnp.int32)
+                        + 2 * tbl_full.astype(jnp.int32))
+                for k in ("j", "evp", "nxt", "nxt_n", "visited",
+                          "vis_n", "explored", "overflow", "vis_over",
+                          "flag_cnt", "flag_rows"):
+                    out[k] = jnp.where(abort, carry[k], out[k])
+                out["f_drop"] = jnp.where(abort, code[None],
+                                          out["f_drop"])
             # The per-wave scalar stats ride along with every step (the
             # ONLY recurring device->host transfer of the device loop:
             # [explored, overflow, vis_over, f_drop, vis_n, nxt_n, j] ++
@@ -1836,6 +1910,19 @@ class TensorSearch:
                 return out
         C = self.chunk
         user_cap = -(-self.frontier_cap // C) * C
+        if self._spill is not None:
+            # Spill mode skips the geometric buffer growth (a drain to
+            # the host spool replaces every would-be drop, so the only
+            # reason to grow is a single chunk's successors exceeding
+            # the buffer — which growth cannot amortise anyway) and
+            # runs its own per-chunk-synced wave loop.
+            try:
+                return self._device_attempt_spill(state, user_cap, t0,
+                                                  ck)
+            finally:
+                w = getattr(self, "_ckpt_writer_obj", None)
+                if w is not None:
+                    w.join()
         # Start the frontier buffer SMALL (2k rows): the per-wave promote
         # zero+copy scales with the buffer, and most searches never need
         # more; the ones that do pay one bounded deterministic restart
@@ -2018,6 +2105,22 @@ class TensorSearch:
                     f"{p.timer_cap}, or max_live_sends={p.max_live_sends} "
                     f"overflowed at depth {depth} ({overflow} drops); "
                     "raise the caps")
+            # Early-warning instrumentation (ISSUE 6 satellite): table
+            # pressure is visible BEFORE the overflow contract fires.
+            limit = (3 * self.visited_cap // 4 if self.strict
+                     else self.visited_cap)
+            if (not getattr(self, "_warned_visited", False)
+                    and vis_n >= int(_visited_warn() * limit)):
+                self._warned_visited = True
+                import warnings
+
+                warnings.warn(
+                    f"{p.name}: visited table at {vis_n}/"
+                    f"{self.visited_cap} at depth {depth} — capacity "
+                    "pressure; raise visited_cap or enable the spill "
+                    "tier (spill=True / DSLABS_SPILL=1) before this "
+                    "becomes CapacityOverflow",
+                    RuntimeWarning, stacklevel=2)
             if vis_over and self.strict:
                 raise CapacityOverflow(
                     f"{p.name}: visited table full at depth {depth} "
@@ -2052,3 +2155,381 @@ class TensorSearch:
                     "SPACE_EXHAUSTED", explored, vis_n, depth,
                     time.time() - t0, visited_overflow=vis_over)
             n_chunks = -(-nxt_n // C)
+
+    # ----------------------------------------- host-RAM spill tier mode
+    #
+    # The capacity-laddered variant of the device loop (ISSUE 6,
+    # tpu/spill.py, docs/capacity.md).  Same wave cycle, three changes:
+    # the step program ABORTS (wholesale revert + code on the f_drop
+    # stats slot) instead of dropping frontier rows or leaving table
+    # keys unresolved; the host answers an abort by draining nxt to the
+    # frontier spool and/or bulk-evicting the visited table to the host
+    # fingerprint tier; and once the tier is live, each level boundary
+    # re-filters the would-be frontier against it (one batched
+    # readback + corrected promote mask — never per-state sync), so
+    # "table full" means "slower, still exact" instead of
+    # CapacityOverflow.  Syncs are per chunk (no speculation): spill
+    # mode is the degraded-capacity gear, correctness over latency.
+    # Every host round-trip goes through the _dispatch seam
+    # (device.spill_drain / spill_evict / spill_reinject tags), so
+    # supervisor retry/watchdog/FaultPlan and the warden's heartbeat
+    # cover the spill path like any other dispatch.
+
+    def _spill_progs(self, cap: int) -> dict:
+        cache = getattr(self, "_spill_prog_cache", None)
+        if cache is None:
+            cache = self._spill_prog_cache = {}
+        progs = cache.get(cap)
+        if progs is not None:
+            return progs
+        lanes = self.lanes
+        V = self.visited_cap
+
+        def reset(carry):
+            out = dict(carry)
+            out["nxt"] = jnp.zeros((cap + 1, lanes), jnp.int32)
+            out["nxt_n"] = jnp.zeros((1,), jnp.int32)
+            out["f_drop"] = jnp.zeros((1,), jnp.int32)
+            return out
+
+        def evict(carry):
+            out = dict(carry)
+            out["visited"] = visited_mod.empty_table(V)
+            out["vis_n"] = jnp.zeros((1,), jnp.int32)
+            out["f_drop"] = jnp.zeros((1,), jnp.int32)
+            return out
+
+        progs = {"reset": jax.jit(reset, donate_argnums=0),
+                 "evict": jax.jit(evict, donate_argnums=0),
+                 "inject": {}, "fp": {}, "prune": {}}
+        cache[cap] = progs
+        return progs
+
+    @staticmethod
+    def _pow2_bucket(n: int, cap: int) -> int:
+        m = 1
+        while m < max(n, 1):
+            m <<= 1
+        return min(m, cap)
+
+    def _spill_keys_of(self, rows: np.ndarray, cap: int) -> np.ndarray:
+        """Fingerprints of host rows via the SAME device fp program the
+        engines hash with (bit-identical keys; jitted per pow2 row
+        bucket so compiles stay O(log cap))."""
+        from dslabs_tpu.tpu.kernels import fingerprint_rows
+
+        n = len(rows)
+        if not n:
+            return np.zeros((0, 4), np.uint32)
+        m = self._pow2_bucket(n, max(cap, n))
+        progs = self._spill_progs(cap)
+        fn = progs["fp"].get(m)
+        if fn is None:
+            fn = progs["fp"][m] = jax.jit(fingerprint_rows)
+        pad = np.zeros((m, rows.shape[1]), np.int32)
+        pad[:n] = rows
+        return np.asarray(fn(jnp.asarray(pad)))[:n]
+
+    def _spill_keep_mask(self, rows: np.ndarray, cap: int) -> np.ndarray:
+        """Exception/prune mask recomputed on drained rows (spill mode
+        appends pruned-but-fresh rows so they reach the refilter; they
+        must not be re-expanded)."""
+        rows = np.asarray(rows)
+        keep = rows[:, -1] == 0
+        if self.p.prunes and len(rows):
+            n = len(rows)
+            m = self._pow2_bucket(n, max(cap, n))
+            progs = self._spill_progs(cap)
+            fn = progs["prune"].get(m)
+            if fn is None:
+                preds = list(self.p.prunes.values())
+
+                def pruned_of(r):
+                    st = self.unflatten_rows(r)
+                    acc = jnp.zeros((r.shape[0],), bool)
+                    for f in preds:
+                        acc = acc | jax.vmap(f)(st)
+                    return acc
+
+                fn = progs["prune"][m] = jax.jit(pruned_of)
+            pad = np.zeros((m, rows.shape[1]), np.int32)
+            pad[:n] = rows
+            keep &= ~np.asarray(fn(jnp.asarray(pad)))[:n]
+        return keep
+
+    def _spill_drain(self, carry, nxt_n: int, cap: int):
+        """Mid-level or boundary drain: read nxt's occupied prefix back
+        (ONE batched readback), refilter against the host tier (the
+        corrected promote mask), drop exception/pruned rows, spool the
+        keepers for deferred re-expansion, and reset nxt on device."""
+        sp = self._spill
+
+        def fetch():
+            rows = np.asarray(carry["nxt"])[:nxt_n]
+            return rows, self._spill_keys_of(rows, cap)
+
+        if nxt_n:
+            rows, keys = self._dispatch("device.spill_drain", fetch)
+            kept = sp.refilter(rows, keys)
+            if len(kept):
+                kept = kept[self._spill_keep_mask(kept, cap)]
+            sp.spool(kept)
+        return self._dispatch("device.spill_drain",
+                              self._spill_progs(cap)["reset"], carry)
+
+    def _spill_evict_dev(self, carry, cap: int):
+        """Bulk eviction: occupied table lines -> host tier, table and
+        vis_n restart empty (a fresh epoch)."""
+        sp = self._spill
+
+        def fetch():
+            return visited_mod.host_occupied(
+                np.asarray(carry["visited"]))
+
+        occ = self._dispatch("device.spill_evict", fetch)
+        sp.evict(occ)
+        return self._dispatch("device.spill_evict",
+                              self._spill_progs(cap)["evict"], carry)
+
+    def _spill_inject(self, carry, rows: np.ndarray, cap: int):
+        """(Re-)inject a host frontier segment as the live cur — the
+        deferred re-expansion wave, at unchanged BFS depth."""
+        n = len(rows)
+        m = self._pow2_bucket(n, cap)
+        lanes = self.lanes
+        progs = self._spill_progs(cap)
+        fn = progs["inject"].get(m)
+        if fn is None:
+            def inject(c, seg, nn):
+                out = dict(c)
+                out["cur"] = jnp.zeros((cap, lanes),
+                                       jnp.int32).at[:m].set(seg)
+                out["cur_n"] = nn
+                out["j"] = jnp.zeros((1,), jnp.int32)
+                out["evp"] = jnp.zeros((1,), jnp.int32)
+                return out
+
+            fn = progs["inject"][m] = jax.jit(inject, donate_argnums=0)
+        pad = np.zeros((m, lanes), np.int32)
+        pad[:n] = rows
+        carry = self._dispatch("device.spill_reinject", fn, carry,
+                               jnp.asarray(pad),
+                               jnp.asarray([n], jnp.int32))
+        return carry, n
+
+    def _spill_wave(self, carry, step, rt, cap: int, n_cur: int):
+        """Expand the injected frontier completely: per-chunk dispatch
+        + sync, answering abort codes (bit 0 frontier full -> drain;
+        bit 1 table full -> drain then evict) by re-dispatching the
+        same chunk against the recovered capacity."""
+        C = self.chunk
+        sp = self._spill
+        n_chunks = max(1, -(-n_cur // C))
+        while True:
+            carry, sdev = self._dispatch("device.step", step, carry, rt)
+            s = self._dispatch("device.sync", device_get, sdev)
+            code = int(s[3])
+            vis_n, nxt_n = int(s[4]), int(s[5])
+            if code:
+                if (code & 1) and nxt_n == 0:
+                    raise CapacityOverflow(
+                        f"{self.p.name}: one chunk's fresh successors "
+                        f"exceed frontier_cap={cap} even with spill; "
+                        f"lower chunk ({C}) or raise frontier_cap")
+                if (code & 2) and vis_n == 0:
+                    raise CapacityOverflow(
+                        f"{self.p.name}: one chunk's unique successors "
+                        f"exceed visited_cap={self.visited_cap} even "
+                        f"from an empty table; lower chunk ({C}) or "
+                        "raise visited_cap")
+                carry = self._spill_drain(carry, nxt_n, cap)
+                if code & 2:
+                    carry = self._spill_evict_dev(carry, cap)
+                continue
+            if int(s[6]) >= n_chunks:
+                # The wave's final sync must stay accurate (the caller
+                # derives the exact unique count from its vis_n), so
+                # end-of-wave eviction is the BOUNDARY's job.
+                return carry, s
+            # Proactive mid-wave high-water eviction keeps aborts rare:
+            # drain whatever nxt holds (pre-eviction refilter order),
+            # then evict, then continue the wave on a fresh epoch.
+            if sp.should_evict(vis_n, self.visited_cap):
+                carry = self._spill_drain(carry, nxt_n, cap)
+                carry = self._spill_evict_dev(carry, cap)
+
+    def _spill_ckpt(self, carry, depth: int, explored: int,
+                    elapsed: float) -> None:
+        """Synchronous unified dump at a spill-mode level boundary:
+        ``visited_keys`` = device table ∪ host tier (exact-deduped, so
+        the resumer's unique base is len(keys)); ``frontier`` = every
+        spooled segment of the level about to run; spill counters ride
+        ``extra__spill_stats``.  CRC + .prev rotation come free from
+        tpu/checkpoint.py — kill-mid-spill resume is bit-exact."""
+        from dslabs_tpu.tpu import checkpoint as ckpt_mod
+
+        sp = self._spill
+        occ = visited_mod.host_occupied(np.asarray(carry["visited"]))
+        ckpt_mod.save(self.checkpoint_path, ckpt_mod.SearchCheckpoint(
+            fingerprint=self._ckpt_fingerprint(), depth=depth,
+            explored=explored, elapsed=elapsed,
+            frontier=sp.spool_cur.concat(self.lanes),
+            visited_keys=sp.checkpoint_keys(occ),
+            extra=sp.checkpoint_extra()))
+
+    def _spill_carry_from_ckpt(self, ck, cap: int):
+        """Spill-mode resume: ALL dumped keys load into the host tier,
+        the device table restarts empty (a fresh epoch — the refilter
+        makes that exact), and the dumped frontier spools in cap-sized
+        segments with the first injected as cur."""
+        sp = self._spill
+        sp.restore(ck.visited_keys, ck.extra)
+        rows = np.asarray(ck.frontier, np.int32)
+        for i in range(0, len(rows), cap):
+            sp.spool_cur.push(rows[i:i + cap])
+        lanes = self.lanes
+        nf = len(self._flag_names)
+        carry = {
+            "cur": jnp.zeros((cap, lanes), jnp.int32),
+            "cur_n": jnp.zeros((1,), jnp.int32),
+            "j": jnp.zeros((1,), jnp.int32),
+            "evp": jnp.zeros((1,), jnp.int32),
+            "nxt": jnp.zeros((cap + 1, lanes), jnp.int32),
+            "nxt_n": jnp.zeros((1,), jnp.int32),
+            "visited": visited_mod.empty_table(self.visited_cap),
+            "vis_n": jnp.zeros((1,), jnp.int32),
+            "explored": jnp.asarray([ck.explored], jnp.int32),
+            "overflow": jnp.zeros((1,), jnp.int32),
+            "vis_over": jnp.zeros((1,), jnp.int32),
+            "f_drop": jnp.zeros((1,), jnp.int32),
+            "flag_cnt": jnp.zeros((nf,), jnp.int32),
+            "flag_rows": jnp.zeros((nf, lanes), jnp.int32),
+        }
+        seg = sp.spool_cur.pop()
+        return self._spill_inject(carry, seg, cap)
+
+    def _device_attempt_spill(self, state, cap: int, t0,
+                              ck=None) -> SearchOutcome:
+        """The spill-mode device BFS (structure mirrors
+        _device_attempt; see the section comment above)."""
+        import time
+
+        from dslabs_tpu.tpu import spill as spill_mod
+
+        p = self.p
+        sp = self._spill
+        step, promote, init = self._dev_programs(cap)
+        rt = getattr(self, "_rt_masks", None)
+        warn_at = spill_mod.visited_warn_threshold()
+        if ck is not None:
+            if not len(ck.frontier):
+                out = SearchOutcome(
+                    "SPACE_EXHAUSTED", ck.explored,
+                    len(ck.visited_keys), ck.depth, time.time() - t0,
+                    visited_overflow=ck.vis_over)
+                sp.attach(out)
+                return out
+            carry, n_cur = self._spill_carry_from_ckpt(ck, cap)
+            depth = ck.depth
+            explored = ck.explored
+            unique = sp.unique(0)
+        else:
+            carry = self._dispatch("device.init", init,
+                                   flatten_state(state))
+            depth = 0
+            n_cur = 1
+            explored, unique = 0, 1
+        while True:
+            if (self.max_secs is not None
+                    and time.time() - t0 > self.max_secs) \
+                    or self._cancelled():
+                out = SearchOutcome(
+                    "TIME_EXHAUSTED", explored, unique, depth,
+                    time.time() - t0, cancelled=self._cancelled())
+                sp.attach(out)
+                return out
+            if self.max_depth is not None and depth >= self.max_depth:
+                out = SearchOutcome("DEPTH_EXHAUSTED", explored, unique,
+                                    depth, time.time() - t0)
+                sp.attach(out)
+                return out
+            depth += 1
+            self._current_depth = depth
+            # ---- expand the level: cur, then every spooled segment of
+            # the same level as deferred re-expansion waves.
+            while True:
+                carry, s = self._spill_wave(carry, step, rt, cap, n_cur)
+                explored, overflow = int(s[0]), int(s[1])
+                vis_over, vis_n, nxt_n = int(s[2]), int(s[4]), int(s[5])
+                flag_counts = np.asarray(s[7:])
+                if overflow:
+                    raise CapacityOverflow(
+                        f"{p.name}: net_cap={p.net_cap}, timer_cap="
+                        f"{p.timer_cap}, or max_live_sends="
+                        f"{p.max_live_sends} overflowed at depth "
+                        f"{depth} ({overflow} drops); raise the caps")
+                if vis_over:
+                    raise AssertionError(
+                        "spill mode committed unresolved keys (abort "
+                        "contract violated)")
+                unique = sp.unique(vis_n)
+                if flag_counts.any():
+                    out = self._dev_terminal(carry, flag_counts,
+                                             explored, unique, depth,
+                                             t0, 0)
+                    sp.attach(out)
+                    return out
+                load = vis_n / self.visited_cap
+                if load >= warn_at and not getattr(
+                        self, "_warned_visited", False):
+                    self._warned_visited = True
+                    import warnings
+
+                    warnings.warn(
+                        f"{p.name}: visited table at "
+                        f"{load:.0%} of visited_cap="
+                        f"{self.visited_cap} at depth {depth} — "
+                        "capacity pressure; the spill tier will evict "
+                        f"at {sp.config.high_water:.0%}",
+                        RuntimeWarning, stacklevel=2)
+                seg = sp.pop_current()
+                if seg is None:
+                    break
+                carry, n_cur = self._spill_inject(carry, seg, cap)
+            # ---- level boundary.  Fast path until the tier/spool is
+            # live: the plain on-device promote.
+            if not (sp.active
+                    or sp.should_evict(vis_n, self.visited_cap)):
+                if nxt_n == 0:
+                    out = SearchOutcome(
+                        "SPACE_EXHAUSTED", explored, unique, depth,
+                        time.time() - t0)
+                    sp.attach(out)
+                    return out
+                carry = self._dispatch("device.promote", promote, carry)
+                n_cur = nxt_n
+                if (self.checkpoint_path and self.checkpoint_every
+                        and depth % self.checkpoint_every == 0):
+                    self._write_dev_ckpt(carry, depth, explored, 0,
+                                         nxt_n, time.time() - t0)
+                continue
+            # Slow exact path: drain nxt through the refilter, evict at
+            # high water (AFTER the drain — the refilter must run
+            # against the pre-eviction tier), swap spools, re-inject.
+            carry = self._spill_drain(carry, nxt_n, cap)
+            if sp.should_evict(vis_n, self.visited_cap):
+                carry = self._spill_evict_dev(carry, cap)
+                vis_n = 0
+            unique = sp.unique(vis_n)
+            sp.advance_level()
+            if not sp.spool_cur.segments:
+                out = SearchOutcome("SPACE_EXHAUSTED", explored, unique,
+                                    depth, time.time() - t0)
+                sp.attach(out)
+                return out
+            if (self.checkpoint_path and self.checkpoint_every
+                    and depth % self.checkpoint_every == 0):
+                self._spill_ckpt(carry, depth, explored,
+                                 time.time() - t0)
+            seg = sp.spool_cur.pop()
+            carry, n_cur = self._spill_inject(carry, seg, cap)

@@ -58,6 +58,10 @@ from dslabs_tpu.tpu.engine import (CapacityOverflow, SearchOutcome,
                                    TensorProtocol, TensorSearch,
                                    device_get, flatten_state,
                                    row_fingerprints, state_fingerprints)
+from dslabs_tpu.tpu.spill import (dropped_warn_threshold as
+                                  _DROPPED_WARN,
+                                  visited_warn_threshold as
+                                  _VISITED_WARN)
 
 __all__ = ["ShardedTensorSearch", "make_mesh"]
 
@@ -126,7 +130,8 @@ class ShardedTensorSearch(TensorSearch):
                  checkpoint_path: Optional[str] = None,
                  checkpoint_every: int = 0,
                  superstep: Optional[bool] = None,
-                 aot_warmup: Optional[bool] = None):
+                 aot_warmup: Optional[bool] = None,
+                 spill=None):
         # Frontier checkpointing (SURVEY §5 "dump SoA tensors"): every
         # ``checkpoint_every`` levels the live carry — the OCCUPIED
         # frontier prefix, the occupied visited-table lines, and the
@@ -187,7 +192,17 @@ class ShardedTensorSearch(TensorSearch):
                          ev_budget=ev_budget, record_trace=record_trace,
                          visited_cap=visited_cap, strict=strict,
                          checkpoint_path=checkpoint_path,
-                         checkpoint_every=checkpoint_every)
+                         checkpoint_every=checkpoint_every,
+                         spill=spill)
+        # Host-RAM spill tier (tpu/spill.py, docs/capacity.md): the
+        # carry gains an ``f_full`` abort-code lane, the chunk step
+        # aborts-and-reverts GLOBALLY (a psum'd decision — owner-side
+        # inserts for a retried chunk must revert on every device) on
+        # frontier/table exhaustion, and level boundaries refilter the
+        # would-be frontier against the host tier.  All of it is
+        # conditional on the knob so non-spill programs stay
+        # byte-identical (warm compile caches keep hitting).
+        self._spill_on = self._spill is not None
         # Trace mode: each level spills (child_fp, parent_fp, event_id)
         # for every appended successor; reconstruction walks fingerprints
         # back to the root on the HOST (fps are stable identities, so the
@@ -208,6 +223,11 @@ class ShardedTensorSearch(TensorSearch):
         # n_chunks + 1 dispatches to superstep + promote.
         self.use_superstep = (_env_on("DSLABS_SHARDED_SUPERSTEP", True)
                               if superstep is None else bool(superstep))
+        if self._spill_on:
+            # The spill abort protocol rides the superstep's drain
+            # condition; the legacy per-chunk parity driver stays the
+            # oracle for UNCAPPED runs only.
+            self.use_superstep = True
         self._superstep = jax.jit(self._build_superstep(), donate_argnums=0)
         # Chunk-step budget per superstep dispatch when a wall-clock
         # budget is active: bounds device work between host clock checks
@@ -283,6 +303,15 @@ class ShardedTensorSearch(TensorSearch):
         # production; the bisect tool measures the REAL step this way
         # instead of maintaining a drifting copy.
         stop_after = getattr(self, "_stop_after", None)
+        # Spill mode (tpu/spill.py): frontier/table exhaustion ABORTS
+        # the chunk step GLOBALLY — the decision is psum'd and every
+        # device reverts its whole update (owner-side inserts included:
+        # a producer may have kept rows whose keys live only in another
+        # device's reverted table, so all-or-nothing is the only sound
+        # retry unit) — and an abort code lands on the carry's f_full
+        # lane (bit 0 frontier full, bit 1 table full) for the host to
+        # answer with a drain/evict before re-dispatching.
+        spill_on = self._spill is not None
 
         def _stopped(carry, *live):
             out = dict(carry)
@@ -457,7 +486,13 @@ class ShardedTensorSearch(TensorSearch):
             # queues states at the cutoff depth).
             noapp = carry["noapp"][0] == 1
             sel_would = fresh_rows & ~pruned
-            sel = sel_would & ~noapp
+            # Spill mode appends pruned-but-fresh rows too: every fresh
+            # insert must reach the host refilter (the drain recomputes
+            # the prune/exception mask before anything re-expands), or
+            # a post-eviction re-discovery of a pruned state would
+            # double-count.  noapp counting stays on sel_would — the
+            # DEPTH-vs-SPACE decision is about expandable successors.
+            sel = (fresh_rows if spill_on else sel_would) & ~noapp
             spos = jnp.cumsum(sel) - 1
             nxt, nxt_n = carry["nxt"], carry["nxt_n"][0]
             sdst = jnp.where(sel & (nxt_n + spos < F), nxt_n + spos, F)
@@ -505,6 +540,20 @@ class ShardedTensorSearch(TensorSearch):
                 # Trace meta rides the SAME append scatter as the rows.
                 out["tmeta"] = carry["tmeta"].at[sdst].set(meta)
                 out["flag_meta"] = flag_meta
+            if spill_on:
+                front_full = (nxt_n + jnp.sum(sel).astype(jnp.int32)
+                              ) > F
+                tbl_full = jnp.any(unres_s)
+                fa = jax.lax.psum(front_full.astype(jnp.int32), ax) > 0
+                tb = jax.lax.psum(tbl_full.astype(jnp.int32), ax) > 0
+                abort = fa | tb
+                code = fa.astype(jnp.int32) + 2 * tb.astype(jnp.int32)
+                for k in ("j", "evp", "nxt", "nxt_n", "visited",
+                          "vis_n", "explored", "overflow", "vis_over",
+                          "drops", "flag_cnt", "flag_rows"):
+                    out[k] = jnp.where(abort, carry[k], out[k])
+                out["f_full"] = jnp.where(abort, code,
+                                          jnp.int32(0))[None]
             return out
 
         return local
@@ -559,6 +608,8 @@ class ShardedTensorSearch(TensorSearch):
         def _psum(x):
             return jax.lax.psum(x, ax)
 
+        spill_on = self._spill is not None
+
         def stats_local(c, steps):
             core = jnp.stack([
                 _psum(c["overflow"][0]),
@@ -574,14 +625,27 @@ class ShardedTensorSearch(TensorSearch):
             remaining = _psum(
                 (c["j"][0] * C < c["cur_n"][0]).astype(jnp.int32))
             tail = jnp.stack([remaining, steps]).astype(jnp.int32)
-            return jnp.concatenate([core, flags, tail])
+            parts = [core, flags, tail]
+            if spill_on:
+                # Spill abort code LAST so every legacy index parse is
+                # untouched; the abort is global, so any device's copy
+                # is the fleet's (pmax for robustness).
+                parts.append(jax.lax.pmax(
+                    c["f_full"], ax).astype(jnp.int32))
+            return jnp.concatenate(parts)
 
         def super_local(carry, budget, masks=None):
             def cond(st):
                 c, k = st
                 own = c["j"][0] * C < c["cur_n"][0]
-                return (jax.lax.psum(own.astype(jnp.int32), ax) > 0) & (
+                keep = (jax.lax.psum(own.astype(jnp.int32), ax) > 0) & (
                     k < budget)
+                if spill_on:
+                    # A spill abort (frontier/table full) suspends the
+                    # drain loop: the host must evict/spool before the
+                    # held-back chunk can be re-stepped.
+                    keep = keep & (c["f_full"][0] == 0)
+                return keep
 
             def body(st):
                 c, k = st
@@ -698,6 +762,8 @@ class ShardedTensorSearch(TensorSearch):
                 "drops", "flag_cnt", "flag_rows"]
         if self.record_trace:
             keys += ["tmeta", "flag_meta"]
+        if self._spill_on:
+            keys += ["f_full"]
         return {k: P(ax) for k in keys}
 
     # ----------------------------------------------------------------- run
@@ -766,6 +832,8 @@ class ShardedTensorSearch(TensorSearch):
             if self.record_trace:
                 out["tmeta"] = jnp.zeros((D * (F + 1), 9), jnp.uint32)
                 out["flag_meta"] = jnp.zeros((D * nf, 9), jnp.uint32)
+            if self._spill_on:
+                out["f_full"] = jnp.zeros((D,), jnp.int32)
             return out
 
         fn = jax.jit(build, out_shardings={
@@ -799,6 +867,8 @@ class ShardedTensorSearch(TensorSearch):
         if self.record_trace:
             out["tmeta"] = sd((D * (F + 1), 9), jnp.uint32)
             out["flag_meta"] = sd((D * nf, 9), jnp.uint32)
+        if self._spill_on:
+            out["f_full"] = sd((D,))
         return out
 
     def aot_warmup(self) -> float:
@@ -1021,6 +1091,22 @@ class ShardedTensorSearch(TensorSearch):
         if ck.fp_map is not None:
             self._fp_map = {tuple(r[:4]): (tuple(r[4:8]), int(r[8]))
                             for r in ck.fp_map.tolist()}
+        if self._spill_on:
+            # Spill-mode resume: every dumped key loads into the host
+            # tier and the device tables restart empty (a fresh epoch
+            # — the refilter makes that exact); the dumped frontier
+            # spools in mesh-sized segments, the first injected via
+            # the normal resume path.
+            import dataclasses as _dc
+
+            sp = self._spill
+            sp.restore(ck.visited_keys, ck.extra)
+            rows = np.asarray(ck.frontier, np.int32)
+            segcap = self.n_devices * self.f_cap
+            for i in range(segcap, len(rows), segcap):
+                sp.spool_cur.push(rows[i:i + segcap])
+            ck = _dc.replace(ck, frontier=rows[:segcap],
+                             visited_keys=np.zeros((0, 4), np.uint32))
         return self._resume_carry(ck), ck.depth, ck.elapsed
 
     def _resume_carry(self, ck):
@@ -1089,6 +1175,8 @@ class ShardedTensorSearch(TensorSearch):
             if self.record_trace:
                 out["tmeta"] = jnp.zeros((F + 1, 9), jnp.uint32)
                 out["flag_meta"] = jnp.zeros((nf, 9), jnp.uint32)
+            if self._spill_on:
+                out["f_full"] = jnp.zeros((1,), jnp.int32)
             return out, jnp.sum(unres).astype(jnp.int32)[None]
 
         ax = self.axis
@@ -1105,6 +1193,160 @@ class ShardedTensorSearch(TensorSearch):
                 f"rebuild the checkpoint's visited set ({n_unres} keys "
                 "unresolved); raise visited_cap")
         return carry
+
+    # ------------------------------------------- host-RAM spill tier
+    #
+    # The sharded half of tpu/spill.py (docs/capacity.md): same
+    # drain/evict/refilter/reinject protocol as the single-device
+    # engine, with the carry sharded over the mesh — readbacks gather
+    # all shards, injections re-split into contiguous per-device
+    # shares (the same discipline as _resume_carry).  Everything rides
+    # the _dispatch seam (sharded.spill_* tags) so supervisor retry/
+    # watchdog/FaultPlan and warden heartbeats cover the spill path.
+
+    def _sh_spill_progs(self) -> dict:
+        progs = getattr(self, "_sh_spill_prog_cache", None)
+        if progs is not None:
+            return progs
+        F, V, lanes = self.f_cap, self.v_cap, self.lanes
+        spec = self._carry_specs()
+
+        def reset(c):
+            out = dict(c)
+            out["nxt"] = jnp.zeros((F + 1, lanes), jnp.int32)
+            out["nxt_n"] = jnp.zeros((1,), jnp.int32)
+            out["f_full"] = jnp.zeros((1,), jnp.int32)
+            return out
+
+        def evict(c):
+            out = dict(c)
+            out["visited"] = jnp.full((V + 1, 4), MAXU32, jnp.uint32)
+            out["vis_n"] = jnp.zeros((1,), jnp.int32)
+            out["f_full"] = jnp.zeros((1,), jnp.int32)
+            return out
+
+        progs = self._sh_spill_prog_cache = {
+            "reset": jax.jit(shard_map(
+                reset, mesh=self.mesh, in_specs=(spec,),
+                out_specs=spec, check_rep=False), donate_argnums=0),
+            "evict": jax.jit(shard_map(
+                evict, mesh=self.mesh, in_specs=(spec,),
+                out_specs=spec, check_rep=False), donate_argnums=0),
+            "inject": {},
+        }
+        return progs
+
+    def _sh_spill_drain(self, carry):
+        """Gather every device's occupied nxt prefix (ONE batched
+        readback), refilter against the host tier, drop exception/
+        pruned rows, spool the keepers, and reset nxt on device."""
+        sp = self._spill
+        D, F, lanes = self.n_devices, self.f_cap, self.lanes
+
+        def fetch():
+            nxt = np.asarray(carry["nxt"]).reshape(D, F + 1, lanes)
+            counts = np.asarray(carry["nxt_n"]).reshape(-1)
+            if counts.sum():
+                rows = np.concatenate(
+                    [nxt[d, :counts[d]] for d in range(D)])
+            else:
+                rows = np.zeros((0, lanes), np.int32)
+            return rows, self._spill_keys_of(rows, F)
+
+        rows, keys = self._dispatch("sharded.spill_drain", fetch)
+        if len(rows):
+            kept = sp.refilter(rows, keys)
+            if len(kept):
+                kept = kept[self._spill_keep_mask(kept, F)]
+            sp.spool(kept)
+        return self._dispatch("sharded.spill_drain",
+                              self._sh_spill_progs()["reset"], carry)
+
+    def _sh_spill_evict(self, carry):
+        """Bulk eviction: every shard's occupied table lines -> the
+        (global) host tier; all tables restart empty."""
+        sp = self._spill
+        D, V = self.n_devices, self.v_cap
+
+        def fetch():
+            vis = np.asarray(carry["visited"]).reshape(D, V + 1, 4)
+            return np.concatenate(
+                [visited_mod.host_occupied(vis[d]) for d in range(D)])
+
+        occ = self._dispatch("sharded.spill_evict", fetch)
+        sp.evict(occ)
+        self._last_vis_max = 0
+        return self._dispatch("sharded.spill_evict",
+                              self._sh_spill_progs()["evict"], carry)
+
+    def _sh_spill_inject(self, carry, rows: np.ndarray):
+        """(Re-)inject a host frontier segment: contiguous per-device
+        shares (ceil split), zero-padded to a pow2 per-device width so
+        the jitted set programs stay O(log f_cap).  Returns
+        ``(carry, per_device_max)`` — the chunk-grid bound."""
+        D, F, lanes = self.n_devices, self.f_cap, self.lanes
+        n = len(rows)
+        per = max(1, -(-n // D))
+        if per > F:
+            raise CapacityOverflow(
+                f"{self.p.name}: spool segment of {n} rows exceeds "
+                f"frontier_cap {F}/device on {D} devices")
+        m = self.cpd
+        while m < per:
+            m <<= 1
+        m = max(min(m, F), 1)
+        progs = self._sh_spill_progs()
+        fn = progs["inject"].get(m)
+        if fn is None:
+            spec = self._carry_specs()
+            ax = self.axis
+
+            def inject(c, seg, nn):
+                out = dict(c)
+                out["cur"] = jnp.zeros((F, lanes),
+                                       jnp.int32).at[:m].set(seg)
+                out["cur_n"] = nn
+                out["j"] = jnp.zeros((1,), jnp.int32)
+                out["evp"] = jnp.zeros((1,), jnp.int32)
+                out["f_full"] = jnp.zeros((1,), jnp.int32)
+                return out
+
+            fn = progs["inject"][m] = jax.jit(shard_map(
+                inject, mesh=self.mesh,
+                in_specs=(spec, P(ax), P(ax)), out_specs=spec,
+                check_rep=False), donate_argnums=0)
+        buf = np.zeros((D, m, lanes), np.int32)
+        counts = np.zeros((D,), np.int32)
+        for d in range(D):
+            part = rows[d * per:(d + 1) * per]
+            buf[d, :len(part)] = part
+            counts[d] = len(part)
+        shard = NamedSharding(self.mesh, P(self.axis))
+        seg = jax.device_put(buf.reshape(D * m, lanes), shard)
+        nn = jax.device_put(counts, shard)
+        carry = self._dispatch("sharded.spill_reinject", fn, carry,
+                               seg, nn)
+        return carry, int(counts.max())
+
+    def _sh_spill_ckpt(self, carry, depth: int, explored: int,
+                       elapsed: float) -> None:
+        """Synchronous spill-mode unified dump: visited_keys = all
+        shard tables ∪ host tier (exact-deduped), frontier = the
+        spooled next level, counters on extra__spill_stats.  Any rung
+        — spill or not, sharded or not — resumes it (docs/capacity.md)."""
+        from dslabs_tpu.tpu import checkpoint as ckpt_mod
+
+        sp = self._spill
+        D, V = self.n_devices, self.v_cap
+        vis = np.asarray(carry["visited"]).reshape(D, V + 1, 4)
+        occ = np.concatenate(
+            [visited_mod.host_occupied(vis[d]) for d in range(D)])
+        ckpt_mod.save(self.checkpoint_path, ckpt_mod.SearchCheckpoint(
+            fingerprint=self._ckpt_fingerprint(), depth=depth,
+            explored=explored, elapsed=elapsed,
+            frontier=sp.spool_cur.concat(self.lanes),
+            visited_keys=sp.checkpoint_keys(occ),
+            extra=sp.checkpoint_extra()))
 
     def run(self, check_initial: bool = True,
             initial: Optional[dict] = None,
@@ -1139,6 +1381,21 @@ class ShardedTensorSearch(TensorSearch):
             out = self._run_levels(t0, state, resume)
             out.levels = self._level_records or None
             out.compile_secs = round(getattr(self, "compile_secs", 0.0), 3)
+            if self._spill_on:
+                self._spill.attach(out)
+            if out.dropped and out.dropped >= _DROPPED_WARN():
+                # The BENCH_r03 shape (5.8M beam drops, one flag to
+                # show for it) must be LOUD — dropped_states is also a
+                # first-class bench JSON field now.
+                import warnings
+
+                warnings.warn(
+                    f"{self.p.name}: beam truncation dropped "
+                    f"{out.dropped} states (>= DSLABS_DROPPED_WARN="
+                    f"{_DROPPED_WARN()}); the verdict covers a "
+                    "narrowed space — raise frontier_cap or enable "
+                    "the spill tier for zero-drop coverage",
+                    RuntimeWarning, stacklevel=2)
             return out
         finally:
             # An async checkpoint still draining must complete before the
@@ -1158,6 +1415,8 @@ class ShardedTensorSearch(TensorSearch):
                 # normally binds these) never runs.
                 explored = int(np.asarray(carry["explored"]).sum())
                 vis_total = int(np.asarray(carry["vis_n"]).sum())
+                if self._spill_on:
+                    vis_total = self._spill.unique(vis_total)
                 drops = int(np.asarray(carry["drops"]).sum())
             else:
                 carry = self._init_carry(state)
@@ -1187,7 +1446,13 @@ class ShardedTensorSearch(TensorSearch):
                 # below replaces the loop-top check for this level.
                 noapp_level = (self.max_depth is not None
                                and depth >= self.max_depth)
-                if noapp_level:
+                if noapp_level and not self._spill_on:
+                    # Spill mode keeps appends ON for the final level:
+                    # the host spool absorbs an over-cap last level
+                    # (noapp's reason to exist), and every fresh insert
+                    # must reach the boundary refilter or a tier
+                    # re-discovery would double-count (exact unique
+                    # parity is the whole point of the tier).
                     shard = NamedSharding(self.mesh, P(self.axis))
                     carry["noapp"] = jax.device_put(
                         np.ones(self.n_devices, np.int32), shard)
@@ -1200,11 +1465,34 @@ class ShardedTensorSearch(TensorSearch):
                      chunks) = self._level_chunks(carry, depth, t0, max_n)
                 if out is not None:
                     return out
+                if self._spill_on:
+                    # Deferred re-expansion waves: spooled segments of
+                    # THIS level (frontier rows that outgrew the device
+                    # buffer, or a resumed dump's tail) run at the same
+                    # depth before the level closes — depth accounting,
+                    # and therefore DEPTH_EXHAUSTED soundness, is
+                    # preserved exactly.
+                    while True:
+                        seg = self._spill.pop_current()
+                        if seg is None:
+                            break
+                        carry, per = self._sh_spill_inject(carry, seg)
+                        (carry, out, explored, vis_total, drops, max_n,
+                         ch2) = self._level_superstep(carry, depth, t0,
+                                                      per)
+                        chunks += ch2
+                        if out is not None:
+                            return out
                 self._level_records.append({
                     "depth": depth, "chunks": int(chunks),
                     "wall": round(time.time() - t_lvl, 4),
                     "explored": int(explored), "unique": int(vis_total),
-                    "next_frontier": int(max_n)})
+                    "next_frontier": int(max_n),
+                    # Per-level visited-table load factor (ISSUE 6
+                    # satellite): pressure is visible in bench JSON
+                    # before the overflow contract can fire.
+                    "load_factor": round(
+                        getattr(self, "_last_load", 0.0), 4)})
                 if _LEVEL_TIMING:
                     import sys as _sys
                     r = self._level_records[-1]
@@ -1215,6 +1503,24 @@ class ShardedTensorSearch(TensorSearch):
                           f"unique={r['unique']} "
                           f"next={r['next_frontier']}",
                           flush=True, file=_sys.stderr)
+                if noapp_level and self._spill_on:
+                    # Final level, spill mode: drain through the
+                    # refilter for the exact dedup accounting, then
+                    # decide DEPTH vs SPACE on the refiltered,
+                    # prune-filtered remainder — the same "expandable
+                    # successors remained" question noapp's would-be
+                    # count answers in the uncapped run.
+                    carry = self._sh_spill_drain(carry)
+                    vis_total = self._spill.unique(
+                        int(np.asarray(carry["vis_n"]).sum()))
+                    remained = self._spill.spool_next.rows()
+                    out = SearchOutcome(
+                        "DEPTH_EXHAUSTED" if remained > 0
+                        else "SPACE_EXHAUSTED",
+                        explored, vis_total, depth,
+                        time.time() - t0, dropped=drops,
+                        samples=getattr(self, "_deep_samples", None))
+                    return out
                 if noapp_level:
                     # max_n counted the final level's would-be appends:
                     # zero means the space ended exactly at the depth
@@ -1229,6 +1535,36 @@ class ShardedTensorSearch(TensorSearch):
                         visited_overflow=getattr(self, "_vis_over", 0))
                 if self.record_trace:
                     self._spill_tmeta(carry)
+                sp = self._spill
+                if self._spill_on and (sp.active or sp.should_evict(
+                        getattr(self, "_last_vis_max", 0), self.v_cap)):
+                    # Spill boundary: drain nxt through the refilter
+                    # (the corrected promote mask — one batched
+                    # readback against the PRE-eviction tier), evict at
+                    # high water, swap spools, re-inject the next
+                    # level's first segment.  Replaces the on-device
+                    # promote until the pressure clears.
+                    carry = self._sh_spill_drain(carry)
+                    if sp.should_evict(
+                            getattr(self, "_last_vis_max", 0),
+                            self.v_cap):
+                        carry = self._sh_spill_evict(carry)
+                    vis_total = sp.unique(
+                        int(np.asarray(carry["vis_n"]).sum()))
+                    sp.advance_level()
+                    if not sp.spool_cur.segments:
+                        return SearchOutcome(
+                            "SPACE_EXHAUSTED", explored, vis_total,
+                            depth, time.time() - t0, dropped=drops,
+                            samples=getattr(self, "_deep_samples",
+                                            None))
+                    if (self.checkpoint_every and self.checkpoint_path
+                            and depth % self.checkpoint_every == 0):
+                        self._sh_spill_ckpt(carry, depth, explored,
+                                            time.time() - t0)
+                    seg = sp.spool_cur.pop()
+                    carry, max_n = self._sh_spill_inject(carry, seg)
+                    continue
                 carry = self._dispatch(
                     "sharded.promote",
                     self._prog("promote", self._finish_level), carry)
@@ -1281,6 +1617,30 @@ class ShardedTensorSearch(TensorSearch):
             if out is not None:
                 return (carry, out, explored, vis_total, drops, nxt_max,
                         chunks)
+            if self._spill_on and int(stats[10 + nf]):
+                # Spill abort: the superstep suspended on a frontier-
+                # full (bit 0) / table-full (bit 1) chunk, reverted
+                # wholesale.  Drain nxt through the refilter to the
+                # host spool, evict the tables if they were the wall,
+                # and re-enter the drain loop — the held-back chunk
+                # re-steps against recovered capacity.
+                code = int(stats[10 + nf])
+                if (code & 1) and nxt_max == 0:
+                    raise CapacityOverflow(
+                        f"{self.p.name}: one chunk's fresh successors "
+                        f"exceed frontier_cap={self.f_cap}/device even "
+                        f"with spill; lower chunk_per_device "
+                        f"({self.cpd}) or raise frontier_cap")
+                if (code & 2) and int(stats[4]) == 0:
+                    raise CapacityOverflow(
+                        f"{self.p.name}: one chunk's unique successors "
+                        f"exceed visited_cap={self.v_cap}/device even "
+                        f"from empty tables; lower chunk_per_device "
+                        f"({self.cpd}) or raise visited_cap")
+                carry = self._sh_spill_drain(carry)
+                if code & 2:
+                    carry = self._sh_spill_evict(carry)
+                continue
             if int(stats[8 + nf]) == 0:     # every device's shard drained
                 return (carry, None, explored, vis_total, drops, nxt_max,
                         chunks)
@@ -1437,6 +1797,36 @@ class ShardedTensorSearch(TensorSearch):
         # .visited_overflow): keys the full table degraded to
         # treat-as-fresh — sound, but unique counts may over-report.
         self._vis_over = vis_over
+        # Early-warning instrumentation (ISSUE 6 satellite): surface
+        # table pressure BEFORE the overflow contract fires.  The
+        # effective ceiling is the strict 75% guard when it applies,
+        # the raw capacity otherwise; load_factor also lands on the
+        # per-level records (SearchOutcome.levels).
+        limit = (3 * self.v_cap // 4
+                 if self.strict and not self._spill_on else self.v_cap)
+        self._last_load = vis_max / self.v_cap
+        self._last_vis_max = vis_max
+        if (vis_max >= int(_VISITED_WARN() * limit)
+                and not getattr(self, "_warned_visited", False)):
+            self._warned_visited = True
+            import warnings
+
+            warnings.warn(
+                f"{self.p.name}: visited table at {vis_max}/"
+                f"{self.v_cap} per device (load "
+                f"{self._last_load:.0%}) at depth {depth} — capacity "
+                "pressure; "
+                + ("the spill tier will evict to host RAM"
+                   if self._spill_on else
+                   "raise visited_cap or enable the spill tier "
+                   "(spill=True / DSLABS_SPILL=1) before this "
+                   "becomes CapacityOverflow"),
+                RuntimeWarning, stacklevel=2)
+        if self._spill_on:
+            # Exact unique count across tiers (tpu/spill.py): the
+            # device total is one epoch's inserts; the host tier holds
+            # the evicted epochs, minus refilter-corrected duplicates.
+            vis_total = self._spill.unique(vis_total)
         if overflow:
             raise CapacityOverflow(
                 f"{self.p.name}: {overflow} semantic drops at depth "
@@ -1456,6 +1846,14 @@ class ShardedTensorSearch(TensorSearch):
                 out.dropped = drops
                 out.visited_overflow = vis_over
                 return out, explored, vis_total, drops, nxt_max, j_done
+        if self._spill_on:
+            # The abort protocol reverts any chunk that would leave
+            # keys unresolved, and eviction replaces the 75% guard.
+            if vis_over:
+                raise AssertionError(
+                    "spill mode committed unresolved keys (abort "
+                    "contract violated)")
+            return None, explored, vis_total, drops, nxt_max, j_done
         if vis_over and self.strict:
             raise CapacityOverflow(
                 f"{self.p.name}: visited hash table full at depth "
@@ -1470,10 +1868,13 @@ class ShardedTensorSearch(TensorSearch):
         return None, explored, vis_total, drops, nxt_max, j_done
 
     def _limit_outcome(self, cond, carry, depth, t0):
+        unique = int(np.asarray(carry["vis_n"]).sum())
+        if self._spill_on:
+            unique = self._spill.unique(unique)
         return SearchOutcome(
             cond,
             int(np.asarray(carry["explored"]).sum()),
-            int(np.asarray(carry["vis_n"]).sum()),
+            unique,
             depth, time.time() - t0,
             dropped=int(np.asarray(carry["drops"]).sum()),
             samples=getattr(self, "_deep_samples", None),

@@ -1,0 +1,379 @@
+"""Host-RAM spill tier: the sound capacity ladder under every engine.
+
+ROADMAP item #4 ("bigger-than-HBM searches").  Before this module the
+device visited table and the frontier buffer were hard walls: a strict
+search that crossed either raised :class:`CapacityOverflow` and the
+failover ladder could not help (smaller rungs have LESS capacity), and
+a beam search silently narrowed (BENCH_r03 dropped 5.8M states with
+only a flag to show for it).  This module turns both walls into the
+classic explicit-state tiering trick (disk-based / hash-compaction
+checkers a la Stern & Dill): cold state moves OFF the fast device onto
+host RAM, and "full" degrades to "slower, still exact".
+
+Three cooperating pieces, all engine-agnostic (the drivers in
+engine.py / sharded.py own the device half):
+
+* :class:`HostVisitedTier` — the cold half of the visited set: an
+  exact, sorted host-side store of 128-bit fingerprints (the same
+  (h1, h2) uint64 representation the host parity loop uses).  When the
+  device table crosses the load-factor high-water mark, its occupied
+  key lines are EVICTED here in bulk and the table restarts empty; at
+  every level boundary the batch of would-be-fresh states is
+  RE-FILTERED against this tier (one batched readback + a corrected
+  promote mask — never a per-state host sync), so a state discovered
+  before an eviction is never re-expanded after one.
+
+* :class:`FrontierSpool` — the overflow-safe frontier: rows that would
+  be dropped (beam) or fatal (strict) at frontier capacity are spilled
+  here and re-injected as deferred re-expansion waves AT THE SAME BFS
+  DEPTH, so level/depth accounting — and therefore the soundness of a
+  ``DEPTH_EXHAUSTED`` verdict — is preserved exactly.  Two spools
+  (current level being consumed, next level being assembled) swap at
+  each level boundary.
+
+* :class:`SpillManager` — the bookkeeping that keeps strict counts
+  EXACT across tiers.  Within one eviction epoch the device table
+  dedups perfectly; across epochs a re-discovered state is counted
+  once more by the device (``dup_epoch``) and the refilter both drops
+  the duplicate row and subtracts the double count:
+
+      unique = len(tier) + vis_n_device_epoch - dup_epoch
+
+  The refilter invariants that make this exact (derived in
+  docs/capacity.md):
+
+  - every batch of rows leaving the device (a mid-level drain or the
+    level-boundary promote) is refiltered against the tier BEFORE the
+    next eviction can add its own keys to the tier — so a first
+    discovery is never mistaken for a re-discovery;
+  - each drained batch spans a single eviction epoch, so it is
+    internally duplicate-free (the device table guaranteed that);
+  - an aborted chunk step is reverted WHOLESALE on device (table
+    included), so a retried chunk re-runs against exactly the state it
+    first saw.
+
+Checkpoints: the unified dump (tpu/checkpoint.py) stays engine- and
+tier-agnostic — ``visited_keys`` stores the UNION of the device table
+and the host tier (deduplicated), ``frontier`` stores the injected
+rows plus every spooled segment, and the spill counters ride an
+``extra__spill_stats`` array.  The host tier therefore inherits the
+CRC32 checksum and ``.prev`` rotation like everything else, a non-
+spill engine can resume a spill dump (if its table fits the key set),
+and a spill engine resumes ANY dump by loading all keys into the tier
+and starting the device table empty — which is why kill-mid-spill
+resume is bit-exact.
+
+Env knobs: ``DSLABS_SPILL`` (default engine opt-in), ``DSLABS_SPILL_
+HIGH_WATER`` (eviction trigger, default 0.60 of visited_cap),
+``DSLABS_SPILL_HOST_CAP`` (max keys the tier accepts before raising —
+the supervisor's capacity ladder escalates it), ``DSLABS_VISITED_WARN``
+(early-warning load factor, default 0.85), ``DSLABS_DROPPED_WARN``
+(beam dropped-states warning threshold, default 1e6).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["SpillConfig", "SpillStats", "HostVisitedTier",
+           "FrontierSpool", "SpillManager", "spill_env_default",
+           "VISITED_WARN_DEFAULT", "DROPPED_WARN_DEFAULT",
+           "visited_warn_threshold", "dropped_warn_threshold"]
+
+VISITED_WARN_DEFAULT = 0.85
+DROPPED_WARN_DEFAULT = 1_000_000
+
+
+def spill_env_default() -> bool:
+    v = os.environ.get("DSLABS_SPILL")
+    if v is None:
+        return False
+    return v.strip().lower() not in ("0", "", "off", "false", "no")
+
+
+def visited_warn_threshold() -> float:
+    """Load factor past which the early-warning fires (satellite:
+    operators must see pressure BEFORE overflow)."""
+    try:
+        return float(os.environ.get("DSLABS_VISITED_WARN", "") or
+                     VISITED_WARN_DEFAULT)
+    except ValueError:
+        return VISITED_WARN_DEFAULT
+
+
+def dropped_warn_threshold() -> int:
+    try:
+        return int(os.environ.get("DSLABS_DROPPED_WARN", "") or
+                   DROPPED_WARN_DEFAULT)
+    except ValueError:
+        return DROPPED_WARN_DEFAULT
+
+
+@dataclasses.dataclass(frozen=True)
+class SpillConfig:
+    """Spill-tier knobs.  ``high_water``: device-table load factor that
+    triggers a bulk eviction at the next boundary (the abort-and-retry
+    backstop in the step programs catches anything that outruns it).
+    ``host_cap``: max keys the host tier accepts; crossing it raises
+    CapacityOverflow (host RAM is large, not infinite) — the
+    supervisor's capacity ladder retries with a bigger tier."""
+
+    high_water: float = float(
+        os.environ.get("DSLABS_SPILL_HIGH_WATER", "") or 0.60)
+    host_cap: int = int(
+        os.environ.get("DSLABS_SPILL_HOST_CAP", "") or (1 << 26))
+
+
+@dataclasses.dataclass
+class SpillStats:
+    """The accounting SearchOutcome surfaces (never a silent spill)."""
+
+    spilled_keys: int = 0        # keys evicted device -> host tier
+    host_tier_hits: int = 0      # re-discoveries the refilter removed
+    respilled_frontier: int = 0  # frontier rows through the host spool
+    evictions: int = 0           # bulk table evictions
+    reinjections: int = 0        # deferred re-expansion waves injected
+
+    def as_array(self) -> np.ndarray:
+        return np.asarray([self.spilled_keys, self.host_tier_hits,
+                           self.respilled_frontier, self.evictions,
+                           self.reinjections], np.int64)
+
+    @classmethod
+    def from_array(cls, a) -> "SpillStats":
+        a = np.asarray(a, np.int64).reshape(-1)
+        return cls(*(int(x) for x in a[:5]))
+
+
+def _rows_to_u64(keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """[K, 4] uint32 device-format key rows -> (h1, h2) uint64 pairs —
+    the host tier's native representation (same packing as
+    engine.host_keys; duplicated here to keep spill.py import-light)."""
+    keys = np.asarray(keys, np.uint64).reshape(-1, 4)
+    h1 = (keys[:, 0] << np.uint64(32)) | keys[:, 1]
+    h2 = (keys[:, 2] << np.uint64(32)) | keys[:, 3]
+    return h1, h2
+
+
+def _u64_to_rows(h1: np.ndarray, h2: np.ndarray) -> np.ndarray:
+    rows = np.empty((len(h1), 4), np.uint32)
+    rows[:, 0] = (h1 >> np.uint64(32)).astype(np.uint32)
+    rows[:, 1] = (h1 & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    rows[:, 2] = (h2 >> np.uint64(32)).astype(np.uint32)
+    rows[:, 3] = (h2 & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return rows
+
+
+class HostVisitedTier:
+    """Exact host-RAM fingerprint set: sorted (h1, h2) uint64 arrays.
+
+    Membership reuses the collision-safe forward scan of
+    ``engine.sorted_member`` (imported lazily — engine imports nothing
+    from this module at top level, so no cycle)."""
+
+    def __init__(self, host_cap: int = 1 << 26):
+        self.h1 = np.empty((0,), np.uint64)
+        self.h2 = np.empty((0,), np.uint64)
+        self.host_cap = host_cap
+
+    def __len__(self) -> int:
+        return len(self.h1)
+
+    def nbytes(self) -> int:
+        return int(self.h1.nbytes + self.h2.nbytes)
+
+    def absorb(self, keys: np.ndarray) -> int:
+        """Merge [K, 4] key rows into the tier (sorted-merge, exact
+        dedup against the existing set AND within the batch).  Returns
+        the number of NEW keys added; raises CapacityOverflow past
+        ``host_cap`` (the ladder escalates the cap, never silently
+        drops a key)."""
+        if not len(keys):
+            return 0
+        h1, h2 = _rows_to_u64(keys)
+        order = np.lexsort((h2, h1))
+        h1, h2 = h1[order], h2[order]
+        first = np.ones(len(h1), bool)
+        first[1:] = (h1[1:] != h1[:-1]) | (h2[1:] != h2[:-1])
+        h1, h2 = h1[first], h2[first]
+        fresh = ~self._contains_u64(h1, h2)
+        n_new = int(fresh.sum())
+        if n_new == 0:
+            return 0
+        if len(self) + n_new > self.host_cap:
+            from dslabs_tpu.tpu.engine import CapacityOverflow
+
+            raise CapacityOverflow(
+                f"host spill tier full: {len(self)} + {n_new} keys > "
+                f"host_cap {self.host_cap} "
+                "(raise DSLABS_SPILL_HOST_CAP or let the supervisor's "
+                "capacity ladder escalate it)")
+        mh1 = np.concatenate([self.h1, h1[fresh]])
+        mh2 = np.concatenate([self.h2, h2[fresh]])
+        mo = np.lexsort((mh2, mh1))
+        self.h1, self.h2 = mh1[mo], mh2[mo]
+        return n_new
+
+    def _contains_u64(self, h1, h2) -> np.ndarray:
+        from dslabs_tpu.tpu.engine import sorted_member
+
+        if not len(self.h1) or not len(h1):
+            return np.zeros(len(h1), bool)
+        return sorted_member(self.h1, self.h2, h1, h2)
+
+    def contains(self, keys: np.ndarray) -> np.ndarray:
+        """[K, 4] key rows -> bool membership mask."""
+        h1, h2 = _rows_to_u64(keys)
+        return self._contains_u64(h1, h2)
+
+    def key_rows(self) -> np.ndarray:
+        """The whole tier as [K, 4] uint32 rows (checkpoint union)."""
+        return _u64_to_rows(self.h1, self.h2)
+
+
+class FrontierSpool:
+    """Host-side queue of frontier row segments for ONE BFS level."""
+
+    def __init__(self):
+        self.segments: List[np.ndarray] = []
+
+    def push(self, rows: np.ndarray) -> None:
+        if len(rows):
+            self.segments.append(np.asarray(rows, np.int32))
+
+    def pop(self) -> Optional[np.ndarray]:
+        return self.segments.pop(0) if self.segments else None
+
+    def rows(self) -> int:
+        return sum(len(s) for s in self.segments)
+
+    def concat(self, lanes: int) -> np.ndarray:
+        if not self.segments:
+            return np.zeros((0, lanes), np.int32)
+        return np.concatenate(self.segments, axis=0)
+
+
+class SpillManager:
+    """Per-run spill state shared by a driver's device half.
+
+    The driver owns WHEN (load-factor checks, abort codes from the
+    step program); this object owns the host tier, the two spools, the
+    exact-count bookkeeping, and the refilter math."""
+
+    def __init__(self, config: Optional[SpillConfig] = None):
+        self.config = config or SpillConfig()
+        self.tier = HostVisitedTier(host_cap=self.config.host_cap)
+        self.spool_cur = FrontierSpool()    # level being consumed
+        self.spool_next = FrontierSpool()   # level being assembled
+        self.stats = SpillStats()
+        # Device-table inserts THIS EPOCH that duplicate a tier key
+        # (refilter hits); reset at each eviction — see the module
+        # docstring's unique formula.
+        self.dup_epoch = 0
+
+    # ------------------------------------------------------------ state
+
+    @property
+    def active(self) -> bool:
+        """Spill machinery engaged: once anything has been tiered or
+        spooled, level boundaries must run the refilter path.  Until
+        then the driver keeps its fast on-device promote."""
+        return (len(self.tier) > 0 or bool(self.spool_cur.segments)
+                or bool(self.spool_next.segments))
+
+    def should_evict(self, vis_n: int, cap: int) -> bool:
+        return vis_n >= int(self.config.high_water * cap)
+
+    def unique(self, vis_n_device: int) -> int:
+        """Exact distinct-state count across tiers (module docstring)."""
+        return len(self.tier) + int(vis_n_device) - self.dup_epoch
+
+    # ------------------------------------------------------- operations
+
+    def evict(self, occupied_keys: np.ndarray) -> int:
+        """Bulk-absorb the device table's occupied key lines; the
+        caller clears the device table (and its vis_n) right after.
+        Returns keys newly tiered."""
+        n_new = self.tier.absorb(occupied_keys)
+        self.stats.spilled_keys += n_new
+        self.stats.evictions += 1
+        self.dup_epoch = 0
+        return n_new
+
+    def refilter(self, rows: np.ndarray,
+                 keys: np.ndarray) -> np.ndarray:
+        """The corrected promote mask: drop rows whose key is already
+        in the host tier (a re-discovery of a pre-eviction state) and
+        charge the duplicate device-table insert to ``dup_epoch``.
+        Returns the kept rows."""
+        if not len(rows) or not len(self.tier):
+            return np.asarray(rows, np.int32)
+        hit = self.tier.contains(keys)
+        n_hit = int(hit.sum())
+        if n_hit:
+            self.stats.host_tier_hits += n_hit
+            self.dup_epoch += n_hit
+            rows = np.asarray(rows)[~hit]
+        return np.asarray(rows, np.int32)
+
+    def spool(self, rows: np.ndarray) -> None:
+        """Queue refiltered NEXT-level rows for deferred re-expansion."""
+        if len(rows):
+            self.stats.respilled_frontier += len(rows)
+            self.spool_next.push(rows)
+
+    def pop_current(self) -> Optional[np.ndarray]:
+        seg = self.spool_cur.pop()
+        if seg is not None:
+            self.stats.reinjections += 1
+        return seg
+
+    def advance_level(self) -> None:
+        """Level boundary: the assembled next level becomes current."""
+        assert not self.spool_cur.segments, \
+            "advance_level with unconsumed current-level segments"
+        self.spool_cur, self.spool_next = (self.spool_next,
+                                           FrontierSpool())
+
+    # ------------------------------------------------------ checkpoints
+
+    def checkpoint_keys(self, device_keys: np.ndarray) -> np.ndarray:
+        """visited_keys for the unified dump: device ∪ tier, exact-
+        deduplicated (the resumer's unique base is len(keys))."""
+        parts = [np.asarray(device_keys, np.uint32).reshape(-1, 4),
+                 self.tier.key_rows()]
+        allk = np.concatenate(parts, axis=0)
+        if not len(allk):
+            return allk
+        h1, h2 = _rows_to_u64(allk)
+        order = np.lexsort((h2, h1))
+        h1, h2 = h1[order], h2[order]
+        first = np.ones(len(h1), bool)
+        first[1:] = (h1[1:] != h1[:-1]) | (h2[1:] != h2[:-1])
+        return _u64_to_rows(h1[first], h2[first])
+
+    def checkpoint_extra(self) -> dict:
+        return {"spill_stats": self.stats.as_array()}
+
+    def restore(self, visited_keys: np.ndarray,
+                extra: Optional[dict] = None) -> None:
+        """Resume-from-dump: ALL dumped keys load into the host tier
+        and the device epoch restarts empty — bit-exact by the unique
+        formula (len(tier) + 0 - 0 = the dump's distinct count)."""
+        self.tier = HostVisitedTier(host_cap=self.config.host_cap)
+        self.spool_cur = FrontierSpool()
+        self.spool_next = FrontierSpool()
+        self.dup_epoch = 0
+        self.tier.absorb(visited_keys)
+        if extra and "spill_stats" in extra:
+            self.stats = SpillStats.from_array(extra["spill_stats"])
+
+    def attach(self, outcome) -> None:
+        """Surface the accounting on a SearchOutcome (never silent)."""
+        outcome.spilled_keys = self.stats.spilled_keys
+        outcome.host_tier_hits = self.stats.host_tier_hits
+        outcome.respilled_frontier = self.stats.respilled_frontier

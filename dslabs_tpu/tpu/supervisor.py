@@ -100,8 +100,10 @@ class DispatchTimeout(RuntimeError):
 
 class EngineFailure(RuntimeError):
     """A rung of the ladder failed past recovery-in-place.  ``kind`` is
-    ``"fatal"`` / ``"retries_exhausted"`` / ``"wedged"``; ``cause`` is
-    the underlying exception."""
+    ``"fatal"`` / ``"retries_exhausted"`` / ``"wedged"`` /
+    ``"capacity"`` (a classified CapacityOverflow the capacity ladder
+    answered with a spill-enabled retry — docs/capacity.md); ``cause``
+    is the underlying exception."""
 
     def __init__(self, engine: str, kind: str, cause: BaseException):
         super().__init__(f"{engine} engine failed ({kind}): "
@@ -188,7 +190,11 @@ class FaultRule:
     """One deterministic fault: dispatches ``at .. at+count-1`` of
     ``engine`` (None = any rung) either raise ``error()`` or hang for
     ``hang_secs`` (interruptibly — the watchdog's abandon releases the
-    thread).  ``count=None`` fires forever."""
+    thread).  ``count=None`` fires forever.  ``site`` (the tag suffix,
+    e.g. ``"spill_drain"``) narrows the rule to one dispatch SITE and
+    switches the ``at``/``count`` window to that site's own dispatch
+    index — how the spill-path fault matrix targets
+    evict/refilter/reinject dispatches deterministically."""
 
     kind: str                      # "raise" | "hang"
     at: int = 0
@@ -197,6 +203,7 @@ class FaultRule:
     error: type = TransientDeviceError
     message: str = "injected fault"
     hang_secs: float = 3600.0
+    site: Optional[str] = None
 
 
 class FaultPlan:
@@ -213,10 +220,11 @@ class FaultPlan:
 
     def raise_at(self, at: int, error: type = TransientDeviceError,
                  engine: Optional[str] = None, count: Optional[int] = 1,
-                 message: str = "injected fault") -> "FaultPlan":
+                 message: str = "injected fault",
+                 site: Optional[str] = None) -> "FaultPlan":
         self.rules.append(FaultRule("raise", at=at, count=count,
                                     engine=engine, error=error,
-                                    message=message))
+                                    message=message, site=site))
         return self
 
     def raise_always(self, error: type = TransientDeviceError,
@@ -226,19 +234,29 @@ class FaultPlan:
                              message=message)
 
     def hang_at(self, at: int, engine: Optional[str] = None,
-                secs: float = 3600.0,
-                count: Optional[int] = 1) -> "FaultPlan":
+                secs: float = 3600.0, count: Optional[int] = 1,
+                site: Optional[str] = None) -> "FaultPlan":
         self.rules.append(FaultRule("hang", at=at, count=count,
-                                    engine=engine, hang_secs=secs))
+                                    engine=engine, hang_secs=secs,
+                                    site=site))
         return self
 
-    def match(self, engine: str, index: int) -> Optional[FaultRule]:
+    def match(self, engine: str, index: int, site: Optional[str] = None,
+              site_index: Optional[int] = None) -> Optional[FaultRule]:
         for r in self.rules:
             if r.engine is not None and r.engine != engine:
                 continue
-            if index < r.at:
+            if r.site is not None:
+                # Site rules window on the SITE's own dispatch index
+                # (e.g. "the second spill_drain of the device rung").
+                if r.site != site or site_index is None:
+                    continue
+                idx = site_index
+            else:
+                idx = index
+            if idx < r.at:
                 continue
-            if r.count is not None and index >= r.at + r.count:
+            if r.count is not None and idx >= r.at + r.count:
                 continue
             self.fired += 1
             return r
@@ -262,6 +280,7 @@ class DispatchBoundary:
         self.retries = 0
         self.timeouts = 0
         self.counts: Dict[str, int] = {}
+        self.site_counts: Dict[tuple, int] = {}
         self._engine_retries: Dict[str, int] = {}
         self._rng = random.Random(self.policy.seed)
         # Optional per-dispatch observer, called as
@@ -316,10 +335,14 @@ class DispatchBoundary:
     def dispatch(self, tag: str, fn, *args):
         engine = tag.split(".", 1)[0]
         passthrough = _passthrough_types()
+        site = tag.split(".", 1)[-1]
         while True:
             idx = self.counts.get(engine, 0)
             self.counts[engine] = idx + 1
-            rule = self.plan.match(engine, idx) if self.plan else None
+            sidx = self.site_counts.get((engine, site), 0)
+            self.site_counts[(engine, site)] = sidx + 1
+            rule = (self.plan.match(engine, idx, site, sidx)
+                    if self.plan else None)
             try:
                 if self.observer is not None:
                     # Observer runs INSIDE the try: a fault it raises
@@ -512,7 +535,8 @@ class SearchSupervisor:
                  protocol_transform: Optional[str] = None,
                  warden_kwargs: Optional[dict] = None,
                  portfolio: bool = False,
-                 swarm_kwargs: Optional[dict] = None):
+                 swarm_kwargs: Optional[dict] = None,
+                 spill=False):
         for rung in ladder:
             if rung not in ("sharded", "device", "host"):
                 raise ValueError(f"unknown ladder rung {rung!r}")
@@ -559,6 +583,24 @@ class SearchSupervisor:
         # outranks a BFS SPACE/DEPTH_EXHAUSTED).
         self.portfolio = portfolio
         self.swarm_kwargs = swarm_kwargs
+        # The CAPACITY LADDER (ISSUE 6, tpu/spill.py, docs/capacity.md).
+        # ``spill=False`` (default): CapacityOverflow passes through
+        # unwrapped — the historical contract, still pinned by tests.
+        # ``spill="ladder"``: CapacityOverflow becomes a CLASSIFIED,
+        # RECOVERABLE failure — the failing rung is rebuilt with the
+        # host-RAM spill tier enabled and resumes from the checkpoint;
+        # a second overflow escalates to an 8x larger host tier before
+        # the next rung takes over.  ``spill=True`` (or a
+        # spill.SpillConfig): every rung runs spill-enabled from the
+        # start.
+        if spill not in (False, True, "ladder"):
+            from dslabs_tpu.tpu import spill as spill_mod
+
+            if not isinstance(spill, spill_mod.SpillConfig):
+                raise ValueError(
+                    "spill must be False, True, 'ladder', or a "
+                    f"spill.SpillConfig — got {spill!r}")
+        self.spill = spill
         if portfolio and process_isolation:
             raise ValueError(
                 "portfolio=True and process_isolation=True are "
@@ -570,20 +612,34 @@ class SearchSupervisor:
         # programs; limits are refreshed from the supervisor per run.
         self._engines: Dict[str, object] = {}
 
-    def _build(self, rung: str):
-        cached = self._engines.get(rung)
+    def _engine_spill(self):
+        """The spill argument engines are BUILT with (None = off):
+        False/"ladder" build plain rungs (the ladder retries with a
+        config on overflow); True/SpillConfig enable from the start."""
+        if self.spill in (False, "ladder"):
+            return None
+        return self.spill
+
+    def _build(self, rung: str, spill=None):
+        # Plain rungs keep their historical cache key (external code
+        # and tests index self._engines["sharded"]); spill-enabled
+        # variants key beside them, per host-tier size.
+        key = (rung if spill is None
+               else (rung, getattr(spill, "host_cap", True)))
+        cached = self._engines.get(key)
         if cached is not None:
             cached.max_depth = self.max_depth
             cached.max_secs = self.max_secs
             return cached
-        self._engines[rung] = s = self._build_fresh(rung)
+        self._engines[key] = s = self._build_fresh(rung, spill)
         return s
 
-    def _build_fresh(self, rung: str):
+    def _build_fresh(self, rung: str, spill=None):
         from dslabs_tpu.tpu.engine import TensorSearch
 
         ck = {"checkpoint_path": self.checkpoint_path,
-              "checkpoint_every": self.checkpoint_every}
+              "checkpoint_every": self.checkpoint_every,
+              "spill": spill}
         if rung == "sharded":
             import jax
 
@@ -632,20 +688,34 @@ class SearchSupervisor:
         body).  ``cancel`` (a threading.Event) is the portfolio lane's
         first-verdict-wins cut — installed on every rung so a cancelled
         BFS returns at its next level boundary."""
+        from dslabs_tpu.tpu.engine import CapacityOverflow
+
         self.boundary = DispatchBoundary(self.policy, self.fault_plan,
                                          observer=self.dispatch_observer)
         self.failures = []
         for i, rung in enumerate(self.ladder):
-            search = self._build(rung)
+            search = self._build(rung, self._engine_spill())
             self.boundary.install(search, engine=rung)
             if cancel is not None:
                 search._cancel_event = cancel
             do_resume = (resume or i > 0) and self._resumable(search)
+            out = None
             try:
                 out = search.run(check_initial=check_initial,
                                  initial=initial, resume=do_resume)
             except EngineFailure as e:
                 self.failures.append(e)
+            except CapacityOverflow as e:
+                if self.spill != "ladder":
+                    # The historical contract: semantic/capacity errors
+                    # pass through unwrapped unless the caller opted
+                    # into the capacity ladder.
+                    raise
+                self.failures.append(EngineFailure(rung, "capacity", e))
+                out = self._capacity_retry(rung, initial, check_initial,
+                                           cancel)
+                search = self._last_capacity_search or search
+            if out is None:
                 continue
             out.engine = rung
             out.retries = self.boundary.retries
@@ -655,6 +725,41 @@ class SearchSupervisor:
             out.abandoned_threads = self.boundary.abandoned_alive()
             return out
         raise SupervisorExhausted(self.failures)
+
+    def _capacity_retry(self, rung, initial, check_initial, cancel):
+        """The capacity ladder's recovery arm (docs/capacity.md): the
+        overflowed rung is rebuilt WITH the host-RAM spill tier and
+        resumes from the checkpoint (that is the point of the ladder —
+        smaller rungs have less capacity, the tier has host RAM); a
+        second overflow escalates to an 8x host tier.  Failures land on
+        ``self.failures`` with kind ``"capacity"`` so the recovery
+        story stays attributable; returns the outcome or None (fall
+        through to the next rung)."""
+        import dataclasses as _dc
+
+        from dslabs_tpu.tpu import spill as spill_mod
+        from dslabs_tpu.tpu.engine import CapacityOverflow
+
+        self._last_capacity_search = None
+        base = (self.spill if isinstance(
+            self.spill, spill_mod.SpillConfig) else
+            spill_mod.SpillConfig())
+        for cfg in (base, _dc.replace(base, host_cap=base.host_cap * 8)):
+            search = self._build(rung, cfg)
+            self.boundary.install(search, engine=rung)
+            if cancel is not None:
+                search._cancel_event = cancel
+            self._last_capacity_search = search
+            try:
+                return search.run(check_initial=check_initial,
+                                  initial=initial,
+                                  resume=self._resumable(search))
+            except CapacityOverflow as e:
+                self.failures.append(EngineFailure(rung, "capacity", e))
+            except EngineFailure as e:
+                self.failures.append(e)
+                return None
+        return None
 
     # ------------------------------------------------------ portfolio
 

@@ -921,6 +921,23 @@ class SwarmSearch(TensorSearch):
             stats = np.asarray(stats)
             vis_over = int(stats[5])
             over = int(stats[4])
+            # Early-warning instrumentation (ISSUE 6 satellite): the
+            # swarm shares the BFS visited table, so operators must
+            # see fill pressure BEFORE the overflow contract fires
+            # (strict raise / treat-as-fresh revisit inflation).
+            from dslabs_tpu.tpu.spill import visited_warn_threshold
+
+            fill = int(stats[1]) / (self.n_devices * self.visited_cap)
+            if (fill >= visited_warn_threshold()
+                    and not getattr(self, "_warned_visited", False)):
+                self._warned_visited = True
+                warnings.warn(
+                    f"{self.p.name}: swarm visited table ~{fill:.0%} "
+                    f"full ({int(stats[1])} fresh inserts vs "
+                    f"{self.n_devices}x{self.visited_cap} slots) at "
+                    f"round {rounds} — capacity pressure; raise "
+                    "visited_cap before overflow degrades dedup",
+                    RuntimeWarning, stacklevel=2)
             # Terminal flags BEFORE the strict capacity guards: a
             # violation found this round is a valid verdict even if
             # the table filled alongside it (the _sync_checks order).

@@ -37,8 +37,8 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["BKT", "MAXU32", "empty_table", "sanitize_keys",
-           "host_sanitize_key", "host_home_slot", "insert",
-           "build_table"]
+           "host_sanitize_key", "host_home_slot", "host_occupied",
+           "insert", "build_table"]
 
 # Slots per bucket: the probe loop reads whole buckets (one aligned
 # 128-byte line of 8 x 16-byte keys).
@@ -82,6 +82,16 @@ def host_home_slot(key: np.ndarray, cap: int) -> int:
     owner-routing-biased in the sharded engine, see sharded.py)."""
     check_cap(cap)
     return (int(key[2]) & (cap // BKT - 1)) * BKT
+
+
+def host_occupied(table: np.ndarray) -> np.ndarray:
+    """Occupied key lines of a HOST copy of a ``[V + 1, 4]`` table (the
+    trailing scatter-dump row excluded) — the bulk-eviction readback of
+    the spill tier (tpu/spill.py) and the checkpoint writers share this
+    one definition of "occupied" (any lane != EMPTY's all-MAX)."""
+    table = np.asarray(table)[:-1]
+    occ = ~(table == MAXU32).all(axis=1)
+    return table[occ]
 
 
 def build_table(cap: int, keys) -> Tuple[jnp.ndarray, int, int]:
