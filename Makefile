@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast perf-smoke fault-smoke swarm-smoke capacity-smoke lab0 lab1 lab2 lab3 lab4 bench dryrun handout clean
+.PHONY: test test-fast perf-smoke fault-smoke swarm-smoke capacity-smoke obs-smoke lab0 lab1 lab2 lab3 lab4 bench dryrun handout clean
 
 test:            ## full acceptance + parity suite
 	$(PY) -m pytest tests/ -q
@@ -57,6 +57,16 @@ swarm-smoke:     ## swarm explorer suite incl. slow deep-narrow scenarios, on CP
 # `python bench.py --spill` if you want the number itself.
 capacity-smoke:  ## host-RAM spill tier + capacity-ladder suite on CPU
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m capacity -p no:cacheprovider
+
+# obs-smoke = the unified telemetry suite (tests/test_telemetry.py):
+# span-count == dispatch-count on both engines, the zero-added-
+# dispatches/transfers overhead guard, SIGKILL flight-log survival
+# with the in-flight dispatch named, the report-CLI golden sections,
+# supervisor retry/failover event plumbing, and the bench-JSON schema
+# pin for the `telemetry` block + error-with-spans shape (the slow
+# bench run tier-1 skips).  docs/observability.md is the field guide.
+obs-smoke:       ## unified telemetry suite (flight recorder / metrics / reports) on CPU
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m obs -p no:cacheprovider
 
 dryrun:          ## multi-chip sharding dry run on a virtual CPU mesh
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

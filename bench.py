@@ -107,6 +107,62 @@ BEAM_LADDER = [
 
 _T0 = time.time()
 
+# Run directory for per-phase telemetry flight logs (tpu/telemetry.py):
+# the parent hands each phase child its own flight-recorder path via
+# DSLABS_BENCH_FLIGHT, so a SIGKILLed/wedged child still leaves its
+# last dispatches on disk and the error JSON can name the in-flight
+# dispatch instead of one scraped stderr line (the BENCH_r05 mystery).
+_RUNDIR = os.environ.get("DSLABS_BENCH_RUNDIR", "/tmp/dslabs_bench")
+
+# Structured wedge diagnostics collected by _sub on phase failure;
+# attached to the last-line JSON as "wedge_diagnostics" by _emit.
+_DIAGNOSTICS = []
+
+
+def _phase_telemetry(label: str):
+    """The phase child's flight recorder.  The parent's path (env)
+    wins; standalone phase invocations land in the run dir."""
+    from dslabs_tpu.tpu.telemetry import Telemetry
+
+    path = os.environ.get("DSLABS_BENCH_FLIGHT")
+    if not path:
+        os.makedirs(_RUNDIR, exist_ok=True)
+        path = os.path.join(_RUNDIR, f"{label}.flight.jsonl")
+    try:
+        os.remove(path)     # stale spans must not pollute this run
+    except OSError:
+        pass
+    return Telemetry(flight_log=path, engine_hint=label)
+
+
+def _note_wedge(label: str, message: str, watch, flight) -> None:
+    """ISSUE-7 satellite (the BENCH_r05 fix): a dead phase's error
+    JSON carries the child's last heartbeat AND its last
+    flight-recorder spans — the in-flight dispatch included — never
+    just the final scraped stderr line."""
+    from dslabs_tpu.tpu import telemetry as tel_mod
+
+    tail = list(watch.tail) if watch is not None else []
+    _DIAGNOSTICS.append({
+        "phase": label,
+        "message": message,
+        "last_heartbeat": tail[-1] if tail else None,
+        "stderr_tail": tail[-3:],
+        "last_spans": tel_mod.tail_records(flight, 6),
+    })
+
+
+def _note_phase_telemetry(result: dict, label: str, phase) -> None:
+    """Collect a phase's telemetry summary under the top-level
+    ``telemetry`` block (pinned by the bench-JSON schema test)."""
+    t = (phase or {}).get("telemetry") if isinstance(phase, dict) \
+        else None
+    if not t:
+        return
+    result.setdefault(
+        "telemetry", {"run_dir": _RUNDIR, "phases": {}})[
+        "phases"][label] = t
+
 
 def _remaining() -> float:
     return DEADLINE_SECS - (time.time() - _T0)
@@ -166,23 +222,31 @@ def _preflight() -> dict:
     wedge that lets heartbeats through still surfaces as a classified,
     attributable ``DispatchTimeout`` inside this bounded subprocess
     instead of a bare hang in a 400 s search phase."""
+    tel = _phase_telemetry("preflight")
     wedge = os.environ.get("DSLABS_BENCH_FAKE_WEDGE")
     if wedge == "hang":
         # Test knob, hang shape: the child goes SILENT (the true
         # BENCH_r05 wedge) — only the parent's silence kill ends it.
+        # The hang happens INSIDE a telemetry span, so the flight log's
+        # torn tail names the in-flight dispatch (the satellite fix).
         _hb("preflight: simulated wedge (hanging)")
-        time.sleep(100000.0)
+        with tel.span("preflight.hang"):
+            time.sleep(100000.0)
     if wedge:
         # Test knob, fast shape: the wedge raises immediately so the
         # cpu-fallback path is exercisable cheaply in CI.
         raise RuntimeError("fake TPU wedge (DSLABS_BENCH_FAKE_WEDGE)")
     _hb("preflight: boot (import + compile cache)")
-    _persistent_cache()
+    with tel.span("preflight.boot"):
+        _persistent_cache()
     from dslabs_tpu.tpu.supervisor import probe_device
 
     _hb("preflight: probe matmul")
-    return probe_device(deadline_secs=float(os.environ.get(
-        "DSLABS_PREFLIGHT_DEADLINE_SECS", "60.0")))
+    with tel.span("preflight.matmul"):
+        res = probe_device(deadline_secs=float(os.environ.get(
+            "DSLABS_PREFLIGHT_DEADLINE_SECS", "60.0")))
+    res["telemetry"] = tel.summary()
+    return res
 
 
 def _calibrate(max_depth: int = 7) -> dict:
@@ -246,6 +310,7 @@ def _run_rung(chunk_per_device: int, frontier_cap: int, visited_cap: int,
 
     from dslabs_tpu.tpu.sharded import ShardedTensorSearch, make_mesh
 
+    tel = _phase_telemetry("rung")
     mesh = make_mesh(len(jax.devices()))
     # Warm-up depth 2, not 1: the final depth-limited level skips the
     # frontier promotion (count-only), so a depth-1 run would leave
@@ -257,7 +322,8 @@ def _run_rung(chunk_per_device: int, frontier_cap: int, visited_cap: int,
     search = ShardedTensorSearch(
         _bench_protocol(), mesh, chunk_per_device=chunk_per_device,
         frontier_cap=frontier_cap, visited_cap=visited_cap, max_depth=2,
-        strict=False, ev_budget=ev_budget, aot_warmup=True)
+        strict=False, ev_budget=ev_budget, aot_warmup=True,
+        telemetry=tel)
     search.run()  # warm-up: residual compiles + runtime plumbing
     compile_secs = time.time() - t_c
     search.max_depth = 64
@@ -282,6 +348,7 @@ def _run_rung(chunk_per_device: int, frontier_cap: int, visited_cap: int,
         "retries": outcome.retries,
         "failovers": outcome.failovers,
         "resumed_from_depth": outcome.resumed_from_depth,
+        "telemetry": tel.summary(),
     }
 
 
@@ -311,6 +378,7 @@ def _run_strict(ev_budget, budget_secs: float) -> dict:
     from dslabs_tpu.tpu.supervisor import RetryPolicy, SearchSupervisor
 
     t_phase = time.time()
+    tel = _phase_telemetry("strict")
     mesh = make_mesh(len(jax.devices()))
     ckpt = {}
     if os.environ.get("DSLABS_BENCH_CKPT"):
@@ -327,7 +395,8 @@ def _run_strict(ev_budget, budget_secs: float) -> dict:
         _bench_protocol(), ladder=("sharded",), mesh=mesh, chunk=8192,
         frontier_cap=(1 << 20) + (1 << 18), visited_cap=1 << 24,
         max_depth=2, strict=True, ev_budget=ev_budget,
-        policy=RetryPolicy(max_retries=3), aot_warmup=True, **ckpt)
+        policy=RetryPolicy(max_retries=3), aot_warmup=True,
+        telemetry=tel, **ckpt)
     t_c = time.time()
     sup.run()  # warm-up: AOT at engine build + residual compiles
     compile_secs = time.time() - t_c
@@ -352,6 +421,7 @@ def _run_strict(ev_budget, budget_secs: float) -> dict:
         "failovers": outcome.failovers,
         "resumed_from_depth": outcome.resumed_from_depth,
         "abandoned_threads": outcome.abandoned_threads,
+        "telemetry": tel.summary(),
     }
 
 
@@ -379,6 +449,7 @@ def _cpu_fallback(budget_secs: float) -> dict:
         make_clientserver_protocol
 
     t_phase = time.time()
+    tel = _phase_telemetry("cpu-fallback")
     proto = dataclasses.replace(
         make_clientserver_protocol(n_clients=3, w=4, net_cap=32),
         goals={})
@@ -386,7 +457,7 @@ def _cpu_fallback(budget_secs: float) -> dict:
 
     def run_one(use_host: bool) -> dict:
         search = TensorSearch(proto, chunk=2048, frontier_cap=1 << 17,
-                              max_depth=2)
+                              max_depth=2, telemetry=tel)
         runner = search.run_host if use_host else search.run
         t_c = time.time()
         runner()            # warm-up: compile outside the measured window
@@ -415,6 +486,7 @@ def _cpu_fallback(budget_secs: float) -> dict:
         "speedup_vs_legacy": round(
             device["value"] / max(legacy["value"], 1e-9), 2),
         "total_secs": round(time.time() - t_phase, 1),
+        "telemetry": tel.summary(),
     }
 
 
@@ -434,6 +506,7 @@ def _run_swarm(budget_secs: float) -> dict:
     from dslabs_tpu.tpu.swarm import SwarmSearch
 
     t_phase = time.time()
+    tel = _phase_telemetry("swarm")
     mesh = make_mesh(len(jax.devices()))
     sw = SwarmSearch(
         _bench_protocol(), mesh=mesh,
@@ -442,6 +515,7 @@ def _run_swarm(budget_secs: float) -> dict:
         max_steps=int(os.environ.get("DSLABS_SWARM_STEPS", "128")),
         steps_per_round=64, seed=0, visited_cap=1 << 22)
     _hb("swarm: fleet built, compiling round program")
+    tel.attach(sw)
     sw.max_secs = max(20.0, budget_secs - (time.time() - t_phase) - 10)
     outcome = sw.run()
     sd = outcome.swarm or {}
@@ -459,6 +533,7 @@ def _run_swarm(budget_secs: float) -> dict:
         "vis_over": outcome.visited_overflow,
         "elapsed": round(outcome.elapsed_secs, 2),
         "compile_secs": outcome.compile_secs,
+        "telemetry": tel.summary(),
     }
 
 
@@ -482,6 +557,7 @@ def _run_spill(budget_secs: float) -> dict:
         make_clientserver_protocol
 
     t_phase = time.time()
+    tel = _phase_telemetry("spill")
     proto = dataclasses.replace(
         make_clientserver_protocol(n_clients=3, w=4), goals={})
     depth = int(os.environ.get("DSLABS_SPILL_DEPTH", "11"))
@@ -489,7 +565,7 @@ def _run_spill(budget_secs: float) -> dict:
     def run_one(visited_cap, spill, chunk):
         search = TensorSearch(proto, chunk=chunk, frontier_cap=1 << 15,
                               max_depth=2, visited_cap=visited_cap,
-                              spill=spill)
+                              spill=spill, telemetry=tel)
         t_c = time.time()
         search.run()          # warm-up: compile outside the window
         compile_secs = time.time() - t_c
@@ -525,6 +601,7 @@ def _run_spill(budget_secs: float) -> dict:
         "dropped_states": sp.dropped_states,
         "compile_secs": round(cs_u + cs_s, 1),
         "total_secs": round(time.time() - t_phase, 1),
+        "telemetry": tel.summary(),
     }
 
 
@@ -568,7 +645,10 @@ def _sub(args, child_budget: float, label: str,
         sys.stderr.flush()
 
     try:
-        env = dict(os.environ, DSLABS_LEVEL_TIMING="1")
+        os.makedirs(_RUNDIR, exist_ok=True)
+        flight = os.path.join(_RUNDIR, f"{label}.flight.jsonl")
+        env = dict(os.environ, DSLABS_LEVEL_TIMING="1",
+                   DSLABS_BENCH_FLIGHT=flight)
         proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__)] + args,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
@@ -581,12 +661,14 @@ def _sub(args, child_budget: float, label: str,
                    f"(killed at +{time.time() - t0:.0f}s; last stderr: "
                    f"{' | '.join(watch.tail[-2:])})")
             _hb(f"phase {label}: WEDGED ({err})")
+            _note_wedge(label, err, watch, flight)
             return None, err
         if status == "total":
             err = (f"{label} killed at {timeout:.0f}s "
                    "(accelerator hang or compile overrun; last stderr: "
                    f"{' | '.join(watch.tail[-2:])})")
             _hb(f"phase {label}: TIMEOUT ({err})")
+            _note_wedge(label, err, watch, flight)
             return None, err
         # The child's stdout is one small JSON line printed at exit, so
         # reading it after wait() cannot deadlock on a full pipe.
@@ -599,10 +681,12 @@ def _sub(args, child_budget: float, label: str,
         if watch.tail:
             err += f" last-stderr={watch.tail[-1]}"
         _hb(f"phase {label}: FAILED ({err})")
+        _note_wedge(label, err, watch, flight)
         return None, err
     except Exception:
         err = traceback.format_exc(limit=2).strip().splitlines()[-1][:300]
         _hb(f"phase {label}: ERROR ({err})")
+        _note_wedge(label, err, None, None)
         return None, err
     finally:
         _CURRENT_CHILD = None
@@ -637,6 +721,10 @@ def _emit(result: dict) -> None:
     if _EMITTED:
         return
     _EMITTED = True
+    if _DIAGNOSTICS and "wedge_diagnostics" not in result:
+        # Every dead phase's last heartbeat + flight-recorder spans
+        # ride the error JSON (ISSUE-7 satellite; schema-pinned).
+        result["wedge_diagnostics"] = _DIAGNOSTICS
     print(json.dumps(result))
     sys.stdout.flush()
 
@@ -727,6 +815,7 @@ def main() -> None:
         if fb is not None:
             result["backend"] = fb.get("backend", "cpu-fallback")
             result["cpu_fallback"] = fb
+            _note_phase_telemetry(result, "cpu-fallback", fb)
             result["metric"] = (
                 "lab1-clientserver strict BFS unique states/min "
                 "(device-resident single-chip loop, cpu-fallback)")
@@ -743,6 +832,7 @@ def main() -> None:
     result["metric"] = (f"lab3-paxos strict BFS unique states/min "
                         f"(sharded tensor backend, {platform} x{n_dev})")
     result["preflight_secs"] = pf["secs"]
+    _note_phase_telemetry(result, "preflight", pf)
 
     if on_cpu:
         # CI / smoke shape: one small beam rung, no calibration.
@@ -754,6 +844,7 @@ def main() -> None:
         if beam:
             _set_headline(result, beam, "BFS (beam)", platform, n_dev)
             result["beam"] = beam
+            _note_phase_telemetry(result, "beam", beam)
         else:
             result["error"] = beam_err
         if _remaining() > 75:
@@ -763,6 +854,7 @@ def main() -> None:
                 silence=PHASE_SILENCE_SECS)
             if swarm is not None:
                 result["swarm"] = swarm
+                _note_phase_telemetry(result, "swarm", swarm)
         if _remaining() > 75:
             spill_res, _spill_err = _sub(
                 ["--spill", str(min(90.0, _remaining() - 15))],
@@ -770,6 +862,7 @@ def main() -> None:
                 silence=PHASE_SILENCE_SECS)
             if spill_res is not None:
                 result["spill"] = spill_res
+                _note_phase_telemetry(result, "spill", spill_res)
         _emit(result)
         return
 
@@ -809,6 +902,7 @@ def main() -> None:
             budget, "strict", silence=PHASE_SILENCE_SECS)
         if strict is not None:
             result["strict"] = strict
+            _note_phase_telemetry(result, "strict", strict)
             _set_headline(result, strict, "strict BFS", platform, n_dev)
         else:
             result["strict_error"] = strict_err
@@ -832,6 +926,7 @@ def main() -> None:
             break
     if beam is not None:
         result["beam"] = beam
+        _note_phase_telemetry(result, "beam", beam)
         if strict is None:
             _set_headline(result, beam, "BFS (beam)", platform, n_dev)
     elif strict is None:
@@ -848,6 +943,7 @@ def main() -> None:
                                 "swarm", silence=PHASE_SILENCE_SECS)
         if swarm is not None:
             result["swarm"] = swarm
+            _note_phase_telemetry(result, "swarm", swarm)
         else:
             result["swarm_error"] = swarm_err
     else:
@@ -863,6 +959,7 @@ def main() -> None:
                                     "spill", silence=PHASE_SILENCE_SECS)
         if spill_res is not None:
             result["spill"] = spill_res
+            _note_phase_telemetry(result, "spill", spill_res)
         else:
             result["spill_error"] = spill_err
     else:

@@ -75,7 +75,8 @@ import numpy as np
 __all__ = ["FORMAT_VERSION", "CheckpointMismatch", "CheckpointCorrupt",
            "SearchCheckpoint", "config_fingerprint", "save", "load",
            "peek_fingerprint", "peek_depth", "AsyncCheckpointWriter",
-           "default_compile_cache_dir"]
+           "default_compile_cache_dir", "default_flight_log",
+           "run_dir_layout"]
 
 
 def default_compile_cache_dir(checkpoint_path) -> "Optional[str]":
@@ -90,6 +91,36 @@ def default_compile_cache_dir(checkpoint_path) -> "Optional[str]":
     return os.path.join(
         os.path.dirname(os.path.abspath(checkpoint_path)),
         "compile_cache")
+
+
+def default_flight_log(checkpoint_path) -> "Optional[str]":
+    """The run-dir convention for the telemetry flight recorder
+    (tpu/telemetry.py): a ``flight.jsonl`` beside the dump, so a
+    killed/wedged run leaves its last-N-dispatches trail next to the
+    state it would have resumed from.  ``None`` when no checkpoint is
+    configured."""
+    if not checkpoint_path:
+        return None
+    return os.path.join(
+        os.path.dirname(os.path.abspath(checkpoint_path)),
+        "flight.jsonl")
+
+
+def run_dir_layout(checkpoint_path) -> dict:
+    """Everything a checkpointed run keeps in its directory — the one
+    place the layout is defined (docs/observability.md):
+
+      checkpoint        the atomic .npz dump (+ ``.prev`` rotation)
+      compile_cache     persistent XLA compile cache (tpu/compile_cache)
+      flight_log        telemetry flight recorder (tpu/telemetry.py)
+    """
+    return {
+        "checkpoint": checkpoint_path,
+        "prev": (checkpoint_path + ".prev") if checkpoint_path else None,
+        "compile_cache": default_compile_cache_dir(checkpoint_path),
+        "flight_log": default_flight_log(checkpoint_path),
+    }
+
 
 FORMAT_VERSION = "dslabs-search-ckpt-v7"
 

@@ -725,8 +725,16 @@ class TensorSearch:
                  use_host_visited: bool = False,
                  checkpoint_path: Optional[str] = None,
                  checkpoint_every: int = 0,
-                 spill=None):
+                 spill=None,
+                 telemetry=None):
         self.p = protocol
+        # Unified telemetry (tpu/telemetry.py): when attached — here or
+        # via ``Telemetry.attach(search)`` — every ``_dispatch`` call
+        # becomes a flight-recorder span and the per-level fused-stats
+        # scalars feed the metrics registry.  Strictly host-side: zero
+        # extra device dispatches or transfers (the overhead-guard
+        # test pins this).
+        self._telemetry = telemetry
         # Host-RAM spill tier (tpu/spill.py, docs/capacity.md): when
         # enabled, a full visited table EVICTS to a host fingerprint
         # set (and would-be frontier drops take a host spool detour)
@@ -848,8 +856,14 @@ class TensorSearch:
         call; the search supervisor (tpu/supervisor.py) installs its
         retry/watchdog/fault-injection boundary as ``_dispatch_hook``.
         Tags are ``"<engine>.<site>"`` — the engine half keys the
-        supervisor's fault plan and per-rung counters."""
+        supervisor's fault plan and per-rung counters.  An attached
+        telemetry recorder (tpu/telemetry.py) wraps the WHOLE chain —
+        hook included — so every dispatch becomes one structured span
+        with zero extra device work."""
         hook = getattr(self, "_dispatch_hook", None)
+        tel = getattr(self, "_telemetry", None)
+        if tel is not None:
+            return tel.record_dispatch(self, tag, hook, fn, *args)
         if hook is None:
             return fn(*args)
         return hook(tag, fn, *args)
@@ -1428,6 +1442,9 @@ class TensorSearch:
         hook = getattr(self, "_dispatch_hook", None)
         if hook is not None:
             sw._dispatch_hook = hook
+        tel = getattr(self, "_telemetry", None)
+        if tel is not None:
+            sw._telemetry = tel
         out = sw.run(initial=initial, check_initial=False)
         # Expose the walk root for tpu/trace.py replay on THIS engine
         # too (decode_trace reads search._trace_root off whichever
@@ -1454,9 +1471,21 @@ class TensorSearch:
         ``use_host_visited`` demand the legacy host-dedup loop
         (:meth:`run_host`, the parity oracle — trace mode spills
         per-level event tables to the host by design)."""
+        tel = getattr(self, "_telemetry", None)
+        if tel is not None and self._spill is not None:
+            # Spill evict/reinject operations surface as telemetry
+            # events (tpu/spill.py) — host bookkeeping only.
+            self._spill.telemetry = tel
         if self.record_trace or self.use_host_visited:
-            return self.run_host(check_initial, initial, resume=resume)
-        return self._run_device(check_initial, initial, resume=resume)
+            out = self.run_host(check_initial, initial, resume=resume)
+            eng = "host"
+        else:
+            out = self._run_device(check_initial, initial,
+                                   resume=resume)
+            eng = "device"
+        if tel is not None:
+            tel.on_outcome(out, engine=eng)
+        return out
 
     def run_host(self, check_initial: bool = True,
                  initial: Optional[dict] = None,
@@ -1531,6 +1560,7 @@ class TensorSearch:
             # Live depth for supervision heartbeats (the dispatch
             # observer reads it — tpu/supervisor.py, tpu/warden.py).
             self._current_depth = depth
+            t_lvl = time.time()
             if self.record_trace:
                 self._levels.append({"parent_rows": parent_rows,
                                      "event_ids": []})
@@ -1632,6 +1662,14 @@ class TensorSearch:
                                      time.time() - t0)
 
             keep_idx = np.nonzero(expand)[0]
+            tel = getattr(self, "_telemetry", None)
+            if tel is not None:
+                tel.on_level("host", {
+                    "depth": depth,
+                    "wall": round(time.time() - t_lvl, 4),
+                    "explored": explored,
+                    "unique": int(len(visited[0])),
+                    "next_frontier": int(len(keep_idx))})
             # lvl_states rows align 1:1 with h1/h2/rows concatenation.
             all_rows = (np.concatenate(lvl_states, axis=0)
                         if len(lvl_states) > 1 else lvl_states[0])
@@ -2056,6 +2094,7 @@ class TensorSearch:
             depth += 1
             # Live depth for supervision heartbeats (tpu/warden.py).
             self._current_depth = depth
+            t_wave = time.time()
             # A checkpoint-due wave skips the speculative next-wave
             # dispatch: the snapshot must see the carry at a clean wave
             # boundary, not mid-way through wave depth+1.
@@ -2133,6 +2172,15 @@ class TensorSearch:
                     f"({vis_n}/{self.visited_cap}) at depth {depth}; "
                     "raise visited_cap")
             last = (explored, vis_n, vis_over)
+            tel = getattr(self, "_telemetry", None)
+            if tel is not None:
+                # Fed from the wave's fused stats vector — scalars this
+                # loop just read anyway (zero extra transfers).
+                tel.on_level("device", {
+                    "depth": depth,
+                    "wall": round(time.time() - t_wave, 4),
+                    "explored": explored, "unique": vis_n,
+                    "next_frontier": int(nxt_n)})
             self._last_dev_carry = carry
             if flag_counts.any():
                 return self._dev_terminal(carry, flag_counts, explored,

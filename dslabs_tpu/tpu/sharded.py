@@ -131,7 +131,8 @@ class ShardedTensorSearch(TensorSearch):
                  checkpoint_every: int = 0,
                  superstep: Optional[bool] = None,
                  aot_warmup: Optional[bool] = None,
-                 spill=None):
+                 spill=None,
+                 telemetry=None):
         # Frontier checkpointing (SURVEY §5 "dump SoA tensors"): every
         # ``checkpoint_every`` levels the live carry — the OCCUPIED
         # frontier prefix, the occupied visited-table lines, and the
@@ -193,7 +194,7 @@ class ShardedTensorSearch(TensorSearch):
                          visited_cap=visited_cap, strict=strict,
                          checkpoint_path=checkpoint_path,
                          checkpoint_every=checkpoint_every,
-                         spill=spill)
+                         spill=spill, telemetry=telemetry)
         # Host-RAM spill tier (tpu/spill.py, docs/capacity.md): the
         # carry gains an ``f_full`` abort-code lane, the chunk step
         # aborts-and-reverts GLOBALLY (a psum'd decision — owner-side
@@ -1377,12 +1378,17 @@ class ShardedTensorSearch(TensorSearch):
             if out is not None:
                 return out
 
+        tel = getattr(self, "_telemetry", None)
+        if tel is not None and self._spill is not None:
+            self._spill.telemetry = tel
         try:
             out = self._run_levels(t0, state, resume)
             out.levels = self._level_records or None
             out.compile_secs = round(getattr(self, "compile_secs", 0.0), 3)
             if self._spill_on:
                 self._spill.attach(out)
+            if tel is not None:
+                tel.on_outcome(out, engine="sharded")
             if out.dropped and out.dropped >= _DROPPED_WARN():
                 # The BENCH_r03 shape (5.8M beam drops, one flag to
                 # show for it) must be LOUD — dropped_states is also a
@@ -1493,6 +1499,11 @@ class ShardedTensorSearch(TensorSearch):
                     # before the overflow contract can fire.
                     "load_factor": round(
                         getattr(self, "_last_load", 0.0), 4)})
+                tel = getattr(self, "_telemetry", None)
+                if tel is not None:
+                    # The SAME host scalars the fused stats readback
+                    # already delivered — telemetry adds no transfers.
+                    tel.on_level("sharded", self._level_records[-1])
                 if _LEVEL_TIMING:
                     import sys as _sys
                     r = self._level_records[-1]

@@ -270,6 +270,11 @@ class SpillManager:
         self.spool_cur = FrontierSpool()    # level being consumed
         self.spool_next = FrontierSpool()   # level being assembled
         self.stats = SpillStats()
+        # Optional telemetry recorder (tpu/telemetry.py), set by the
+        # owning engine at run start: evictions and reinjections become
+        # flight-recorder events (host bookkeeping only — the device
+        # round-trips themselves are already spans via _dispatch).
+        self.telemetry = None
         # Device-table inserts THIS EPOCH that duplicate a tier key
         # (refilter hits); reset at each eviction — see the module
         # docstring's unique formula.
@@ -302,6 +307,9 @@ class SpillManager:
         self.stats.spilled_keys += n_new
         self.stats.evictions += 1
         self.dup_epoch = 0
+        if self.telemetry is not None:
+            self.telemetry.event("spill_evict", keys=n_new,
+                                 tier=len(self.tier))
         return n_new
 
     def refilter(self, rows: np.ndarray,
@@ -330,6 +338,8 @@ class SpillManager:
         seg = self.spool_cur.pop()
         if seg is not None:
             self.stats.reinjections += 1
+            if self.telemetry is not None:
+                self.telemetry.event("spill_reinject", rows=len(seg))
         return seg
 
     def advance_level(self) -> None:

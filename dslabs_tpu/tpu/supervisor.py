@@ -274,9 +274,13 @@ class DispatchBoundary:
 
     def __init__(self, policy: Optional[RetryPolicy] = None,
                  plan: Optional[FaultPlan] = None,
-                 observer=None):
+                 observer=None, telemetry=None):
         self.policy = policy or RetryPolicy()
         self.plan = plan
+        # Optional telemetry recorder (tpu/telemetry.py): retry and
+        # wedge decisions become flight-recorder events, and spans read
+        # ``retries`` off this boundary via ``search._dispatch_boundary``.
+        self.telemetry = telemetry
         self.retries = 0
         self.timeouts = 0
         self.counts: Dict[str, int] = {}
@@ -318,6 +322,9 @@ class DispatchBoundary:
         # publishes ``_current_depth`` as levels complete.
         self._depth_src = (
             lambda: int(getattr(search, "_current_depth", 0)))
+        # Telemetry spans read the retry counter off this attribute to
+        # report retries-per-dispatch without new plumbing.
+        search._dispatch_boundary = self
         if engine is None:
             search._dispatch_hook = self.dispatch
         else:
@@ -369,6 +376,9 @@ class DispatchBoundary:
                 # The abandoned dispatch may have consumed its donated
                 # buffers; there is nothing sound to retry in place.
                 self.timeouts += 1
+                if self.telemetry is not None:
+                    self.telemetry.event("wedged", engine=engine,
+                                         site=site, index=idx)
                 raise EngineFailure(engine, "wedged", e)
             except Exception as e:  # noqa: BLE001 — classified below
                 if classify_failure(e) != "transient":
@@ -378,6 +388,11 @@ class DispatchBoundary:
                     raise EngineFailure(engine, "retries_exhausted", e)
                 self._engine_retries[engine] = used + 1
                 self.retries += 1
+                if self.telemetry is not None:
+                    self.telemetry.event("retry", engine=engine,
+                                         site=site, index=idx,
+                                         attempt=used + 1,
+                                         error=type(e).__name__)
                 time.sleep(self._backoff(used))
 
     def _backoff(self, attempt: int) -> float:
@@ -536,7 +551,8 @@ class SearchSupervisor:
                  warden_kwargs: Optional[dict] = None,
                  portfolio: bool = False,
                  swarm_kwargs: Optional[dict] = None,
-                 spill=False):
+                 spill=False,
+                 telemetry=None):
         for rung in ladder:
             if rung not in ("sharded", "device", "host"):
                 raise ValueError(f"unknown ladder rung {rung!r}")
@@ -605,6 +621,10 @@ class SearchSupervisor:
             raise ValueError(
                 "portfolio=True and process_isolation=True are "
                 "mutually exclusive (the swarm lane runs in-process)")
+        # Unified telemetry (tpu/telemetry.py): attached to every rung
+        # it builds, so dispatch spans, rung/failover events, and the
+        # final outcome all land in one flight log.
+        self.telemetry = telemetry
         self.boundary: Optional[DispatchBoundary] = None
         self.failures: List[EngineFailure] = []
         # Engines are cached per rung so repeated run() calls (e.g. the
@@ -691,20 +711,30 @@ class SearchSupervisor:
         from dslabs_tpu.tpu.engine import CapacityOverflow
 
         self.boundary = DispatchBoundary(self.policy, self.fault_plan,
-                                         observer=self.dispatch_observer)
+                                         observer=self.dispatch_observer,
+                                         telemetry=self.telemetry)
         self.failures = []
         for i, rung in enumerate(self.ladder):
             search = self._build(rung, self._engine_spill())
             self.boundary.install(search, engine=rung)
+            if self.telemetry is not None:
+                search._telemetry = self.telemetry
             if cancel is not None:
                 search._cancel_event = cancel
             do_resume = (resume or i > 0) and self._resumable(search)
+            if self.telemetry is not None:
+                self.telemetry.event("rung", engine=rung, index=i,
+                                     resume=bool(do_resume))
             out = None
             try:
                 out = search.run(check_initial=check_initial,
                                  initial=initial, resume=do_resume)
             except EngineFailure as e:
                 self.failures.append(e)
+                if self.telemetry is not None:
+                    self.telemetry.event("failover", engine=rung,
+                                         kind=e.kind,
+                                         error=str(e.cause)[:200])
             except CapacityOverflow as e:
                 if self.spill != "ladder":
                     # The historical contract: semantic/capacity errors
@@ -747,6 +777,10 @@ class SearchSupervisor:
         for cfg in (base, _dc.replace(base, host_cap=base.host_cap * 8)):
             search = self._build(rung, cfg)
             self.boundary.install(search, engine=rung)
+            if self.telemetry is not None:
+                search._telemetry = self.telemetry
+                self.telemetry.event("capacity_retry", engine=rung,
+                                     host_cap=cfg.host_cap)
             if cancel is not None:
                 search._cancel_event = cancel
             self._last_capacity_search = search
@@ -819,8 +853,11 @@ class SearchSupervisor:
             try:
                 sw = self._build_swarm()
                 boundary = DispatchBoundary(self.policy,
-                                            self.fault_plan)
+                                            self.fault_plan,
+                                            telemetry=self.telemetry)
                 boundary.install(sw, engine="swarm")
+                if self.telemetry is not None:
+                    sw._telemetry = self.telemetry
                 sw._cancel_event = cancel
                 out = sw.run(resume=resume, initial=initial,
                              check_initial=False)
@@ -878,7 +915,7 @@ class SearchSupervisor:
             max_secs=self.max_secs, chunk=self.chunk,
             frontier_cap=self.frontier_cap,
             visited_cap=self.visited_cap, ev_budget=self.ev_budget,
-            aot_warmup=self.aot_warmup,
+            aot_warmup=self.aot_warmup, telemetry=self.telemetry,
             **(self.warden_kwargs or {}))
         try:
             return warden.run(resume=resume)

@@ -916,9 +916,20 @@ class SwarmSearch(TensorSearch):
             rounds += 1
             # Live "depth" for supervision heartbeats = round count.
             self._current_depth = rounds
+            t_round = time.time()
             carry, stats = self._round_call(carry,
                                             self.steps_per_round)
             stats = np.asarray(stats)
+            tel = getattr(self, "_telemetry", None)
+            if tel is not None:
+                # Fed from the round's fused stats vector — the same
+                # scalars this loop reads anyway (zero extra syncs).
+                tel.on_level("swarm", {
+                    "depth": rounds,
+                    "wall": round(time.time() - t_round, 4),
+                    "explored": int(stats[0]), "unique": int(stats[1]),
+                    "next_frontier": 0, "deepest": int(stats[6]),
+                    "restarts": int(stats[3])})
             vis_over = int(stats[5])
             over = int(stats[4])
             # Early-warning instrumentation (ISSUE 6 satellite): the
@@ -993,6 +1004,9 @@ class SwarmSearch(TensorSearch):
                 "(> DSLABS_SWARM_RESTART_WARN) — walkers are churning; "
                 "raise max_steps or seed from a deeper frontier",
                 RuntimeWarning, stacklevel=3)
+        tel = getattr(self, "_telemetry", None)
+        if tel is not None:
+            tel.on_outcome(out, engine="swarm")
         return out
 
     def _exhaust_outcome(self, stats, rounds: int, t0,

@@ -1,0 +1,699 @@
+"""Unified telemetry: dispatch-span flight recorder, metrics, reports.
+
+Before this module every subsystem emitted its own ad-hoc signals —
+SearchOutcome counters, warden heartbeat lines, bench JSON fragments,
+``DSLABS_LEVEL_TIMING`` records — and a wedged run left almost nothing
+behind (BENCH_r05 died in preflight with one scraped stderr line to
+explain a 300-second hang).  This is the one observability substrate
+they all feed, built on the paper's discipline that **every signal must
+come from scalar readbacks already paid for**: the recorder never adds
+a device dispatch and never reads anything off the device beyond the
+fused stats vector the engines already sync (enforced by the
+overhead-guard test in tests/test_telemetry.py).
+
+Pieces:
+
+* **Dispatch spans.**  :meth:`Telemetry.attach` hooks the existing
+  ``TensorSearch._dispatch`` seam — the one choke point every hot-loop
+  device dispatch already funnels through (tpu/supervisor.py).  Each
+  dispatch becomes a structured span (engine, site, per-engine index,
+  live BFS depth, wall seconds, retries absorbed by the supervisor
+  boundary, watchdog deadline-scale, outcome) appended to a bounded
+  in-memory ring and — when a ``flight_log`` is configured — streamed
+  as JSONL to the **flight-recorder file** beside the checkpoint
+  (tpu/checkpoint.py ``default_flight_log``).  The file is opened
+  line-buffered append-only and every dispatch writes a begin marker
+  BEFORE the device call, so a SIGKILL'd or wedged run leaves a
+  readable trail whose torn tail names the in-flight dispatch —
+  exactly what the BENCH_r05 shape lacked.
+
+* **Metrics registry.**  Counters / gauges / histograms fed from the
+  host scalars the run already holds: per-level fused-stats records
+  (all three engines + the swarm's rounds), spill/overflow counters,
+  supervisor retry/failover/rung events, and warden heartbeats
+  re-emitted from the child→parent JSON protocol.  ``summary()`` is
+  the JSON block bench phases attach to their output.
+
+* **Profiler windows.**  ``DSLABS_PROFILE=<dir>`` wraps the first
+  ``DSLABS_PROFILE_STEPS`` post-warmup hot-loop dispatches (the first
+  dispatch at each site pays the XLA compile and is skipped) in
+  ``jax.profiler.trace`` — an opt-in deep dive that rides the same
+  seam, zero cost when the knob is unset.
+
+* **Run reports.**  ``python -m dslabs_tpu.tpu.telemetry report
+  <run-dir-or-flight-log>`` renders the flight log alone into per-level
+  throughput series, per-site dispatch-latency percentiles, the
+  retry/failover/heartbeat timeline, spill and overflow counts, the
+  compile-vs-search wall split, and the in-flight dispatch of a torn
+  tail.  docs/observability.md documents the span model and the
+  "diagnosing a wedge" recipe rides it (docs/resilience.md).
+
+Thread-safe (the portfolio runs two lanes against one recorder); pure
+host-side Python + stdlib — importing this module never imports jax.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+__all__ = ["Telemetry", "MetricsRegistry", "Counter", "Gauge",
+           "Histogram", "read_flight", "tail_records", "build_report",
+           "render_report", "render_sites", "main"]
+
+# Hot-loop sites whose steady-state dispatches are worth a profiler
+# capture (the compile-paying first dispatch at a site is skipped).
+_PROFILE_SITES = ("superstep", "step", "round", "expand")
+
+
+# ------------------------------------------------------------- registry
+
+class Counter:
+    """Monotonic count (events, dispatches, retries)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, v: int = 1) -> None:
+        self.value += v
+
+
+class Gauge:
+    """Last-written scalar (depth, table load, outcome counters)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def set(self, v) -> None:
+        self.value = v
+
+
+class Histogram:
+    """Bounded sample store with percentile readout (span latencies).
+    Keeps the most recent ``cap`` observations — a run report wants
+    the distribution, not an unbounded host array."""
+
+    __slots__ = ("values", "count", "total", "cap")
+
+    def __init__(self, cap: int = 4096):
+        self.values: deque = deque(maxlen=cap)
+        self.count = 0
+        self.total = 0.0
+        self.cap = cap
+
+    def observe(self, v: float) -> None:
+        self.values.append(float(v))
+        self.count += 1
+        self.total += float(v)
+
+    def percentile(self, q: float) -> float:
+        if not self.values:
+            return 0.0
+        vs = sorted(self.values)
+        i = min(len(vs) - 1, max(0, int(round(q * (len(vs) - 1)))))
+        return vs[i]
+
+    def snapshot(self) -> dict:
+        return {"count": self.count,
+                "total": round(self.total, 6),
+                "p50": round(self.percentile(0.50), 6),
+                "p90": round(self.percentile(0.90), 6),
+                "p99": round(self.percentile(0.99), 6),
+                "max": round(max(self.values, default=0.0), 6)}
+
+
+class MetricsRegistry:
+    """Create-on-touch named metrics; ``snapshot()`` is plain JSON."""
+
+    def __init__(self):
+        self.counters: Dict[str, Counter] = {}
+        self.gauges: Dict[str, Gauge] = {}
+        self.histograms: Dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        c = self.counters.get(name)
+        if c is None:
+            c = self.counters[name] = Counter()
+        return c
+
+    def gauge(self, name: str) -> Gauge:
+        g = self.gauges.get(name)
+        if g is None:
+            g = self.gauges[name] = Gauge()
+        return g
+
+    def histogram(self, name: str) -> Histogram:
+        h = self.histograms.get(name)
+        if h is None:
+            h = self.histograms[name] = Histogram()
+        return h
+
+    def snapshot(self) -> dict:
+        return {
+            "counters": {k: c.value for k, c in self.counters.items()},
+            "gauges": {k: g.value for k, g in self.gauges.items()},
+            "histograms": {k: h.snapshot()
+                           for k, h in self.histograms.items()},
+        }
+
+
+# ------------------------------------------------------ profiler window
+
+class _ProfileWindow:
+    """Opt-in ``jax.profiler.trace`` capture of the first K post-warmup
+    hot-loop dispatches (DSLABS_PROFILE=<dir>, DSLABS_PROFILE_STEPS).
+    The first dispatch at each site pays the XLA compile and is never
+    captured (a compile trace drowns the steady-state picture).  All
+    failures degrade to "window off" — profiling must never take a
+    search down."""
+
+    def __init__(self):
+        self.dir = os.environ.get("DSLABS_PROFILE") or None
+        try:
+            self.steps = int(os.environ.get("DSLABS_PROFILE_STEPS",
+                                            "4"))
+        except ValueError:
+            self.steps = 4
+        self.active = False
+        self.done = self.dir is None
+        self._left = 0
+        self._seen: Dict[str, int] = {}
+
+    def on_start(self, site: str) -> None:
+        if self.done or self.active or site not in _PROFILE_SITES:
+            return
+        n = self._seen.get(site, 0)
+        self._seen[site] = n + 1
+        if n == 0:
+            return                     # compile-paying warm-up dispatch
+        try:
+            import jax
+
+            jax.profiler.start_trace(self.dir)
+            self.active = True
+            self._left = self.steps
+        except Exception:
+            self.done = True
+
+    def on_done(self, site: str) -> None:
+        if not self.active or site not in _PROFILE_SITES:
+            return
+        self._left -= 1
+        if self._left <= 0:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self.active = False
+            self.done = True
+
+
+# ------------------------------------------------------------- recorder
+
+class Telemetry:
+    """The per-run recorder.  ``attach(search)`` routes the search's
+    ``_dispatch`` seam through :meth:`record_dispatch`; engines feed
+    per-level fused-stats records via :meth:`on_level` and final
+    outcomes via :meth:`on_outcome`; the supervisor/warden feed
+    recovery events via :meth:`event`.  Everything lands in the ring
+    buffer, the metrics registry, and (when configured) the JSONL
+    flight-recorder file."""
+
+    def __init__(self, flight_log: Optional[str] = None,
+                 ring: Optional[int] = None,
+                 engine_hint: Optional[str] = None):
+        if ring is None:
+            try:
+                ring = int(os.environ.get("DSLABS_TELEMETRY_RING",
+                                          "512"))
+            except ValueError:
+                ring = 512
+        self.ring: deque = deque(maxlen=ring)
+        self.registry = MetricsRegistry()
+        self.levels: List[dict] = []
+        self.events: deque = deque(maxlen=512)
+        self.flight_log = flight_log
+        self.engine_hint = engine_hint
+        self._counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._profile = _ProfileWindow()
+        self._t0 = time.time()
+        self._fh = None
+        if flight_log:
+            d = os.path.dirname(os.path.abspath(flight_log))
+            os.makedirs(d, exist_ok=True)
+            # Line-buffered append: each record hits the OS on its own
+            # write, so a SIGKILL leaves complete lines (the reader
+            # tolerates one torn tail line).
+            self._fh = open(flight_log, "a", buffering=1)
+        self._write({"t": "meta", "started": round(self._t0, 3),
+                     "pid": os.getpid(), "hint": engine_hint})
+
+    @classmethod
+    def for_checkpoint(cls, checkpoint_path: str, **kw) -> "Telemetry":
+        """The run-dir convention: flight log beside the dump
+        (tpu/checkpoint.py ``default_flight_log``)."""
+        from dslabs_tpu.tpu import checkpoint as ckpt_mod
+
+        kw.setdefault("flight_log",
+                      ckpt_mod.default_flight_log(checkpoint_path))
+        return cls(**kw)
+
+    # ----------------------------------------------------------- plumbing
+
+    def _ts(self) -> float:
+        return round(time.time() - self._t0, 4)
+
+    def _write(self, rec: dict) -> None:
+        if self._fh is None:
+            return
+        try:
+            self._fh.write(json.dumps(rec) + "\n")
+        except (OSError, ValueError):
+            self._fh = None           # disk gone / closed: record in RAM only
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+    def attach(self, search):
+        """Route ``search``'s dispatches through this recorder (the
+        engine's ``_dispatch`` checks ``_telemetry``).  Returns the
+        search for chaining."""
+        search._telemetry = self
+        return search
+
+    # ----------------------------------------------------------- dispatch
+
+    def record_dispatch(self, search, tag: str, hook, fn, *args):
+        """THE span source: called by ``TensorSearch._dispatch`` for
+        every hot-loop device dispatch.  Wraps the existing hook chain
+        (supervisor boundary included) — never an extra device call,
+        never a readback; everything recorded is a host scalar the
+        dispatch already produced."""
+        engine, _, site = tag.partition(".")
+        with self._lock:
+            idx = self._counts.get(engine, 0)
+            self._counts[engine] = idx + 1
+        depth = int(getattr(search, "_current_depth", 0) or 0)
+        boundary = getattr(search, "_dispatch_boundary", None)
+        r0 = boundary.retries if boundary is not None else 0
+        scales = getattr(search, "_dispatch_deadline_scales", None) or {}
+        scale = float(scales.get(site, 1.0))
+        start = {"t": "dispatch", "ts": self._ts(), "tag": tag,
+                 "i": idx, "depth": depth}
+        with self._lock:
+            self._write(start)
+        self._profile.on_start(site)
+        t0 = time.time()
+        outcome = "ok"
+        try:
+            if hook is None:
+                return fn(*args)
+            return hook(tag, fn, *args)
+        except BaseException as e:  # noqa: BLE001 — recorded, re-raised
+            outcome = type(e).__name__
+            raise
+        finally:
+            wall = time.time() - t0
+            self._profile.on_done(site)
+            retries = ((boundary.retries - r0)
+                       if boundary is not None else 0)
+            span = {"t": "span", "ts": self._ts(), "tag": tag,
+                    "engine": engine, "site": site, "i": idx,
+                    "depth": depth, "wall": round(wall, 6),
+                    "retries": retries, "scale": scale,
+                    "outcome": outcome}
+            with self._lock:
+                self.ring.append(span)
+                self._write(span)
+                self.registry.counter(f"dispatches.{engine}").inc()
+                self.registry.histogram(f"dispatch_secs.{tag}").observe(
+                    wall)
+                if retries:
+                    self.registry.counter("retries").inc(retries)
+                if outcome != "ok":
+                    self.registry.counter(
+                        f"dispatch_errors.{outcome}").inc()
+
+    @contextlib.contextmanager
+    def span(self, tag: str, **fields):
+        """Manual span for host-side work that is not a device dispatch
+        (bench preflight, the profiling tools' timed blocks).  Same
+        record shape, same registry feeds."""
+        engine, _, site = tag.partition(".")
+        with self._lock:
+            idx = self._counts.get(engine, 0)
+            self._counts[engine] = idx + 1
+            self._write({"t": "dispatch", "ts": self._ts(), "tag": tag,
+                         "i": idx, "depth": 0})
+        t0 = time.time()
+        outcome = "ok"
+        try:
+            yield self
+        except BaseException as e:  # noqa: BLE001 — recorded, re-raised
+            outcome = type(e).__name__
+            raise
+        finally:
+            wall = time.time() - t0
+            span = {"t": "span", "ts": self._ts(), "tag": tag,
+                    "engine": engine, "site": site, "i": idx,
+                    "depth": 0, "wall": round(wall, 6), "retries": 0,
+                    "scale": 1.0, "outcome": outcome, **fields}
+            with self._lock:
+                self.ring.append(span)
+                self._write(span)
+                self.registry.counter(f"dispatches.{engine}").inc()
+                self.registry.histogram(f"dispatch_secs.{tag}").observe(
+                    wall)
+
+    # -------------------------------------------------------- other feeds
+
+    def event(self, kind: str, **fields) -> None:
+        """Recovery/operational event (supervisor retry/failover/rung,
+        warden heartbeat/child_death, spill evict/reinject, …)."""
+        rec = {"t": "event", "ts": self._ts(), "kind": kind, **fields}
+        with self._lock:
+            self.events.append(rec)
+            self._write(rec)
+            self.registry.counter(f"events.{kind}").inc()
+
+    def on_level(self, engine: str, record: dict) -> None:
+        """One completed BFS level / wave / swarm round, described by
+        the host scalars of the fused stats readback the engine already
+        paid for (depth, wall, explored, unique, next_frontier, …)."""
+        rec = {"t": "level", "ts": self._ts(), "engine": engine,
+               **record}
+        with self._lock:
+            self.levels.append(rec)
+            self._write(rec)
+            self.registry.counter(f"levels.{engine}").inc()
+            self.registry.gauge(f"depth.{engine}").set(
+                record.get("depth", 0))
+            self.registry.gauge(f"explored.{engine}").set(
+                record.get("explored", 0))
+            self.registry.gauge(f"unique.{engine}").set(
+                record.get("unique", 0))
+            if record.get("wall") is not None:
+                self.registry.histogram(f"level_secs.{engine}").observe(
+                    float(record["wall"]))
+            if record.get("load_factor") is not None:
+                self.registry.gauge(f"load_factor.{engine}").set(
+                    record["load_factor"])
+
+    # Outcome scalars worth a gauge + the outcome record (all plain
+    # host ints the verdict already carries).
+    _OUTCOME_FIELDS = (
+        "states_explored", "unique_states", "depth", "retries",
+        "failovers", "resumed_from_depth", "visited_overflow",
+        "dropped", "spilled_keys", "host_tier_hits",
+        "respilled_frontier", "walker_restarts", "swarm_overflow",
+        "child_restarts", "killed_dispatches", "abandoned_threads")
+
+    def on_outcome(self, out, engine: Optional[str] = None) -> None:
+        """Ingest a SearchOutcome's accounting: one ``outcome`` record
+        plus gauges for every counter (spill, overflow, recovery)."""
+        eng = engine or getattr(out, "engine", None) or "search"
+        rec = {"t": "outcome", "ts": self._ts(), "engine": eng,
+               "end_condition": out.end_condition,
+               "elapsed_secs": round(float(out.elapsed_secs), 4),
+               "compile_secs": round(float(out.compile_secs), 4)}
+        with self._lock:
+            for f in self._OUTCOME_FIELDS:
+                v = int(getattr(out, f, 0) or 0)
+                rec[f] = v
+                if v:
+                    self.registry.gauge(f"outcome.{f}").set(v)
+            self.registry.gauge("outcome.compile_secs").set(
+                rec["compile_secs"])
+            self._write(rec)
+            self.events.append(rec)
+
+    # ------------------------------------------------------------ summary
+
+    def summary(self) -> dict:
+        """The compact JSON block bench phases attach to their output:
+        span totals, per-site latency snapshots, event counts, and the
+        flight-log path for the deep dive."""
+        with self._lock:
+            sites = {name[len("dispatch_secs."):]: h.snapshot()
+                     for name, h in
+                     self.registry.histograms.items()
+                     if name.startswith("dispatch_secs.")}
+            events = {name[len("events."):]: c.value
+                      for name, c in self.registry.counters.items()
+                      if name.startswith("events.")}
+            return {
+                "spans": sum(self._counts.values()),
+                "dispatches": dict(self._counts),
+                "sites": sites,
+                "events": events,
+                "levels": len(self.levels),
+                "flight_log": self.flight_log,
+            }
+
+
+# ------------------------------------------------------- flight reading
+
+def read_flight(path: str) -> List[dict]:
+    """Parse a flight-recorder JSONL file, tolerating ONE torn tail
+    line (the signature of a SIGKILL mid-write).  A torn line anywhere
+    else raises — the file is corrupt, not merely truncated."""
+    records: List[dict] = []
+    with open(path) as f:
+        lines = f.read().splitlines()
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            records.append(json.loads(line))
+        except ValueError:
+            if i == len(lines) - 1:
+                break                     # torn tail: expected crash shape
+            raise
+    return records
+
+
+def tail_records(path: Optional[str], n: int = 6,
+                 kinds=("dispatch", "span", "event")) -> List[dict]:
+    """The last ``n`` span/dispatch/event records of a flight log —
+    the wedge-diagnostics payload bench.py attaches to a phase error.
+    Never raises: diagnostics must not mask the error they describe."""
+    if not path:
+        return []
+    try:
+        recs = [r for r in read_flight(path) if r.get("t") in kinds]
+    except Exception:
+        return []
+    return recs[-n:]
+
+
+# --------------------------------------------------------------- report
+
+def _resolve_flight(path: str) -> str:
+    """Accept a flight log OR a run directory (the checkpoint's dir):
+    a directory resolves to its ``flight.jsonl`` or the newest
+    ``*.flight.jsonl`` inside it."""
+    if os.path.isdir(path):
+        cand = os.path.join(path, "flight.jsonl")
+        if os.path.exists(cand):
+            return cand
+        logs = sorted(
+            (os.path.join(path, f) for f in os.listdir(path)
+             if f.endswith(".flight.jsonl") or f.endswith(".jsonl")),
+            key=lambda p: os.path.getmtime(p))
+        if logs:
+            return logs[-1]
+        raise FileNotFoundError(f"no flight log (*.jsonl) in {path}")
+    return path
+
+
+def build_report(records: List[dict]) -> dict:
+    """Aggregate a flight log's records into the run-report structure
+    (everything the renderer needs, derived from the log alone)."""
+    spans = [r for r in records if r.get("t") == "span"]
+    levels = [r for r in records if r.get("t") == "level"]
+    events = [r for r in records if r.get("t") == "event"]
+    outcomes = [r for r in records if r.get("t") == "outcome"]
+    meta = next((r for r in records if r.get("t") == "meta"), None)
+
+    sites: Dict[str, Histogram] = {}
+    first_wall: Dict[str, float] = {}
+    for s in spans:
+        h = sites.setdefault(s["tag"], Histogram())
+        h.observe(s.get("wall", 0.0))
+        first_wall.setdefault(s["tag"], float(s.get("wall", 0.0)))
+    total_wall = sum(float(s.get("wall", 0.0)) for s in spans)
+    compile_wall = sum(first_wall.values())
+
+    # Per-level throughput series: explored is cumulative, so the rate
+    # uses the delta against the previous record of the same engine.
+    series: Dict[str, List[dict]] = {}
+    prev: Dict[str, int] = {}
+    for lv in levels:
+        eng = lv.get("engine", "?")
+        d = int(lv.get("explored", 0)) - prev.get(eng, 0)
+        prev[eng] = int(lv.get("explored", 0))
+        wall = float(lv.get("wall", 0.0)) or 1e-9
+        series.setdefault(eng, []).append(dict(lv, delta_explored=d,
+                                               rate=round(d / wall, 1)))
+
+    # Recovery timeline: events plus retry-absorbing spans, time-sorted.
+    timeline = sorted(
+        (events
+         + [s for s in spans if s.get("retries")]
+         + [s for s in spans if s.get("outcome") not in (None, "ok")]),
+        key=lambda r: r.get("ts", 0.0))
+
+    # In-flight dispatch: a begin marker with no matching span means
+    # the process died (or is wedged) inside that device call.
+    open_dispatch = None
+    done = {(s["tag"], s["i"]) for s in spans}
+    for r in records:
+        if r.get("t") == "dispatch" and (r["tag"], r["i"]) not in done:
+            open_dispatch = r
+    counts = {}
+    for o in outcomes:
+        for k in ("spilled_keys", "host_tier_hits", "respilled_frontier",
+                  "visited_overflow", "dropped", "retries", "failovers",
+                  "walker_restarts", "swarm_overflow"):
+            if o.get(k):
+                counts[k] = counts.get(k, 0) + int(o[k])
+    return {"meta": meta, "n_spans": len(spans),
+            "sites": {t: h.snapshot() for t, h in sites.items()},
+            "series": series, "timeline": timeline,
+            "outcomes": outcomes, "counts": counts,
+            "total_wall": round(total_wall, 3),
+            "compile_wall": round(compile_wall, 3),
+            "in_flight": open_dispatch}
+
+
+def render_report(report: dict, source: str = "") -> str:
+    """The human-readable run report (pinned sections: the golden test
+    asserts these headers — keep them stable)."""
+    out: List[str] = []
+    out.append(f"== dslabs run report: {source or 'flight log'} ==")
+    meta = report.get("meta") or {}
+    if meta:
+        out.append(f"meta: pid {meta.get('pid')} "
+                   f"hint={meta.get('hint')}")
+    out.append(
+        f"spans: {report['n_spans']} dispatches across "
+        f"{len(report['sites'])} sites; device wall "
+        f"{report['total_wall']:.3f}s "
+        f"(first-dispatch/compile {report['compile_wall']:.3f}s, "
+        f"steady {report['total_wall'] - report['compile_wall']:.3f}s)")
+
+    out.append("")
+    out.append("-- dispatch latency by site --")
+    out.append(f"{'site':34s} {'n':>6s} {'p50ms':>9s} {'p90ms':>9s} "
+               f"{'p99ms':>9s} {'maxms':>9s} {'total_s':>9s}")
+    for tag in sorted(report["sites"]):
+        s = report["sites"][tag]
+        out.append(f"{tag:34s} {s['count']:6d} {s['p50']*1e3:9.2f} "
+                   f"{s['p90']*1e3:9.2f} {s['p99']*1e3:9.2f} "
+                   f"{s['max']*1e3:9.2f} {s['total']:9.3f}")
+
+    out.append("")
+    out.append("-- per-level throughput --")
+    if not report["series"]:
+        out.append("(no level records)")
+    for eng in sorted(report["series"]):
+        out.append(f"[engine {eng}]")
+        out.append(f"{'depth':>6s} {'wall_s':>8s} {'explored':>10s} "
+                   f"{'unique':>10s} {'next':>10s} {'states/s':>10s}")
+        for lv in report["series"][eng]:
+            out.append(
+                f"{lv.get('depth', 0):6d} {lv.get('wall', 0.0):8.3f} "
+                f"{lv.get('explored', 0):10d} "
+                f"{lv.get('unique', 0):10d} "
+                f"{lv.get('next_frontier', 0):10d} "
+                f"{lv.get('rate', 0.0):10.1f}")
+
+    out.append("")
+    out.append("-- recovery timeline --")
+    if not report["timeline"]:
+        out.append("(no retries, failovers, or events)")
+    for r in report["timeline"][-40:]:
+        if r.get("t") == "event":
+            extra = {k: v for k, v in r.items()
+                     if k not in ("t", "ts", "kind")}
+            out.append(f"+{r.get('ts', 0.0):8.2f}s event "
+                       f"{r['kind']} {extra}")
+        else:
+            out.append(f"+{r.get('ts', 0.0):8.2f}s span {r['tag']} "
+                       f"i={r['i']} retries={r.get('retries', 0)} "
+                       f"outcome={r.get('outcome')}")
+
+    out.append("")
+    out.append("-- spill / overflow / recovery counts --")
+    if report["counts"]:
+        out.append(" ".join(f"{k}={v}"
+                            for k, v in sorted(report["counts"].items())))
+    else:
+        out.append("(all zero)")
+    for o in report["outcomes"]:
+        out.append(
+            f"outcome: {o.get('end_condition')} engine="
+            f"{o.get('engine')} depth={o.get('depth')} "
+            f"unique={o.get('unique_states')} "
+            f"explored={o.get('states_explored')} "
+            f"elapsed={o.get('elapsed_secs')}s "
+            f"compile={o.get('compile_secs')}s")
+
+    if report["in_flight"] is not None:
+        r = report["in_flight"]
+        out.append("")
+        out.append(f"!! in-flight at EOF: {r['tag']} i={r['i']} "
+                   f"depth={r.get('depth')} — the run died or wedged "
+                   "inside this dispatch")
+    return "\n".join(out)
+
+
+def render_sites(summary: dict) -> str:
+    """The per-site latency table of a :meth:`Telemetry.summary` —
+    the shared renderer the profiling tools (tools/profile_*.py) print
+    instead of hand-rolled timing scaffolds.  Columns match the report
+    CLI's dispatch-latency section."""
+    out = [f"{'site':40s} {'n':>6s} {'p50ms':>9s} {'p90ms':>9s} "
+           f"{'maxms':>9s} {'total_s':>9s}"]
+    for tag in sorted(summary.get("sites", {})):
+        s = summary["sites"][tag]
+        out.append(f"{tag:40s} {s['count']:6d} {s['p50']*1e3:9.2f} "
+                   f"{s['p90']*1e3:9.2f} {s['max']*1e3:9.2f} "
+                   f"{s['total']:9.3f}")
+    return "\n".join(out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import sys
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] != "report" or len(argv) < 2:
+        print("usage: python -m dslabs_tpu.tpu.telemetry report "
+              "<run-dir-or-flight-log>", file=sys.stderr)
+        return 2
+    path = _resolve_flight(argv[1])
+    report = build_report(read_flight(path))
+    print(render_report(report, source=path))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

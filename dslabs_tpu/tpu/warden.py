@@ -269,7 +269,13 @@ class Warden:
                  grace_slack: float = 5.0,
                  fault: Optional[dict] = None,
                  env: Optional[dict] = None,
-                 extra_sys_path: Optional[List[str]] = None):
+                 extra_sys_path: Optional[List[str]] = None,
+                 telemetry=None):
+        # Unified telemetry (tpu/telemetry.py): child heartbeats from
+        # the pipe protocol are re-emitted as parent-side telemetry
+        # events, so the flight log shows the child's dispatch-level
+        # liveness even though the child is a separate process.
+        self.telemetry = telemetry
         self.factory = factory
         self.factory_kwargs = factory_kwargs or {}
         self.transform = transform
@@ -408,6 +414,13 @@ class Warden:
             if t == "hb":
                 last_hb = msg
                 grace = float(msg.get("grace", self.steady_grace))
+                if self.telemetry is not None:
+                    self.telemetry.event(
+                        "heartbeat", rung=rung,
+                        phase=msg.get("phase"), tag=msg.get("tag"),
+                        n=msg.get("n"), depth=msg.get("depth"),
+                        ckpt_depth=msg.get("ckpt_depth"),
+                        grace=msg.get("grace"))
                 continue
             if t == "result":
                 proc.wait()
@@ -461,6 +474,11 @@ class Warden:
                                detail=res["detail"],
                                last_hb=res.get("last_hb"))
             self.deaths.append(death)
+            if self.telemetry is not None:
+                self.telemetry.event(
+                    "child_death", rung=rung, kind=death.kind,
+                    exitcode=death.exitcode,
+                    detail=death.detail[:200])
             self.failures.append(EngineFailure(
                 rung, death.kind, RuntimeError(death.detail)))
         raise SupervisorExhausted(self.failures)

@@ -1,0 +1,373 @@
+"""Unified telemetry layer (ISSUE 7, tpu/telemetry.py).
+
+The contract under test, in the paper's discipline that every signal
+must come from scalar readbacks already paid for:
+
+* **span count == dispatch count** on pingpong, BOTH engines — the
+  recorder rides the existing ``_dispatch`` seam, one span per
+  dispatch, never more, never fewer;
+* **zero added overhead** — attaching telemetry changes neither the
+  dispatch counts nor the number of device->host readbacks (the
+  ``engine.device_get`` spy), the hard acceptance constraint;
+* **crash-safe flight recorder** — a SIGKILL'd run leaves a parseable
+  JSONL tail whose last record names the IN-FLIGHT dispatch (the
+  BENCH_r05 diagnosability fix);
+* **report CLI** — renders per-level throughput and per-site latency
+  percentiles from the flight log alone (golden sections pinned);
+* **supervisor/bench integration** — retries/failovers become events,
+  and the bench JSON's ``telemetry`` block + error-with-spans shape
+  are schema-pinned so future phases can't silently drop fields.
+
+``make obs-smoke`` runs this file including the slow bench shape.
+"""
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from dslabs_tpu.tpu import engine  # noqa: E402
+from dslabs_tpu.tpu import telemetry as tel_mod  # noqa: E402
+from dslabs_tpu.tpu.engine import TensorSearch  # noqa: E402
+from dslabs_tpu.tpu.protocols.pingpong import \
+    make_pingpong_protocol  # noqa: E402
+from dslabs_tpu.tpu.sharded import ShardedTensorSearch, make_mesh  # noqa: E402
+from dslabs_tpu.tpu.telemetry import (Telemetry, build_report,  # noqa: E402
+                                      read_flight, render_report,
+                                      tail_records)
+
+pytestmark = pytest.mark.obs
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _pruned_pingpong():
+    pp = make_pingpong_protocol(workload_size=2)
+    return dataclasses.replace(
+        pp, goals={}, prunes={"CLIENTS_DONE": pp.goals["CLIENTS_DONE"]})
+
+
+def _counting_hook(counts):
+    def hook(tag, fn, *args):
+        counts[tag] = counts.get(tag, 0) + 1
+        return fn(*args)
+    return hook
+
+
+def _spans(tel):
+    return [r for r in tel.ring if r["t"] == "span"]
+
+
+# ------------------------------------------------- span/dispatch parity
+
+def test_span_count_equals_dispatch_count_device_engine():
+    counts = {}
+    tel = Telemetry()
+    search = TensorSearch(_pruned_pingpong(), max_depth=8,
+                          frontier_cap=1 << 10, visited_cap=1 << 12)
+    search._dispatch_hook = _counting_hook(counts)
+    tel.attach(search)
+    out = search.run()
+    assert out.end_condition == "SPACE_EXHAUSTED"
+    assert sum(counts.values()) == len(_spans(tel))
+    # Span tags are the dispatch tags, verbatim.
+    by_tag = {}
+    for s in _spans(tel):
+        by_tag[s["tag"]] = by_tag.get(s["tag"], 0) + 1
+    assert by_tag == counts
+
+
+def test_span_count_equals_dispatch_count_sharded_engine():
+    counts = {}
+    tel = Telemetry()
+    search = ShardedTensorSearch(
+        _pruned_pingpong(), make_mesh(8), chunk_per_device=16,
+        frontier_cap=1 << 8, visited_cap=1 << 10, max_depth=8,
+        telemetry=tel)
+    search._dispatch_hook = _counting_hook(counts)
+    out = search.run()
+    assert out.end_condition == "SPACE_EXHAUSTED"
+    assert sum(counts.values()) == len(_spans(tel))
+    assert any(s["tag"] == "sharded.superstep" for s in _spans(tel))
+
+
+# ----------------------------------------------------- overhead guard
+
+def test_overhead_guard_no_added_dispatches_or_transfers(monkeypatch):
+    """ACCEPTANCE: telemetry adds ZERO device dispatches and ZERO
+    device->host readbacks — dispatch counts and device_get call
+    counts are identical with and without the recorder, both
+    engines."""
+    proto = _pruned_pingpong()
+    gets = []
+    real = engine.device_get
+
+    def spy(x):
+        gets.append(1)
+        return real(x)
+
+    monkeypatch.setattr(engine, "device_get", spy)
+
+    def run_device(telemetry):
+        counts = {}
+        s = TensorSearch(proto, max_depth=8, frontier_cap=1 << 10,
+                         visited_cap=1 << 12, telemetry=telemetry)
+        s._dispatch_hook = _counting_hook(counts)
+        del gets[:]
+        out = s.run()
+        return counts, len(gets), out
+
+    c0, g0, o0 = run_device(None)
+    c1, g1, o1 = run_device(Telemetry())
+    assert c0 == c1, "telemetry changed the dispatch schedule"
+    assert g0 == g1, "telemetry added device->host transfers"
+    assert (o0.unique_states, o0.end_condition) == \
+        (o1.unique_states, o1.end_condition)
+
+    def run_sharded(telemetry):
+        counts = {}
+        s = ShardedTensorSearch(
+            proto, make_mesh(8), chunk_per_device=16,
+            frontier_cap=1 << 8, visited_cap=1 << 10, max_depth=8,
+            telemetry=telemetry)
+        s._dispatch_hook = _counting_hook(counts)
+        s.run()
+        return counts
+
+    assert run_sharded(None) == run_sharded(Telemetry())
+
+
+# ------------------------------------------------------- flight log IO
+
+def test_flight_log_records_and_levels(tmp_path):
+    flight = str(tmp_path / "flight.jsonl")
+    tel = Telemetry(flight_log=flight)
+    search = TensorSearch(_pruned_pingpong(), max_depth=8,
+                          frontier_cap=1 << 10, visited_cap=1 << 12)
+    tel.attach(search)
+    out = search.run()
+    tel.close()
+    recs = read_flight(flight)
+    kinds = {r["t"] for r in recs}
+    assert {"meta", "dispatch", "span", "level", "outcome"} <= kinds
+    spans = [r for r in recs if r["t"] == "span"]
+    starts = [r for r in recs if r["t"] == "dispatch"]
+    assert len(spans) == len(starts)        # every start closed
+    levels = [r for r in recs if r["t"] == "level"]
+    assert len(levels) == out.depth
+    oc = [r for r in recs if r["t"] == "outcome"][-1]
+    assert oc["end_condition"] == out.end_condition
+    assert oc["unique_states"] == out.unique_states
+
+
+def test_read_flight_tolerates_torn_tail_only(tmp_path):
+    p = tmp_path / "t.jsonl"
+    good = json.dumps({"t": "span", "tag": "device.step", "i": 0})
+    p.write_text(good + "\n" + good + "\n" + '{"t": "disp')  # torn tail
+    assert len(read_flight(str(p))) == 2
+    # A torn line mid-file is corruption, not truncation.
+    p.write_text('{"t": "sp\n' + good + "\n")
+    with pytest.raises(ValueError):
+        read_flight(str(p))
+    # tail_records never raises — diagnostics must not mask the error.
+    assert tail_records(str(p)) == []
+    assert tail_records(None) == []
+
+
+def test_run_dir_layout_names_flight_log(tmp_path):
+    from dslabs_tpu.tpu import checkpoint as ckpt_mod
+
+    ck = str(tmp_path / "search.ckpt")
+    lay = ckpt_mod.run_dir_layout(ck)
+    assert lay["flight_log"] == str(tmp_path / "flight.jsonl")
+    assert lay["compile_cache"] == str(tmp_path / "compile_cache")
+    tel = Telemetry.for_checkpoint(ck)
+    assert tel.flight_log == lay["flight_log"]
+    tel.close()
+
+
+# ------------------------------------------------------ SIGKILL survival
+
+_KILL_CHILD = r"""
+import dataclasses, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache-cpu")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+from dslabs_tpu.tpu.engine import TensorSearch
+from dslabs_tpu.tpu.protocols.pingpong import make_pingpong_protocol
+from dslabs_tpu.tpu.telemetry import Telemetry
+
+pp = make_pingpong_protocol(workload_size=2)
+pp = dataclasses.replace(pp, goals={},
+                         prunes={"CLIENTS_DONE": pp.goals["CLIENTS_DONE"]})
+search = TensorSearch(pp, max_depth=10, frontier_cap=1 << 10,
+                      visited_cap=1 << 12)
+n = [0]
+def hook(tag, fn, *args):
+    n[0] += 1
+    if n[0] == 6:
+        print("WEDGED", flush=True)
+        time.sleep(600.0)           # the wedge: parent SIGKILLs us here
+    return fn(*args)
+search._dispatch_hook = hook
+Telemetry(flight_log=sys.argv[1]).attach(search)
+search.run()
+"""
+
+
+def test_flight_log_survives_sigkill_names_inflight_dispatch(tmp_path):
+    """ACCEPTANCE: a SIGKILL'd run leaves a parseable JSONL tail whose
+    last record is the begin marker of the dispatch that was in
+    flight — the wedge is attributable from the file alone."""
+    flight = str(tmp_path / "flight.jsonl")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _KILL_CHILD, flight],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env, cwd=ROOT)
+    try:
+        line = proc.stdout.readline()       # blocks until mid-dispatch
+        assert "WEDGED" in line
+        time.sleep(0.3)                     # let the marker line flush
+        proc.send_signal(signal.SIGKILL)
+    finally:
+        proc.wait(timeout=30)
+    recs = read_flight(flight)              # parses despite the kill
+    assert recs, "flight log must survive SIGKILL"
+    spans = {(r["tag"], r["i"]) for r in recs if r["t"] == "span"}
+    starts = [r for r in recs if r["t"] == "dispatch"]
+    open_starts = [r for r in starts if (r["tag"], r["i"]) not in spans]
+    assert len(open_starts) == 1, recs[-3:]
+    # The report names the same in-flight dispatch.
+    rep = build_report(recs)
+    assert rep["in_flight"] is not None
+    assert rep["in_flight"]["tag"] == open_starts[0]["tag"]
+    assert "in-flight at EOF" in render_report(rep)
+
+
+# ------------------------------------------------------------ report CLI
+
+def test_report_cli_golden_sections(tmp_path, capsys):
+    """The report CLI renders per-level throughput and per-site latency
+    percentiles FROM THE LOG ALONE (acceptance) — section headers and
+    key fields pinned."""
+    flight = str(tmp_path / "flight.jsonl")
+    tel = Telemetry(flight_log=flight)
+    search = ShardedTensorSearch(
+        _pruned_pingpong(), make_mesh(8), chunk_per_device=16,
+        frontier_cap=1 << 8, visited_cap=1 << 10, max_depth=8,
+        telemetry=tel)
+    out = search.run()
+    tel.close()
+    # A run dir (the checkpoint's directory) resolves to flight.jsonl.
+    assert tel_mod.main(["report", str(tmp_path)]) == 0
+    text = capsys.readouterr().out
+    for header in ("== dslabs run report", "-- dispatch latency by site --",
+                   "-- per-level throughput --", "-- recovery timeline --",
+                   "-- spill / overflow / recovery counts --"):
+        assert header in text, f"missing section {header!r}"
+    assert "sharded.superstep" in text
+    assert "[engine sharded]" in text
+    assert f"outcome: {out.end_condition}" in text
+    assert "p50ms" in text and "p99ms" in text and "states/s" in text
+    # One throughput row per completed level.
+    lines = text.splitlines()
+    i = lines.index("[engine sharded]")
+    rows = [ln for ln in lines[i + 2:] if ln and ln[0] != "["
+            and not ln.startswith("--")]
+    assert len([r for r in rows if r.strip()
+                and r.strip()[0].isdigit()]) == out.depth
+
+
+# ------------------------------------------- supervisor / event plumbing
+
+def test_supervisor_retries_become_events_and_span_retries():
+    from dslabs_tpu.tpu.supervisor import (FaultPlan, RetryPolicy,
+                                           SearchSupervisor)
+
+    tel = Telemetry()
+    plan = FaultPlan().raise_at(2, engine="host")
+    sup = SearchSupervisor(
+        _pruned_pingpong(), ladder=("host",),
+        policy=RetryPolicy(max_retries=2, backoff_base=0.001),
+        fault_plan=plan, max_depth=8, chunk=1 << 8,
+        frontier_cap=1 << 10, visited_cap=1 << 12, telemetry=tel)
+    out = sup.run()
+    assert out.end_condition == "SPACE_EXHAUSTED"
+    assert out.retries >= 1
+    ev = {r["kind"] for r in tel.events if r.get("t") == "event"}
+    assert "rung" in ev and "retry" in ev
+    assert tel.registry.counters["events.retry"].value >= 1
+    # The retry is charged to the span of the dispatch that absorbed it.
+    assert sum(s["retries"] for s in _spans(tel)) == out.retries
+
+
+def test_profiler_window_knob_is_safe(tmp_path, monkeypatch):
+    """DSLABS_PROFILE wraps post-warmup dispatches in jax.profiler
+    windows; whatever the platform does with that, the search itself
+    must be unaffected (the knob can never take a run down)."""
+    monkeypatch.setenv("DSLABS_PROFILE", str(tmp_path / "prof"))
+    monkeypatch.setenv("DSLABS_PROFILE_STEPS", "2")
+    tel = Telemetry()
+    search = TensorSearch(_pruned_pingpong(), max_depth=8,
+                          frontier_cap=1 << 10, visited_cap=1 << 12)
+    tel.attach(search)
+    out = search.run()
+    assert out.end_condition == "SPACE_EXHAUSTED"
+    assert not tel._profile.active          # window closed behind itself
+
+
+# ------------------------------------------------- bench JSON schema pin
+
+@pytest.mark.slow
+def test_bench_json_schema_pins_telemetry_and_wedge_shapes():
+    """SCHEMA PIN (ISSUE-7 satellite): the bench's last-line JSON must
+    carry (a) the ``telemetry`` block with per-phase span summaries and
+    flight-log paths, and (b) on a wedged phase, ``wedge_diagnostics``
+    whose entries name the phase, the child's last heartbeat, AND its
+    last flight-recorder spans — including the in-flight dispatch of
+    the hang (the BENCH_r05 fix).  Future phases cannot silently drop
+    these fields."""
+    env = dict(os.environ, DSLABS_FORCE_CPU="1",
+               DSLABS_BENCH_FAKE_WEDGE="hang",
+               DSLABS_BENCH_PREFLIGHT_SILENCE_SECS="8",
+               DSLABS_FALLBACK_DEPTH="5",
+               DSLABS_BENCH_DEADLINE_SECS="400")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py")],
+        capture_output=True, text=True, timeout=380, env=env, cwd=ROOT)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+
+    # (b) the error-with-spans shape
+    assert "wedge_diagnostics" in out, out.keys()
+    diag = out["wedge_diagnostics"][0]
+    for key in ("phase", "message", "last_heartbeat", "last_spans"):
+        assert key in diag, diag.keys()
+    assert diag["phase"] == "preflight"
+    assert diag["last_heartbeat"] is not None
+    # The hang ran inside a telemetry span: its begin marker is in the
+    # flight tail, naming the in-flight dispatch.
+    assert any(r.get("tag") == "preflight.hang"
+               for r in diag["last_spans"]), diag["last_spans"]
+
+    # (a) the telemetry block (cpu-fallback phase ran for real)
+    tl = out["telemetry"]
+    assert "run_dir" in tl and "phases" in tl
+    ph = tl["phases"]["cpu-fallback"]
+    for key in ("spans", "dispatches", "sites", "events", "levels",
+                "flight_log"):
+        assert key in ph, ph.keys()
+    assert ph["spans"] > 0
+    assert ph["levels"] > 0
+    assert any(site.startswith("device.") for site in ph["sites"])

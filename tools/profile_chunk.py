@@ -1,10 +1,11 @@
 """Decompose the TPU chunk-step cost: which stage dominates?
 
-Times jitted sub-programs of the bench configuration's expand pipeline on
-whatever accelerator is present. Not part of the test suite — a dev tool.
-"""
-
-import time
+Times jitted sub-programs of the bench configuration's expand pipeline
+on whatever accelerator is present — a thin client of the telemetry
+API (tpu/telemetry.py): each stage is a compile span + N steady spans
+and the output is the shared per-site latency table (the old hand-rolled
+``bench_fn`` stopwatch scaffold is gone).  Not part of the test suite —
+a dev tool."""
 
 import jax
 
@@ -16,21 +17,22 @@ from dslabs_tpu.tpu.engine import (TensorSearch, canonicalize_net,
                                    insert_messages, state_fingerprints,
                                    append_timers, flatten_state)
 from dslabs_tpu.tpu.protocols.paxos import make_paxos_protocol
+from dslabs_tpu.tpu.telemetry import Telemetry, render_sites
+
+TEL = Telemetry(engine_hint="profile_chunk")
 
 
-def bench_fn(name, fn, *args, iters=5):
+def timed(name, fn, *args, iters=5):
+    """One compile span + ``iters`` steady spans through the telemetry
+    recorder; returns the steady mean seconds (for derived rates)."""
     fn = jax.jit(fn)
-    t0 = time.time()
-    out = fn(*args)
-    jax.block_until_ready(out)
-    print(f"{name:40s} compile+1st {time.time()-t0:6.1f} s")
-    t0 = time.time()
+    with TEL.span(f"profile.{name}.compile"):
+        jax.block_until_ready(fn(*args))
     for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    dt = (time.time() - t0) / iters
-    print(f"{name:40s} {dt*1e3:9.2f} ms")
-    return dt
+        with TEL.span(f"profile.{name}"):
+            jax.block_until_ready(fn(*args))
+    h = TEL.registry.histogram(f"dispatch_secs.profile.{name}")
+    return h.total / max(h.count, 1)
 
 
 def main():
@@ -47,50 +49,39 @@ def main():
           f"lanes={flatten_state(state).shape[1]}")
 
     # full expand
-    dt = bench_fn("full _expand_chunk", search._expand_chunk,
-                  chunk_state, chunk_valid)
-    print(f"  -> {n_pairs/dt:,.0f} explored pairs/s")
+    dt = timed("expand_chunk", search._expand_chunk, chunk_state,
+               chunk_valid)
+    print(f"full _expand_chunk -> {n_pairs/max(dt, 1e-9):,.0f} "
+          "explored pairs/s")
 
     # pieces, over the flattened pair batch
     rep_state = jnp.repeat(chunk_state, ne, axis=0)
     ev = jnp.tile(jnp.arange(ne), C)
 
-    def step_only(rs, e):
-        return jax.vmap(search._step_one)(rs, e)
-
-    dt = bench_fn("vmapped _step_one (incl. insert/append)", step_only,
-                  rep_state, ev)
+    timed("step_one", lambda rs, e: jax.vmap(search._step_one)(rs, e),
+          rep_state, ev)
 
     p = protocol
     rep_states = search.unflatten_rows(rep_state)   # views into the rows
     live = p.max_live_sends or p.max_sends
     sends = jnp.full((n_pairs, live, p.msg_width), 2**31 - 1, jnp.int32)
 
-    def ins_only(net, s):
-        return jax.vmap(insert_messages)(net, s)
-
-    dt = bench_fn("insert_messages alone", ins_only, rep_states["net"],
-                  sends)
-
-    def canon_only(net):
-        return jax.vmap(canonicalize_net)(net)
-
-    bench_fn("canonicalize_net alone", canon_only, rep_states["net"])
+    timed("insert_messages",
+          lambda net, s: jax.vmap(insert_messages)(net, s),
+          rep_states["net"], sends)
+    timed("canonicalize_net",
+          lambda net: jax.vmap(canonicalize_net)(net),
+          rep_states["net"])
 
     new_t = jnp.full((n_pairs, p.max_sets, 1 + p.timer_width), 2**31 - 1,
                      jnp.int32)
-
-    def app_only(t, nt):
-        return jax.vmap(append_timers)(t, nt)
-
-    bench_fn("append_timers alone", app_only, rep_states["timers"], new_t)
+    timed("append_timers",
+          lambda t, nt: jax.vmap(append_timers)(t, nt),
+          rep_states["timers"], new_t)
 
     from dslabs_tpu.tpu.engine import row_fingerprints
 
-    def fp_only(rs):
-        return row_fingerprints(rs)
-
-    bench_fn("row_fingerprints alone", fp_only, rep_state)
+    timed("row_fingerprints", row_fingerprints, rep_state)
 
     # the in-chunk lexsort
     fp = row_fingerprints(rep_state)
@@ -103,8 +94,7 @@ def main():
             jnp.any(fps[1:] != fps[:-1], axis=1))
         return jnp.zeros_like(valids).at[order].set(first & valids)
 
-    bench_fn("in-chunk lexsort+unique", sort_only, fp,
-             jnp.ones(n_pairs, bool))
+    timed("lexsort_unique", sort_only, fp, jnp.ones(n_pairs, bool))
 
     # predicate flags
     rows_all = jax.vmap(search._step_one)(rep_state, ev)[0]
@@ -118,7 +108,10 @@ def main():
                 out[f"{kind}:{name}"] = jax.vmap(fn)(states)
         return out
 
-    bench_fn("predicate flags alone", flags_only, rows_all)
+    timed("predicate_flags", flags_only, rows_all)
+
+    print()
+    print(render_sites(TEL.summary()))
 
 
 if __name__ == "__main__":

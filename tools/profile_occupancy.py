@@ -1,8 +1,13 @@
 """Measure per-state valid-event occupancy by BFS level on the bench
 config: how many of the net_cap + nn*timer_cap event slots are actually
 deliverable?  Sets the budget for occupancy-compacted enumeration.
-Dev tool, not part of the suite."""
 
+A thin client of the telemetry API (tpu/telemetry.py): each level's
+occupancy scalars become telemetry level records (and flight-log lines
+under ``--flight <path>``) and the chunk work is spanned, replacing the
+old hand-rolled timing scaffold.  Dev tool, not part of the suite."""
+
+import sys
 import time
 
 import jax
@@ -15,9 +20,15 @@ import numpy as np
 from dslabs_tpu.tpu.engine import SENTINEL, timer_deliverable_mask
 from dslabs_tpu.tpu.protocols.paxos import make_paxos_protocol
 from dslabs_tpu.tpu.sharded import ShardedTensorSearch, make_mesh
+from dslabs_tpu.tpu.telemetry import Telemetry, render_sites
 
 
 def main():
+    flight = None
+    if "--flight" in sys.argv:
+        flight = sys.argv[sys.argv.index("--flight") + 1]
+    tel = Telemetry(flight_log=flight, engine_hint="profile_occupancy")
+
     protocol = make_paxos_protocol(n=3, n_clients=2, w=1, max_slots=3,
                                    net_cap=64, timer_cap=6)
     import dataclasses
@@ -26,7 +37,7 @@ def main():
     search = ShardedTensorSearch(
         protocol, mesh, chunk_per_device=256, frontier_cap=1 << 16,
         visited_cap=1 << 22, max_depth=1, strict=False)
-    p = protocol
+    tel.attach(search)      # chunk/promote dispatches become spans
 
     def stats(carry):
         cur, cur_n = carry["cur"], carry["cur_n"][0]
@@ -55,6 +66,7 @@ def main():
         depth = 0
         while max_n > 0 and depth < 24 and time.time() - t0 < 400:
             depth += 1
+            t_lvl = time.time()
             n_chunks = -(-(max_n + search.n_devices - 1) // search.cpd)
             for _ in range(n_chunks):
                 carry = search._chunk_step(carry)
@@ -69,10 +81,29 @@ def main():
             c = np.cumsum(hist)
             p99 = int(np.searchsorted(c, 0.99 * c[-1]))
             p90 = int(np.searchsorted(c, 0.90 * c[-1]))
+            # The occupancy scalars become one telemetry level record
+            # per depth — the report CLI renders the series, and the
+            # live print below is just a view of the same record.
+            rec = {"depth": int(depth),
+                   "wall": round(time.time() - t_lvl, 4),
+                   "explored": int(tot), "unique": int(n),
+                   "next_frontier": int(max_n),
+                   "ev_mean": round(float(mean), 2),
+                   "ev_p90": p90, "ev_p99": p99, "ev_max": int(mx),
+                   "msgs_max": int(mmx), "timers_max": int(tmx),
+                   "drops": int(drops)}
+            tel.on_level("occupancy", rec)
             print(f"lvl {depth:2d} n={int(n):6d} mean={mean:5.1f} "
                   f"p90={p90} p99={p99} max={int(mx)} "
                   f"msgs_max={int(mmx)} tmax={int(tmx)} drops={drops}",
                   flush=True)
+
+    print()
+    print(render_sites(tel.summary()))
+    if flight:
+        print(f"\nflight log: {flight} "
+              f"(python -m dslabs_tpu.tpu.telemetry report {flight})")
+    tel.close()
 
 
 if __name__ == "__main__":
