@@ -60,13 +60,20 @@ capacity-smoke:  ## host-RAM spill tier + capacity-ladder suite on CPU
 
 # obs-smoke = the unified telemetry suite (tests/test_telemetry.py):
 # span-count == dispatch-count on both engines, the zero-added-
-# dispatches/transfers overhead guard, SIGKILL flight-log survival
-# with the in-flight dispatch named, the report-CLI golden sections,
-# supervisor retry/failover event plumbing, and the bench-JSON schema
-# pin for the `telemetry` block + error-with-spans shape (the slow
-# bench run tier-1 skips).  docs/observability.md is the field guide.
-obs-smoke:       ## unified telemetry suite (flight recorder / metrics / reports) on CPU
+# dispatches/transfers overhead guard (per-device lanes + STATUS.json
+# writer enabled), per-device skew lanes on the 8-device mesh, SIGKILL
+# flight-log survival with the in-flight dispatch named, the
+# report-CLI golden sections + --json schema pin, the live-monitor
+# watch view, the bench-ledger compare, supervisor retry/failover
+# event plumbing, and the bench-JSON schema pin for the `telemetry`
+# block + error-with-spans shape (the slow bench run tier-1 skips) —
+# PLUS the CLI end-to-end steps via tools/obs_smoke.py: `telemetry
+# watch --once` on a finished run and `telemetry compare` on a parity
+# ledger and an injected-regression ledger.  docs/observability.md is
+# the field guide.
+obs-smoke:       ## unified telemetry suite (flight recorder / metrics / reports / watch / ledger) on CPU
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m obs -p no:cacheprovider
+	JAX_PLATFORMS=cpu $(PY) tools/obs_smoke.py
 
 dryrun:          ## multi-chip sharding dry run on a virtual CPU mesh
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

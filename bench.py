@@ -112,11 +112,40 @@ _T0 = time.time()
 # DSLABS_BENCH_FLIGHT, so a SIGKILLed/wedged child still leaves its
 # last dispatches on disk and the error JSON can name the in-flight
 # dispatch instead of one scraped stderr line (the BENCH_r05 mystery).
-_RUNDIR = os.environ.get("DSLABS_BENCH_RUNDIR", "/tmp/dslabs_bench")
+_RUNDIR_REQUESTED = os.environ.get("DSLABS_BENCH_RUNDIR",
+                                   "/tmp/dslabs_bench")
+_RUNDIR_STATE = {"path": None, "substituted": False}
 
 # Structured wedge diagnostics collected by _sub on phase failure;
 # attached to the last-line JSON as "wedge_diagnostics" by _emit.
 _DIAGNOSTICS = []
+
+
+def _rundir() -> str:
+    """The run directory, PROVEN writable.  When the requested dir
+    cannot be created or written (read-only FS, permission error) the
+    bench falls back to a fresh tempdir instead of silently losing
+    every phase's flight log — the substitution is noted in the
+    last-line JSON, and wedge diagnostics on a dead phase keep
+    working (they read the flight tail from the actual dir)."""
+    if _RUNDIR_STATE["path"]:
+        return _RUNDIR_STATE["path"]
+    path = _RUNDIR_REQUESTED
+    try:
+        os.makedirs(path, exist_ok=True)
+        probe = os.path.join(path, f".probe.{os.getpid()}")
+        with open(probe, "w") as f:
+            f.write("ok")
+        os.remove(probe)
+    except OSError:
+        import tempfile
+
+        path = tempfile.mkdtemp(prefix="dslabs_bench_")
+        _RUNDIR_STATE["substituted"] = True
+        _hb(f"run dir {_RUNDIR_REQUESTED!r} unwritable — flight logs "
+            f"fall back to {path}")
+    _RUNDIR_STATE["path"] = path
+    return path
 
 
 def _phase_telemetry(label: str):
@@ -126,12 +155,13 @@ def _phase_telemetry(label: str):
 
     path = os.environ.get("DSLABS_BENCH_FLIGHT")
     if not path:
-        os.makedirs(_RUNDIR, exist_ok=True)
-        path = os.path.join(_RUNDIR, f"{label}.flight.jsonl")
+        path = os.path.join(_rundir(), f"{label}.flight.jsonl")
     try:
         os.remove(path)     # stale spans must not pollute this run
     except OSError:
         pass
+    # Telemetry itself degrades to RAM-only recording if even this
+    # path is unwritable (summary() then carries flight_error).
     return Telemetry(flight_log=path, engine_hint=label)
 
 
@@ -160,7 +190,7 @@ def _note_phase_telemetry(result: dict, label: str, phase) -> None:
     if not t:
         return
     result.setdefault(
-        "telemetry", {"run_dir": _RUNDIR, "phases": {}})[
+        "telemetry", {"run_dir": _rundir(), "phases": {}})[
         "phases"][label] = t
 
 
@@ -645,8 +675,12 @@ def _sub(args, child_budget: float, label: str,
         sys.stderr.flush()
 
     try:
-        os.makedirs(_RUNDIR, exist_ok=True)
-        flight = os.path.join(_RUNDIR, f"{label}.flight.jsonl")
+        flight = os.path.join(_rundir(), f"{label}.flight.jsonl")
+        # Live-monitor hint (ISSUE 8 satellite): any terminal can tail
+        # this phase — depth/rate/skew plus the in-flight dispatch —
+        # while it runs, or post-mortem after a kill.
+        _hb(f"phase {label}: watch with `python -m "
+            f"dslabs_tpu.tpu.telemetry watch {_rundir()}`")
         env = dict(os.environ, DSLABS_LEVEL_TIMING="1",
                    DSLABS_BENCH_FLIGHT=flight)
         proc = subprocess.Popen(
@@ -714,6 +748,30 @@ def _store_cal_cache(cal) -> None:
 _EMITTED = False
 
 
+def _ledger_path() -> str:
+    return (os.environ.get("DSLABS_BENCH_LEDGER")
+            or os.path.join(_rundir(), "BENCH_HISTORY.jsonl"))
+
+
+def _append_ledger(result: dict) -> None:
+    """Cross-run bench ledger (ISSUE 8): every run's last-line JSON —
+    telemetry summaries included — appends to BENCH_HISTORY.jsonl, so
+    the BENCH_r0N trajectory is a queryable artifact
+    (`python -m dslabs_tpu.tpu.telemetry compare <ledger>` diffs the
+    latest run against the best prior run per phase).  Never fatal —
+    the ledger is an artifact, not a dependency."""
+    try:
+        from dslabs_tpu.tpu import telemetry as tel_mod
+
+        path = _ledger_path()
+        if tel_mod.append_ledger(
+                path, dict(result, t="bench",
+                           ts=round(time.time(), 1))) is not None:
+            result["ledger"] = path
+    except Exception:  # noqa: BLE001 — the JSON line must still print
+        pass
+
+
 def _emit(result: dict) -> None:
     """Print THE one JSON line (idempotent: the signal handler and the
     normal path can both reach here; only the first wins)."""
@@ -725,6 +783,13 @@ def _emit(result: dict) -> None:
         # Every dead phase's last heartbeat + flight-recorder spans
         # ride the error JSON (ISSUE-7 satellite; schema-pinned).
         result["wedge_diagnostics"] = _DIAGNOSTICS
+    if _RUNDIR_STATE["substituted"]:
+        # The run-dir fallback substitution is never silent: graders
+        # reading the JSON learn where the flight logs actually are.
+        result["run_dir_substituted"] = {
+            "requested": _RUNDIR_REQUESTED,
+            "actual": _RUNDIR_STATE["path"]}
+    _append_ledger(result)
     print(json.dumps(result))
     sys.stdout.flush()
 

@@ -76,7 +76,7 @@ __all__ = ["FORMAT_VERSION", "CheckpointMismatch", "CheckpointCorrupt",
            "SearchCheckpoint", "config_fingerprint", "save", "load",
            "peek_fingerprint", "peek_depth", "AsyncCheckpointWriter",
            "default_compile_cache_dir", "default_flight_log",
-           "run_dir_layout"]
+           "default_status_path", "run_dir_layout"]
 
 
 def default_compile_cache_dir(checkpoint_path) -> "Optional[str]":
@@ -106,6 +106,18 @@ def default_flight_log(checkpoint_path) -> "Optional[str]":
         "flight.jsonl")
 
 
+def default_status_path(checkpoint_path) -> "Optional[str]":
+    """The live-monitor convention (tpu/telemetry.py): an atomic
+    ``STATUS.json`` beside the dump, rewritten at level boundaries so
+    ``telemetry watch <run-dir>`` can render the run from another
+    process.  ``None`` when no checkpoint is configured."""
+    if not checkpoint_path:
+        return None
+    return os.path.join(
+        os.path.dirname(os.path.abspath(checkpoint_path)),
+        "STATUS.json")
+
+
 def run_dir_layout(checkpoint_path) -> dict:
     """Everything a checkpointed run keeps in its directory — the one
     place the layout is defined (docs/observability.md):
@@ -113,12 +125,14 @@ def run_dir_layout(checkpoint_path) -> dict:
       checkpoint        the atomic .npz dump (+ ``.prev`` rotation)
       compile_cache     persistent XLA compile cache (tpu/compile_cache)
       flight_log        telemetry flight recorder (tpu/telemetry.py)
+      status            live-monitor STATUS.json (telemetry watch)
     """
     return {
         "checkpoint": checkpoint_path,
         "prev": (checkpoint_path + ".prev") if checkpoint_path else None,
         "compile_cache": default_compile_cache_dir(checkpoint_path),
         "flight_log": default_flight_log(checkpoint_path),
+        "status": default_status_path(checkpoint_path),
     }
 
 

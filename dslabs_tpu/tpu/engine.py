@@ -1510,6 +1510,7 @@ class TensorSearch:
                 "(per-level trace spills cannot be rebuilt from a "
                 "checkpoint); rerun without record_trace")
         self._levels = []
+        self._host_prev_explored = 0
         if ck is not None:
             # Resume at the checkpointed level boundary: the visited SET
             # comes back from the dumped 128-bit keys, the frontier from
@@ -1664,12 +1665,22 @@ class TensorSearch:
             keep_idx = np.nonzero(expand)[0]
             tel = getattr(self, "_telemetry", None)
             if tel is not None:
+                from dslabs_tpu.tpu import telemetry as tel_mod
+
+                delta = [explored - getattr(self, "_host_prev_explored",
+                                            0)]
+                self._host_prev_explored = explored
                 tel.on_level("host", {
                     "depth": depth,
                     "wall": round(time.time() - t_lvl, 4),
                     "explored": explored,
                     "unique": int(len(visited[0])),
-                    "next_frontier": int(len(keep_idx))})
+                    "next_frontier": int(len(keep_idx)),
+                    "per_device": {
+                        "explored": delta,
+                        "frontier": [int(len(keep_idx))],
+                        "load_factor": [0.0], "drops": [0]},
+                    "skew": {"explored": tel_mod.skew_metrics(delta)}})
             # lvl_states rows align 1:1 with h1/h2/rows concatenation.
             all_rows = (np.concatenate(lvl_states, axis=0)
                         if len(lvl_states) > 1 else lvl_states[0])
@@ -2171,16 +2182,30 @@ class TensorSearch:
                     f"{p.name}: visited table > 75% full "
                     f"({vis_n}/{self.visited_cap}) at depth {depth}; "
                     "raise visited_cap")
+            prev_explored = last[0]
             last = (explored, vis_n, vis_over)
             tel = getattr(self, "_telemetry", None)
             if tel is not None:
+                from dslabs_tpu.tpu import telemetry as tel_mod
+
                 # Fed from the wave's fused stats vector — scalars this
-                # loop just read anyway (zero extra transfers).
+                # loop just read anyway (zero extra transfers).  The
+                # per-device lanes are length-1 on the single-device
+                # engine but keep the mesh-scope record shape uniform
+                # (report heatmap / STATUS.json / skew gauges).
+                delta = [explored - prev_explored]
                 tel.on_level("device", {
                     "depth": depth,
                     "wall": round(time.time() - t_wave, 4),
                     "explored": explored, "unique": vis_n,
-                    "next_frontier": int(nxt_n)})
+                    "next_frontier": int(nxt_n),
+                    "load_factor": round(vis_n / self.visited_cap, 4),
+                    "per_device": {
+                        "explored": delta, "frontier": [int(nxt_n)],
+                        "load_factor": [round(vis_n / self.visited_cap,
+                                              4)],
+                        "drops": [0]},
+                    "skew": {"explored": tel_mod.skew_metrics(delta)}})
             self._last_dev_carry = carry
             if flag_counts.any():
                 return self._dev_terminal(carry, flag_counts, explored,

@@ -260,6 +260,16 @@ class ShardedTensorSearch(TensorSearch):
                 ], jnp.int32),
                 jnp.sum(carry["flag_cnt"].reshape(self.n_devices, nf),
                         axis=0).astype(jnp.int32),
+                # Per-device stats lanes (ISSUE 8): the pre-reduction
+                # per-device scalars ride the SAME readback vector —
+                # [explored×D, vis_n×D, nxt_n×D, drops×D], always the
+                # LAST 4D slots of either driver's layout — so shard
+                # skew / table load / frontier occupancy per device
+                # cost zero extra transfers.
+                carry["explored"].astype(jnp.int32),
+                carry["vis_n"].astype(jnp.int32),
+                carry["nxt_n"].astype(jnp.int32),
+                carry["drops"].astype(jnp.int32),
             ])
 
         self._stats = jax.jit(stats)
@@ -628,11 +638,19 @@ class ShardedTensorSearch(TensorSearch):
             tail = jnp.stack([remaining, steps]).astype(jnp.int32)
             parts = [core, flags, tail]
             if spill_on:
-                # Spill abort code LAST so every legacy index parse is
-                # untouched; the abort is global, so any device's copy
-                # is the fleet's (pmax for robustness).
+                # Spill abort code after the tail so every legacy index
+                # parse is untouched; the abort is global, so any
+                # device's copy is the fleet's (pmax for robustness).
                 parts.append(jax.lax.pmax(
                     c["f_full"], ax).astype(jnp.int32))
+            # Per-device stats lanes (ISSUE 8), LAST so all absolute
+            # index parses above stay valid: one all_gather inside the
+            # SAME fused program — the replicated stats vector simply
+            # grows by 4D int32s, never an extra dispatch or readback.
+            per_dev = jnp.stack([c["explored"][0], c["vis_n"][0],
+                                 c["nxt_n"][0], c["drops"][0]])
+            parts.append(jax.lax.all_gather(
+                per_dev, ax).T.reshape(-1).astype(jnp.int32))
             return jnp.concatenate(parts)
 
         def super_local(carry, budget, masks=None):
@@ -1371,6 +1389,7 @@ class ShardedTensorSearch(TensorSearch):
         # SearchOutcome.levels; DSLABS_LEVEL_TIMING pretty-prints the
         # same records to stderr as they land.
         self._level_records: List[dict] = []
+        self._pd_prev_explored = [0] * self.n_devices
         self._root_fp = tuple(np.asarray(
             state_fingerprints(state), np.uint32)[0].tolist())
         if check_initial:
@@ -1489,7 +1508,7 @@ class ShardedTensorSearch(TensorSearch):
                         chunks += ch2
                         if out is not None:
                             return out
-                self._level_records.append({
+                rec = {
                     "depth": depth, "chunks": int(chunks),
                     "wall": round(time.time() - t_lvl, 4),
                     "explored": int(explored), "unique": int(vis_total),
@@ -1498,8 +1517,46 @@ class ShardedTensorSearch(TensorSearch):
                     # satellite): pressure is visible in bench JSON
                     # before the overflow contract can fire.
                     "load_factor": round(
-                        getattr(self, "_last_load", 0.0), 4)})
+                        getattr(self, "_last_load", 0.0), 4)}
+                # Mesh-scope lanes (ISSUE 8): the pre-psum per-device
+                # scalars the fused stats vector already carried, plus
+                # skew metrics — what the owner-hashed all_to_all
+                # design is decided on (ROADMAP #1).  Explored is
+                # cumulative per device, so the level's work share is
+                # the delta against the previous level sync.
+                pdev = getattr(self, "_last_per_device", None)
+                if pdev is not None:
+                    from dslabs_tpu.tpu import telemetry as tel_mod
+
+                    prev = getattr(self, "_pd_prev_explored",
+                                   [0] * self.n_devices)
+                    delta = [e - p for e, p in zip(pdev["explored"],
+                                                   prev)]
+                    self._pd_prev_explored = list(pdev["explored"])
+                    rec["per_device"] = {
+                        "explored": delta,
+                        "frontier": pdev["frontier"],
+                        "load_factor": [round(v / self.v_cap, 4)
+                                        for v in pdev["vis_n"]],
+                        "drops": pdev["drops"]}
+                    rec["skew"] = {
+                        "explored": tel_mod.skew_metrics(delta),
+                        "frontier": tel_mod.skew_metrics(
+                            pdev["frontier"])}
                 tel = getattr(self, "_telemetry", None)
+                if tel is not None:
+                    # Host-side HBM high-water per device, polled via
+                    # the runtime's memory stats at level boundaries
+                    # ONLY (a host syscall — never a device dispatch
+                    # or readback; CPU meshes report nothing and the
+                    # lane is omitted).
+                    from dslabs_tpu.tpu import telemetry as tel_mod
+
+                    hbm = tel_mod.device_memory_stats(
+                        self.mesh.devices.flat)
+                    if hbm is not None:
+                        rec["hbm_peak"] = hbm
+                self._level_records.append(rec)
                 if tel is not None:
                     # The SAME host scalars the fused stats readback
                     # already delivered — telemetry adds no transfers.
@@ -1804,6 +1861,16 @@ class ShardedTensorSearch(TensorSearch):
         (overflow, drops, vis_over, explored, vis_max, vis_total, nxt_max,
          j_done) = (int(x) for x in s[:8])
         flag_counts = s[8:8 + nf]
+        # Per-device stats lanes: the LAST 4D slots of either driver's
+        # layout (superstep appends them after the tail/f_full slots,
+        # the legacy stats program after the flags) — stashed for the
+        # level record's skew derivation, same readback as everything
+        # above.
+        D = self.n_devices
+        pd = [int(x) for x in s[len(s) - 4 * D:]]
+        self._last_per_device = {
+            "explored": pd[:D], "vis_n": pd[D:2 * D],
+            "frontier": pd[2 * D:3 * D], "drops": pd[3 * D:]}
         # Running total for outcome plumbing (SearchOutcome
         # .visited_overflow): keys the full table degraded to
         # treat-as-fresh — sound, but unique counts may over-report.

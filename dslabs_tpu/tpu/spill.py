@@ -333,13 +333,22 @@ class SpillManager:
         if len(rows):
             self.stats.respilled_frontier += len(rows)
             self.spool_next.push(rows)
+            if self.telemetry is not None:
+                # Live-monitor feed (STATUS.json "spill" block): the
+                # tier/spool sizes a watcher reads to see how deep the
+                # capacity detour currently is.
+                self.telemetry.event(
+                    "spill_spool", rows=len(rows),
+                    spool_rows=self.spool_next.rows(),
+                    tier=len(self.tier))
 
     def pop_current(self) -> Optional[np.ndarray]:
         seg = self.spool_cur.pop()
         if seg is not None:
             self.stats.reinjections += 1
             if self.telemetry is not None:
-                self.telemetry.event("spill_reinject", rows=len(seg))
+                self.telemetry.event("spill_reinject", rows=len(seg),
+                                     tier=len(self.tier))
         return seg
 
     def advance_level(self) -> None:

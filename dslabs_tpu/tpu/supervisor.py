@@ -831,6 +831,13 @@ class SearchSupervisor:
             lanes[name] = out
             if out.end_condition in _TERMINAL:
                 lanes.setdefault("winner", name)
+                if self.telemetry is not None:
+                    # The live monitor's "current lane" feed: a
+                    # portfolio watcher sees which lane won, not just
+                    # that SOMETHING returned (tpu/telemetry.py
+                    # STATUS.json).
+                    self.telemetry.event("lane_winner", lane=name,
+                                         end=out.end_condition)
                 cancel.set()
 
         def bfs_lane():
@@ -867,6 +874,8 @@ class SearchSupervisor:
             except BaseException as e:  # noqa: BLE001 — re-raised below
                 lanes["swarm_err"] = e
 
+        if self.telemetry is not None:
+            self.telemetry.event("lane", lanes="bfs+swarm")
         threads = [threading.Thread(target=bfs_lane, daemon=True,
                                     name="dslabs-portfolio-bfs"),
                    threading.Thread(target=swarm_lane, daemon=True,

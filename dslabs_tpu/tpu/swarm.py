@@ -605,8 +605,17 @@ class SwarmSearch(TensorSearch):
                 ps(c["over"][0]), ps(c["vis_over"][0]),
                 jax.lax.pmax(c["deepest"][0], ax), k,
             ]).astype(jnp.int32)
-            return jnp.concatenate([core,
-                                    ps(c["hit_cnt"]).astype(jnp.int32)])
+            # Per-device stats lanes (ISSUE 8): the pre-psum per-device
+            # scalars ride the SAME readback, LAST so every absolute
+            # index parse stays valid — [explored×D, fresh×D,
+            # restarts×D, deepest×D], one all_gather in the fused round
+            # program, zero extra dispatches or transfers.
+            per_dev = jnp.stack([c["explored"][0], c["fresh"][0],
+                                 c["restarts"][0], c["deepest"][0]])
+            return jnp.concatenate([
+                core, ps(c["hit_cnt"]).astype(jnp.int32),
+                jax.lax.all_gather(per_dev, ax).T.reshape(-1)
+                .astype(jnp.int32)])
 
         def round_local(carry, budget, masks=None):
             def cond(st):
@@ -904,6 +913,7 @@ class SwarmSearch(TensorSearch):
         self.compile_secs += time.time() - t_c
         t0 = time.time() - prev_elapsed
         stats = None
+        self._pd_prev_explored = [0] * self.n_devices
         while True:
             cancelled = self._cancelled()
             timed_out = (self.max_secs is not None
@@ -922,14 +932,36 @@ class SwarmSearch(TensorSearch):
             stats = np.asarray(stats)
             tel = getattr(self, "_telemetry", None)
             if tel is not None:
+                from dslabs_tpu.tpu import telemetry as tel_mod
+
                 # Fed from the round's fused stats vector — the same
                 # scalars this loop reads anyway (zero extra syncs).
-                tel.on_level("swarm", {
+                rec = {
                     "depth": rounds,
                     "wall": round(time.time() - t_round, 4),
                     "explored": int(stats[0]), "unique": int(stats[1]),
                     "next_frontier": 0, "deepest": int(stats[6]),
-                    "restarts": int(stats[3])})
+                    "restarts": int(stats[3])}
+                # Per-device lanes off the SAME readback (the 4D tail
+                # stats_local appends): walker-work share per device is
+                # the per-round explored delta.
+                D = self.n_devices
+                pd = [int(x) for x in stats[len(stats) - 4 * D:]]
+                prev = getattr(self, "_pd_prev_explored", [0] * D)
+                delta = [e - p for e, p in zip(pd[:D], prev)]
+                self._pd_prev_explored = pd[:D]
+                rec["per_device"] = {
+                    "explored": delta, "unique": pd[D:2 * D],
+                    "restarts": pd[2 * D:3 * D],
+                    "deepest": pd[3 * D:]}
+                rec["skew"] = {
+                    "explored": tel_mod.skew_metrics(delta),
+                    "unique": tel_mod.skew_metrics(pd[D:2 * D])}
+                hbm = tel_mod.device_memory_stats(
+                    self.mesh.devices.flat)
+                if hbm is not None:
+                    rec["hbm_peak"] = hbm
+                tel.on_level("swarm", rec)
             vis_over = int(stats[5])
             over = int(stats[4])
             # Early-warning instrumentation (ISSUE 6 satellite): the

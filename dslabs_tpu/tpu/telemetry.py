@@ -45,8 +45,38 @@ Pieces:
   throughput series, per-site dispatch-latency percentiles, the
   retry/failover/heartbeat timeline, spill and overflow counts, the
   compile-vs-search wall split, and the in-flight dispatch of a torn
-  tail.  docs/observability.md documents the span model and the
-  "diagnosing a wedge" recipe rides it (docs/resilience.md).
+  tail.  ``report --json`` emits the same structure machine-readable
+  (one schema shared with the grading scripts and the ledger compare
+  path; pinned by test).  docs/observability.md documents the span
+  model and the "diagnosing a wedge" recipe rides it
+  (docs/resilience.md).
+
+* **Per-device skew (mesh scope).**  The sharded / swarm engines keep
+  their pre-``psum`` per-device scalars in the SAME fused stats
+  readback (frontier occupancy, visited-table load, states expanded,
+  capacity drops — see sharded.py ``stats_local``), so per-level
+  records carry ``per_device`` lanes and :func:`skew_metrics`
+  (max/mean imbalance + coefficient of variation) at zero added
+  transfers.  ``on_level`` feeds them to the registry and warns past
+  ``DSLABS_SKEW_WARN``; the report CLI renders a per-device ×
+  per-level heatmap.  These are the numbers the owner-hashed
+  ``all_to_all`` design (ROADMAP #1) is decided on.
+
+* **Live run monitor.**  A recorder with a run dir atomically rewrites
+  ``STATUS.json`` (depth, rate, skew, spill tier, last span, current
+  rung/lane, in-flight dispatch) at level/event boundaries —
+  ``python -m dslabs_tpu.tpu.telemetry watch <run-dir>`` tails it plus
+  the flight log to render a live terminal view of ANY run, including
+  a warden child or a bench phase in another process, and survives
+  the run being SIGKILLed mid-level (atomic replace = never torn;
+  the flight tail names the in-flight dispatch).
+
+* **Cross-run bench ledger.**  bench.py appends each run's last-line
+  JSON to ``BENCH_HISTORY.jsonl`` (:func:`append_ledger`);
+  ``telemetry compare <ledger>`` diffs the latest run against the
+  best prior run per phase and flags regressions past
+  ``DSLABS_BENCH_REGRESS_PCT`` — the BENCH_r0N trajectory as a
+  queryable artifact instead of loose files.
 
 Thread-safe (the portfolio runs two lanes against one recorder); pure
 host-side Python + stdlib — importing this module never imports jax.
@@ -56,6 +86,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import math
 import os
 import threading
 import time
@@ -64,11 +95,78 @@ from typing import Dict, List, Optional
 
 __all__ = ["Telemetry", "MetricsRegistry", "Counter", "Gauge",
            "Histogram", "read_flight", "tail_records", "build_report",
-           "render_report", "render_sites", "main"]
+           "render_report", "render_sites", "skew_metrics",
+           "device_memory_stats", "default_status_path", "load_status",
+           "render_watch", "append_ledger", "read_ledger",
+           "compare_ledger", "render_compare", "main"]
 
 # Hot-loop sites whose steady-state dispatches are worth a profiler
 # capture (the compile-paying first dispatch at a site is skipped).
 _PROFILE_SITES = ("superstep", "step", "round", "expand")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def skew_metrics(values) -> dict:
+    """Shard-skew summary of one per-device lane: the slowest-device
+    ratio (``imbalance`` = max/mean — 1.0 is a perfectly balanced
+    mesh, D is one device doing all the work) and the coefficient of
+    variation.  Pure host math over scalars the level sync already
+    read; shared by the engines (per-level records), ``on_level``
+    (registry + warning), and the report heatmap."""
+    vals = [float(v) for v in values]
+    n = len(vals)
+    if not n:
+        return {"max": 0, "mean": 0.0, "imbalance": 1.0, "cv": 0.0}
+    mean = sum(vals) / n
+    mx = max(vals)
+    if mean <= 0:
+        return {"max": mx, "mean": round(mean, 3),
+                "imbalance": 1.0, "cv": 0.0}
+    var = sum((v - mean) ** 2 for v in vals) / n
+    return {"max": mx, "mean": round(mean, 3),
+            "imbalance": round(mx / mean, 4),
+            "cv": round(math.sqrt(var) / mean, 4)}
+
+
+def device_memory_stats(devices) -> Optional[List[int]]:
+    """Per-device HBM high-water (``peak_bytes_in_use``), polled
+    host-side via the runtime's memory stats — never a device
+    dispatch.  ``None`` when the backend does not report (CPU meshes):
+    callers simply omit the lane."""
+    out = []
+    for d in devices:
+        try:
+            ms = d.memory_stats() or {}
+        except Exception:  # noqa: BLE001 — absence of stats is normal
+            return None
+        out.append(int(ms.get("peak_bytes_in_use",
+                              ms.get("bytes_in_use", 0))))
+    return out if any(out) else None
+
+
+def default_status_path(flight_log: Optional[str]) -> Optional[str]:
+    """The live-monitor file that pairs with a flight log: the run-dir
+    convention is ``STATUS.json`` beside ``flight.jsonl``
+    (checkpoint.run_dir_layout); a named phase log
+    (``<phase>.flight.jsonl``, the bench layout) gets
+    ``<phase>.STATUS.json`` so concurrent phases in one dir never
+    clobber each other."""
+    if not flight_log:
+        return None
+    d = os.path.dirname(os.path.abspath(flight_log))
+    base = os.path.basename(flight_log)
+    if base == "flight.jsonl":
+        return os.path.join(d, "STATUS.json")
+    for suffix in (".flight.jsonl", ".jsonl"):
+        if base.endswith(suffix):
+            return os.path.join(d, base[:-len(suffix)] + ".STATUS.json")
+    return os.path.join(d, base + ".STATUS.json")
 
 
 # ------------------------------------------------------------- registry
@@ -232,7 +330,8 @@ class Telemetry:
 
     def __init__(self, flight_log: Optional[str] = None,
                  ring: Optional[int] = None,
-                 engine_hint: Optional[str] = None):
+                 engine_hint: Optional[str] = None,
+                 status_path: Optional[str] = None):
         if ring is None:
             try:
                 ring = int(os.environ.get("DSLABS_TELEMETRY_RING",
@@ -250,13 +349,33 @@ class Telemetry:
         self._profile = _ProfileWindow()
         self._t0 = time.time()
         self._fh = None
+        self.flight_error: Optional[str] = None
+        # Live-monitor state (STATUS.json): the last level/event/outcome
+        # scalars, atomically rewritten so ``telemetry watch`` in any
+        # other process can render this run.  Derived from the flight
+        # log's location unless given explicitly; None = monitor off.
+        self.status_path = (status_path
+                            or default_status_path(flight_log))
+        self._status_secs = _env_float("DSLABS_STATUS_SECS", 1.0)
+        self._status_last = 0.0
+        self._status: Dict[str, object] = {}
+        self._prev_explored: Dict[str, int] = {}
+        self._open_dispatch: Optional[dict] = None
+        self._warned_skew = False
         if flight_log:
-            d = os.path.dirname(os.path.abspath(flight_log))
-            os.makedirs(d, exist_ok=True)
             # Line-buffered append: each record hits the OS on its own
             # write, so a SIGKILL leaves complete lines (the reader
-            # tolerates one torn tail line).
-            self._fh = open(flight_log, "a", buffering=1)
+            # tolerates one torn tail line).  An unwritable location
+            # (read-only FS — the bench fallback case) degrades to
+            # RAM-only recording, never takes the run down.
+            try:
+                d = os.path.dirname(os.path.abspath(flight_log))
+                os.makedirs(d, exist_ok=True)
+                self._fh = open(flight_log, "a", buffering=1)
+            except OSError as e:
+                self.flight_error = f"{type(e).__name__}: {e}"
+                self.flight_log = None
+                self.status_path = status_path  # only if explicit
         self._write({"t": "meta", "started": round(self._t0, 3),
                      "pid": os.getpid(), "hint": engine_hint})
 
@@ -283,8 +402,44 @@ class Telemetry:
         except (OSError, ValueError):
             self._fh = None           # disk gone / closed: record in RAM only
 
+    def _write_status(self, force: bool = False) -> None:
+        """Atomically rewrite STATUS.json (tmp + ``os.replace``, so a
+        reader — or a SIGKILL — never sees a torn file).  Called with
+        ``self._lock`` held, from the feeds the run already makes:
+        level boundaries, recovery events, outcomes, and (throttled by
+        ``DSLABS_STATUS_SECS``) dispatch begin markers.  Pure host
+        file IO — never a device dispatch or readback; failures
+        disable the monitor, never the run."""
+        if self.status_path is None:
+            return
+        now = time.time()
+        if not force and now - self._status_last < self._status_secs:
+            return
+        self._status_last = now
+        last_span = self.ring[-1] if self.ring else None
+        st = {
+            "t": "status", "pid": os.getpid(),
+            "hint": self.engine_hint,
+            "updated": round(now, 3),
+            "uptime": round(now - self._t0, 1),
+            "spans": sum(self._counts.values()),
+            "levels": len(self.levels),
+            "last_span": last_span,
+            "in_flight": self._open_dispatch,
+            "flight_log": self.flight_log,
+            **self._status,
+        }
+        tmp = self.status_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                f.write(json.dumps(st))
+            os.replace(tmp, self.status_path)
+        except OSError:
+            self.status_path = None
+
     def close(self) -> None:
         with self._lock:
+            self._write_status(force=True)
             if self._fh is not None:
                 try:
                     self._fh.close()
@@ -320,6 +475,8 @@ class Telemetry:
                  "i": idx, "depth": depth}
         with self._lock:
             self._write(start)
+            self._open_dispatch = start
+            self._write_status()
         self._profile.on_start(site)
         t0 = time.time()
         outcome = "ok"
@@ -343,6 +500,7 @@ class Telemetry:
             with self._lock:
                 self.ring.append(span)
                 self._write(span)
+                self._open_dispatch = None
                 self.registry.counter(f"dispatches.{engine}").inc()
                 self.registry.histogram(f"dispatch_secs.{tag}").observe(
                     wall)
@@ -361,8 +519,11 @@ class Telemetry:
         with self._lock:
             idx = self._counts.get(engine, 0)
             self._counts[engine] = idx + 1
-            self._write({"t": "dispatch", "ts": self._ts(), "tag": tag,
-                         "i": idx, "depth": 0})
+            start = {"t": "dispatch", "ts": self._ts(), "tag": tag,
+                     "i": idx, "depth": 0}
+            self._write(start)
+            self._open_dispatch = start
+            self._write_status()
         t0 = time.time()
         outcome = "ok"
         try:
@@ -379,6 +540,7 @@ class Telemetry:
             with self._lock:
                 self.ring.append(span)
                 self._write(span)
+                self._open_dispatch = None
                 self.registry.counter(f"dispatches.{engine}").inc()
                 self.registry.histogram(f"dispatch_secs.{tag}").observe(
                     wall)
@@ -393,6 +555,24 @@ class Telemetry:
             self.events.append(rec)
             self._write(rec)
             self.registry.counter(f"events.{kind}").inc()
+            # Live-monitor feeds: the current ladder rung / portfolio
+            # lane and the spill tier's size ride STATUS.json so the
+            # watch view shows where a run IS, not just how fast.
+            if kind in ("rung", "capacity_retry"):
+                self._status["rung"] = {k: v for k, v in rec.items()
+                                        if k not in ("t", "ts")}
+                self._write_status(force=True)
+            elif kind in ("lane", "lane_winner", "failover",
+                          "child_death"):
+                self._status["lane"] = {k: v for k, v in rec.items()
+                                        if k not in ("t", "ts")}
+                self._write_status(force=True)
+            elif kind.startswith("spill"):
+                self._status["spill"] = {k: v for k, v in rec.items()
+                                         if k not in ("t", "ts")}
+                self._write_status()
+            else:
+                self._write_status()
 
     def on_level(self, engine: str, record: dict) -> None:
         """One completed BFS level / wave / swarm round, described by
@@ -400,6 +580,7 @@ class Telemetry:
         paid for (depth, wall, explored, unique, next_frontier, …)."""
         rec = {"t": "level", "ts": self._ts(), "engine": engine,
                **record}
+        skew = rec.get("skew")
         with self._lock:
             self.levels.append(rec)
             self._write(rec)
@@ -416,6 +597,56 @@ class Telemetry:
             if record.get("load_factor") is not None:
                 self.registry.gauge(f"load_factor.{engine}").set(
                     record["load_factor"])
+            # Mesh-scope skew feeds (per-device lanes already in the
+            # record — the engines read them off the SAME fused stats
+            # vector, zero added transfers).
+            if skew:
+                work = skew.get("explored") or next(iter(skew.values()))
+                self.registry.gauge(f"skew.{engine}").set(
+                    work.get("imbalance", 1.0))
+                self.registry.gauge(f"skew_cv.{engine}").set(
+                    work.get("cv", 0.0))
+                self.registry.histogram(
+                    f"skew_imbalance.{engine}").observe(
+                    float(work.get("imbalance", 1.0)))
+            # Live monitor: per-level rate from the explored delta.
+            explored = int(record.get("explored", 0) or 0)
+            delta = explored - self._prev_explored.get(engine, 0)
+            self._prev_explored[engine] = explored
+            wall = float(record.get("wall", 0.0) or 0.0)
+            self._status.update({
+                "engine": engine,
+                "depth": record.get("depth", 0),
+                "explored": explored,
+                "unique": record.get("unique", 0),
+                "rate_per_min": round(delta / wall * 60.0, 1)
+                if wall > 0 else None,
+                "level_wall": wall,
+                "load_factor": record.get("load_factor"),
+                "skew": skew,
+                "per_device": record.get("per_device"),
+            })
+            self._write_status(force=True)
+        if skew:
+            work = skew.get("explored") or next(iter(skew.values()))
+            warn_at = _env_float("DSLABS_SKEW_WARN", 3.0)
+            if (not self._warned_skew
+                    and len(record.get("per_device", {})
+                            .get("explored", ())) > 1
+                    and work.get("mean", 0.0) >= 64
+                    and work.get("imbalance", 1.0) >= warn_at):
+                self._warned_skew = True
+                import warnings
+
+                warnings.warn(
+                    f"shard skew: slowest-device imbalance "
+                    f"{work['imbalance']:.2f}x (cv {work['cv']:.2f}) "
+                    f"at depth {record.get('depth')} on engine "
+                    f"{engine} (>= DSLABS_SKEW_WARN={warn_at}) — the "
+                    "mesh is load-imbalanced; see the per-device "
+                    "heatmap in `telemetry report` and "
+                    "docs/observability.md",
+                    RuntimeWarning, stacklevel=3)
 
     # Outcome scalars worth a gauge + the outcome record (all plain
     # host ints the verdict already carries).
@@ -444,6 +675,8 @@ class Telemetry:
                 rec["compile_secs"])
             self._write(rec)
             self.events.append(rec)
+            self._status["end_condition"] = out.end_condition
+            self._write_status(force=True)
 
     # ------------------------------------------------------------ summary
 
@@ -459,7 +692,7 @@ class Telemetry:
             events = {name[len("events."):]: c.value
                       for name, c in self.registry.counters.items()
                       if name.startswith("events.")}
-            return {
+            out = {
                 "spans": sum(self._counts.values()),
                 "dispatches": dict(self._counts),
                 "sites": sites,
@@ -467,6 +700,14 @@ class Telemetry:
                 "levels": len(self.levels),
                 "flight_log": self.flight_log,
             }
+            if self.status_path:
+                out["status"] = self.status_path
+            if self.flight_error:
+                out["flight_error"] = self.flight_error
+            sk = self._status.get("skew")
+            if sk:
+                out["skew"] = sk
+            return out
 
 
 # ------------------------------------------------------- flight reading
@@ -626,6 +867,43 @@ def render_report(report: dict, source: str = "") -> str:
                 f"{lv.get('next_frontier', 0):10d} "
                 f"{lv.get('rate', 0.0):10.1f}")
 
+    # Per-device × per-level heatmap (mesh scope): only rendered when
+    # the level records carry per_device lanes (sharded/swarm engines).
+    # Rows start with 'd' — the throughput rows above are the only
+    # digit-leading rows, which the golden test counts.
+    heat_engines = [e for e in sorted(report["series"])
+                    if any(lv.get("per_device")
+                           for lv in report["series"][e])]
+    if heat_engines:
+        ramp = " .:-=+*#%@"
+        out.append("")
+        out.append("-- per-device skew (explored share per level) --")
+        for eng in heat_engines:
+            lvs = [lv for lv in report["series"][eng]
+                   if lv.get("per_device")]
+            n_dev = max(len(lv["per_device"].get("explored", ()))
+                        for lv in lvs)
+            out.append(f"[engine {eng}] devices 0..{n_dev - 1}; "
+                       "each cell = device share of the level's "
+                       "expanded states")
+            for lv in lvs:
+                lane = lv["per_device"].get("explored", [])
+                mx = max(max(lane, default=0), 1)
+                cells = "".join(
+                    ramp[min(len(ramp) - 1,
+                             int(round(v / mx * (len(ramp) - 1))))]
+                    for v in lane)
+                sk = (lv.get("skew") or {}).get("explored", {})
+                out.append(
+                    f"d{lv.get('depth', 0):4d} |{cells}| "
+                    f"imb={sk.get('imbalance', 1.0):5.2f} "
+                    f"cv={sk.get('cv', 0.0):5.2f}")
+            hbms = [lv for lv in lvs if lv.get("hbm_peak")]
+            if hbms:
+                peak = hbms[-1]["hbm_peak"]
+                out.append("hbm peak bytes/device: "
+                           + " ".join(f"{b:.2e}" for b in peak))
+
     out.append("")
     out.append("-- recovery timeline --")
     if not report["timeline"]:
@@ -681,18 +959,274 @@ def render_sites(summary: dict) -> str:
     return "\n".join(out)
 
 
+# ----------------------------------------------------- live run monitor
+
+def _resolve_status(path: str) -> Optional[str]:
+    """STATUS.json for a run dir (or a direct path): ``STATUS.json``
+    first (the checkpoint run-dir convention), else the newest
+    ``*.STATUS.json`` (the bench per-phase convention)."""
+    if os.path.isdir(path):
+        cand = os.path.join(path, "STATUS.json")
+        if os.path.exists(cand):
+            return cand
+        stats = sorted(
+            (os.path.join(path, f) for f in os.listdir(path)
+             if f.endswith("STATUS.json")),
+            key=lambda p: os.path.getmtime(p))
+        return stats[-1] if stats else None
+    return path if path.endswith(".json") else None
+
+
+def load_status(path: Optional[str]) -> Optional[dict]:
+    """Read a STATUS.json; never raises (the writer's atomic replace
+    means a well-formed file or nothing, but the run dir may predate
+    the monitor entirely)."""
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            return json.loads(f.read())
+    except (OSError, ValueError):
+        return None
+
+
+def render_watch(path: str, now: Optional[float] = None) -> str:
+    """One frame of the live monitor, from the run dir ALONE: the
+    atomic STATUS.json (depth / rate / skew / spill / rung) plus the
+    flight log's tail (last span; the in-flight dispatch of a torn
+    tail — a SIGKILLed run stays attributable)."""
+    now = time.time() if now is None else now
+    out: List[str] = [f"== dslabs live monitor: {path} =="]
+    st = load_status(_resolve_status(path))
+    if st is None:
+        out.append("(no STATUS.json yet — run predates the monitor, "
+                   "or died before its first level)")
+    else:
+        age = now - float(st.get("updated", now))
+        stale = " !! STALE (run dead or wedged?)" if age > 15 else ""
+        out.append(f"status: pid {st.get('pid')} "
+                   f"hint={st.get('hint')} "
+                   f"updated {age:.1f}s ago{stale}")
+        rate = st.get("rate_per_min")
+        out.append(
+            f"engine {st.get('engine', '?')}  "
+            f"depth {st.get('depth', 0)}  "
+            f"unique {st.get('unique', 0)}  "
+            f"explored {st.get('explored', 0)}  "
+            f"rate {rate if rate is not None else '?'} states/min")
+        sk = st.get("skew") or {}
+        if sk:
+            parts = [f"{lane} imb={m.get('imbalance', 1.0):.2f} "
+                     f"cv={m.get('cv', 0.0):.2f}"
+                     for lane, m in sorted(sk.items())]
+            out.append("skew: " + " | ".join(parts))
+        pd = st.get("per_device") or {}
+        if pd.get("frontier") is not None:
+            out.append("per-device frontier: "
+                       + " ".join(str(v) for v in pd["frontier"]))
+        if st.get("load_factor") is not None:
+            out.append(f"visited load factor: {st['load_factor']}")
+        if st.get("spill"):
+            out.append("spill: " + " ".join(
+                f"{k}={v}" for k, v in sorted(st["spill"].items())))
+        if st.get("rung"):
+            out.append("rung: " + " ".join(
+                f"{k}={v}" for k, v in sorted(st["rung"].items())))
+        if st.get("lane"):
+            out.append("lane: " + " ".join(
+                f"{k}={v}" for k, v in sorted(st["lane"].items())))
+        ls = st.get("last_span")
+        if ls:
+            out.append(f"last span: {ls.get('tag')} i={ls.get('i')} "
+                       f"depth={ls.get('depth')} "
+                       f"{ls.get('outcome')} {ls.get('wall', 0.0)}s")
+        if st.get("end_condition"):
+            out.append(f"end: {st['end_condition']}")
+    # The flight tail is the authority on an unclosed dispatch: the
+    # STATUS snapshot may predate the wedge, but the begin marker
+    # cannot (it is written BEFORE the device call).
+    try:
+        recs = read_flight(_resolve_flight(path))
+    except (OSError, ValueError):
+        recs = []
+    if recs:
+        done = {(s["tag"], s["i"]) for s in recs
+                if s.get("t") == "span"}
+        open_d = None
+        for r in recs:
+            if (r.get("t") == "dispatch"
+                    and (r["tag"], r["i"]) not in done):
+                open_d = r
+        if open_d is not None:
+            out.append(f"!! in-flight: {open_d['tag']} "
+                       f"i={open_d['i']} depth={open_d.get('depth')} "
+                       "— the run is inside (or died inside) this "
+                       "dispatch")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------- cross-run ledger
+
+def append_ledger(path: str, record: dict) -> Optional[str]:
+    """Append one run's record to a JSONL bench ledger.  Never raises
+    (the ledger is an artifact, not a dependency); returns the path on
+    success, None on failure."""
+    try:
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+        return path
+    except (OSError, ValueError, TypeError):
+        return None
+
+
+def read_ledger(path: str) -> List[dict]:
+    """Ledger reader — same torn-tail tolerance as the flight log (a
+    run killed mid-append leaves one torn line, not a dead ledger)."""
+    return read_flight(path)
+
+
+# The bench phases a ledger compare diffs ("headline" is the last-line
+# JSON's top-level value — the number the BENCH_r0N trajectory tracks).
+_LEDGER_PHASES = ("headline", "strict", "beam", "swarm", "spill",
+                  "cpu_fallback")
+
+
+def _phase_value(rec: dict, phase: str) -> Optional[float]:
+    if phase == "headline":
+        v = rec.get("value")
+    else:
+        p = rec.get(phase)
+        v = p.get("value") if isinstance(p, dict) else None
+    try:
+        v = float(v)
+    except (TypeError, ValueError):
+        return None
+    return v if v > 0 else None
+
+
+def compare_ledger(records: List[dict],
+                   threshold: Optional[float] = None) -> dict:
+    """Diff the LATEST run against the BEST prior run per phase.
+    ``threshold`` is the tolerated fractional slowdown
+    (DSLABS_BENCH_REGRESS_PCT, default 0.25 = flag anything >25%
+    below the best prior rate — states/min is noisy on shared boxes,
+    and the best-prior baseline already biases toward flagging)."""
+    if threshold is None:
+        threshold = _env_float("DSLABS_BENCH_REGRESS_PCT", 0.25)
+    runs = [r for r in records if isinstance(r, dict)
+            and ("value" in r or r.get("t") == "bench")]
+    cmp = {"runs": len(runs), "threshold_pct": round(threshold * 100, 1),
+           "phases": {}, "regressions": [], "improvements": []}
+    if len(runs) < 2:
+        cmp["note"] = "need >= 2 runs to compare"
+        return cmp
+    latest, prior = runs[-1], runs[:-1]
+    for phase in _LEDGER_PHASES:
+        lv = _phase_value(latest, phase)
+        priors = [v for v in (_phase_value(r, phase) for r in prior)
+                  if v is not None]
+        if lv is None or not priors:
+            continue
+        best = max(priors)
+        delta = (lv - best) / best
+        entry = {"phase": phase, "latest": round(lv, 1),
+                 "best_prior": round(best, 1),
+                 "delta_pct": round(delta * 100, 1)}
+        cmp["phases"][phase] = entry
+        if delta < -threshold:
+            cmp["regressions"].append(entry)
+        elif delta > threshold:
+            cmp["improvements"].append(entry)
+    return cmp
+
+
+def render_compare(cmp: dict, source: str = "") -> str:
+    out = [f"== bench ledger compare: {source or 'ledger'} "
+           f"({cmp['runs']} runs, threshold "
+           f"{cmp['threshold_pct']:.0f}%) =="]
+    if cmp.get("note"):
+        out.append(cmp["note"])
+        return "\n".join(out)
+    out.append(f"{'phase':14s} {'latest':>12s} {'best_prior':>12s} "
+               f"{'delta':>8s}")
+    for phase in _LEDGER_PHASES:
+        e = cmp["phases"].get(phase)
+        if e is None:
+            continue
+        out.append(f"{phase:14s} {e['latest']:12.1f} "
+                   f"{e['best_prior']:12.1f} {e['delta_pct']:+7.1f}%")
+    for e in cmp["regressions"]:
+        out.append(f"REGRESSION: phase={e['phase']} "
+                   f"latest={e['latest']} vs best={e['best_prior']} "
+                   f"({e['delta_pct']:+.1f}%)")
+    for e in cmp["improvements"]:
+        out.append(f"improvement: phase={e['phase']} "
+                   f"({e['delta_pct']:+.1f}%)")
+    if not cmp["regressions"]:
+        out.append("parity: no phase regressed past the threshold")
+    return "\n".join(out)
+
+
+# ------------------------------------------------------------------ CLI
+
+_USAGE = """usage: python -m dslabs_tpu.tpu.telemetry <command> ...
+
+  report  <run-dir-or-flight-log> [--json]   render a run report
+  watch   <run-dir> [--interval S] [--once]  live monitor of any run
+  compare <ledger.jsonl> [--threshold F]     diff latest vs best prior
+"""
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import sys
 
     argv = list(sys.argv[1:] if argv is None else argv)
-    if not argv or argv[0] != "report" or len(argv) < 2:
-        print("usage: python -m dslabs_tpu.tpu.telemetry report "
-              "<run-dir-or-flight-log>", file=sys.stderr)
+    if len(argv) < 2 or argv[0] not in ("report", "watch", "compare"):
+        print(_USAGE, file=sys.stderr)
         return 2
-    path = _resolve_flight(argv[1])
-    report = build_report(read_flight(path))
-    print(render_report(report, source=path))
-    return 0
+    cmd, path = argv[0], argv[1]
+    flags = argv[2:]
+
+    if cmd == "report":
+        flight = _resolve_flight(path)
+        report = build_report(read_flight(flight))
+        if "--json" in flags:
+            # The machine-readable schema (pinned by test): the same
+            # sections the renderer draws, one structure shared with
+            # grading scripts and the ledger compare path.
+            print(json.dumps(dict(report, source=flight)))
+        else:
+            print(render_report(report, source=flight))
+        return 0
+
+    if cmd == "compare":
+        threshold = None
+        if "--threshold" in flags:
+            threshold = float(flags[flags.index("--threshold") + 1])
+        cmp = compare_ledger(read_ledger(path), threshold)
+        print(render_compare(cmp, source=path))
+        return 1 if cmp["regressions"] else 0
+
+    # watch: redraw until interrupted (--once = one frame, for smoke
+    # tests and scripts).  Reads only the run dir — the run itself can
+    # be any process, a warden child or a bench phase included.
+    interval = 2.0
+    if "--interval" in flags:
+        interval = float(flags[flags.index("--interval") + 1])
+    once = "--once" in flags
+    try:
+        while True:
+            frame = render_watch(path)
+            if not once:
+                print("\x1b[2J\x1b[H", end="")
+            print(frame, flush=True)
+            if once:
+                return 0
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
 
 
 if __name__ == "__main__":

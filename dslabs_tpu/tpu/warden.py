@@ -599,6 +599,21 @@ def _child_main() -> int:
                    "depth": depth, "ckpt_depth": st["ckpt_depth"],
                    "grace": idle_g})
 
+    # A checkpointed child gets a run-dir telemetry recorder of its
+    # own (flight.jsonl + STATUS.json beside the dump): `telemetry
+    # watch <run-dir>` then renders the CHILD's live depth/rate/skew
+    # from the directory alone — the parent's heartbeat re-emission
+    # covers liveness, this covers progress.  Never fatal: a child on
+    # a read-only dir just runs unrecorded.
+    child_tel = None
+    if ckpt_path:
+        try:
+            from dslabs_tpu.tpu.telemetry import Telemetry
+
+            child_tel = Telemetry.for_checkpoint(
+                ckpt_path, engine_hint=f"warden-child:{rung}")
+        except Exception:  # noqa: BLE001 — observability is optional
+            child_tel = None
     sup = SearchSupervisor(
         proto, ladder=(rung,), policy=policy,
         checkpoint_path=ckpt_path,
@@ -610,7 +625,7 @@ def _child_main() -> int:
         frontier_cap=spec.get("frontier_cap", 1 << 14),
         visited_cap=spec.get("visited_cap", 1 << 20),
         ev_budget=ev, aot_warmup=spec.get("aot_warmup", False),
-        dispatch_observer=observer)
+        dispatch_observer=observer, telemetry=child_tel)
     sup_ref["sup"] = sup
     try:
         out = sup.run(resume=bool(spec.get("resume")))
@@ -621,6 +636,9 @@ def _child_main() -> int:
         _send({"t": "err", "kind": kind,
                "error": f"{type(e).__name__}: {e}"[:500]})
         return CHILD_RC_FAILED
+    finally:
+        if child_tel is not None:
+            child_tel.close()
     import jax
 
     _send({"t": "result", "outcome": outcome_to_dict(out),

@@ -100,11 +100,14 @@ def test_span_count_equals_dispatch_count_sharded_engine():
 
 # ----------------------------------------------------- overhead guard
 
-def test_overhead_guard_no_added_dispatches_or_transfers(monkeypatch):
-    """ACCEPTANCE: telemetry adds ZERO device dispatches and ZERO
-    device->host readbacks — dispatch counts and device_get call
-    counts are identical with and without the recorder, both
-    engines."""
+def test_overhead_guard_no_added_dispatches_or_transfers(
+        monkeypatch, tmp_path):
+    """ACCEPTANCE (extended by ISSUE 8): telemetry adds ZERO device
+    dispatches and ZERO device->host readbacks — dispatch counts and
+    device_get call counts are bit-identical with and without the
+    recorder, both engines, WITH the per-device stats lanes and the
+    STATUS.json live-monitor writer enabled (full flight-recorder
+    config, not a RAM-only stub)."""
     proto = _pruned_pingpong()
     gets = []
     real = engine.device_get
@@ -114,6 +117,13 @@ def test_overhead_guard_no_added_dispatches_or_transfers(monkeypatch):
         return real(x)
 
     monkeypatch.setattr(engine, "device_get", spy)
+
+    def full_tel(name):
+        # Flight log + derived STATUS.json: the whole mesh-scope
+        # recorder, every writer engaged.
+        tel = Telemetry(flight_log=str(tmp_path / name / "flight.jsonl"))
+        assert tel.status_path is not None
+        return tel
 
     def run_device(telemetry):
         counts = {}
@@ -125,11 +135,12 @@ def test_overhead_guard_no_added_dispatches_or_transfers(monkeypatch):
         return counts, len(gets), out
 
     c0, g0, o0 = run_device(None)
-    c1, g1, o1 = run_device(Telemetry())
+    c1, g1, o1 = run_device(full_tel("dev"))
     assert c0 == c1, "telemetry changed the dispatch schedule"
     assert g0 == g1, "telemetry added device->host transfers"
     assert (o0.unique_states, o0.end_condition) == \
         (o1.unique_states, o1.end_condition)
+    assert (tmp_path / "dev" / "STATUS.json").exists()
 
     def run_sharded(telemetry):
         counts = {}
@@ -138,10 +149,15 @@ def test_overhead_guard_no_added_dispatches_or_transfers(monkeypatch):
             frontier_cap=1 << 8, visited_cap=1 << 10, max_depth=8,
             telemetry=telemetry)
         s._dispatch_hook = _counting_hook(counts)
+        del gets[:]
         s.run()
-        return counts
+        return counts, len(gets)
 
-    assert run_sharded(None) == run_sharded(Telemetry())
+    cs0, gs0 = run_sharded(None)
+    cs1, gs1 = run_sharded(full_tel("sharded"))
+    assert cs0 == cs1, "telemetry changed the sharded dispatch schedule"
+    assert gs0 == gs1, "telemetry added sharded device->host transfers"
+    assert (tmp_path / "sharded" / "STATUS.json").exists()
 
 
 # ------------------------------------------------------- flight log IO
@@ -254,6 +270,231 @@ def test_flight_log_survives_sigkill_names_inflight_dispatch(tmp_path):
     assert "in-flight at EOF" in render_report(rep)
 
 
+# --------------------------------------------- per-device lanes / skew
+
+def test_per_device_lanes_and_skew_on_8_device_mesh():
+    """ACCEPTANCE (ISSUE 8): on the n_devices=8 CPU dryrun mesh (the
+    MULTICHIP_r05 configuration) every level record carries per-device
+    lanes with 8 entries and finite skew metrics — read off the SAME
+    fused stats vector the level sync already pays for."""
+    import math
+
+    tel = Telemetry()
+    search = ShardedTensorSearch(
+        _pruned_pingpong(), make_mesh(8), chunk_per_device=16,
+        frontier_cap=1 << 8, visited_cap=1 << 10, max_depth=8,
+        telemetry=tel)
+    out = search.run()
+    assert out.end_condition == "SPACE_EXHAUSTED"
+    assert out.levels, "sharded outcome must carry level records"
+    for rec in out.levels:
+        pd = rec["per_device"]
+        for lane in ("explored", "frontier", "load_factor", "drops"):
+            assert len(pd[lane]) == 8, (lane, pd)
+        sk = rec["skew"]
+        for lane in ("explored", "frontier"):
+            assert math.isfinite(sk[lane]["imbalance"])
+            assert math.isfinite(sk[lane]["cv"])
+            assert sk[lane]["imbalance"] >= 1.0 or \
+                sk[lane]["mean"] == 0.0
+        # The level's per-device explored deltas sum to the level's
+        # global explored delta (the lanes ARE the pre-psum values).
+    total = sum(sum(r["per_device"]["explored"]) for r in out.levels)
+    assert total == out.states_explored
+    # on_level fed the registry gauges.
+    assert "skew.sharded" in tel.registry.gauges
+    assert tel.registry.gauges["skew.sharded"].value >= 1.0
+
+
+def test_per_device_lanes_swarm_rounds():
+    """Swarm rounds keep their pre-psum per-device walker stats in the
+    same round readback: 8 lanes per round record on the 8-device
+    mesh."""
+    from dslabs_tpu.tpu.swarm import SwarmSearch
+
+    tel = Telemetry()
+    sw = SwarmSearch(_pruned_pingpong(), mesh=make_mesh(8),
+                     walkers_per_device=4, max_steps=8,
+                     steps_per_round=4, seed=0, visited_cap=1 << 10,
+                     max_rounds=2)
+    tel.attach(sw)
+    sw.run()
+    rounds = [r for r in tel.levels if r.get("engine") == "swarm"]
+    assert rounds, "swarm rounds must land level records"
+    for rec in rounds:
+        assert len(rec["per_device"]["explored"]) == 8
+        assert len(rec["per_device"]["unique"]) == 8
+        assert rec["skew"]["explored"]["imbalance"] >= 1.0
+
+
+# ------------------------------------------------- STATUS.json / watch
+
+def test_status_json_schema_and_watch_finished_run(tmp_path, capsys):
+    """Tentpole leg 2: the engines' feeds atomically rewrite
+    STATUS.json in the run dir (schema pinned here), and
+    ``telemetry watch`` renders depth/rate/skew from the run dir
+    ALONE."""
+    from dslabs_tpu.tpu import checkpoint as ckpt_mod
+
+    ck = str(tmp_path / "search.ckpt")
+    assert ckpt_mod.run_dir_layout(ck)["status"] == \
+        str(tmp_path / "STATUS.json")
+    tel = Telemetry.for_checkpoint(ck)
+    assert tel.status_path == str(tmp_path / "STATUS.json")
+    search = ShardedTensorSearch(
+        _pruned_pingpong(), make_mesh(8), chunk_per_device=16,
+        frontier_cap=1 << 8, visited_cap=1 << 10, max_depth=8,
+        telemetry=tel)
+    out = search.run()
+    tel.close()
+
+    st = json.loads((tmp_path / "STATUS.json").read_text())
+    for key in ("t", "pid", "updated", "uptime", "spans", "levels",
+                "last_span", "in_flight", "flight_log", "engine",
+                "depth", "explored", "unique", "rate_per_min", "skew",
+                "per_device", "end_condition"):
+        assert key in st, f"STATUS.json missing {key!r}"
+    assert st["t"] == "status"
+    assert st["pid"] == os.getpid()
+    assert st["engine"] == "sharded"
+    assert st["depth"] == out.depth
+    assert st["end_condition"] == out.end_condition
+    assert st["in_flight"] is None          # run finished cleanly
+    assert len(st["per_device"]["explored"]) == 8
+
+    assert tel_mod.main(["watch", str(tmp_path), "--once"]) == 0
+    text = capsys.readouterr().out
+    assert f"depth {out.depth}" in text
+    assert "rate" in text
+    assert "skew:" in text
+    assert f"end: {out.end_condition}" in text
+
+
+_WATCH_KILL_CHILD = r"""
+import dataclasses, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache-cpu")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+from dslabs_tpu.tpu.engine import TensorSearch
+from dslabs_tpu.tpu.protocols.pingpong import make_pingpong_protocol
+from dslabs_tpu.tpu.telemetry import Telemetry
+
+pp = make_pingpong_protocol(workload_size=2)
+pp = dataclasses.replace(pp, goals={},
+                         prunes={"CLIENTS_DONE": pp.goals["CLIENTS_DONE"]})
+search = TensorSearch(pp, max_depth=10, frontier_cap=1 << 10,
+                      visited_cap=1 << 12)
+n = [0]
+def hook(tag, fn, *args):
+    n[0] += 1
+    if n[0] == 6:
+        print("WEDGED", flush=True)
+        time.sleep(600.0)           # the wedge: parent SIGKILLs us here
+    return fn(*args)
+search._dispatch_hook = hook
+Telemetry.for_checkpoint(sys.argv[1] + "/search.ckpt").attach(search)
+search.run()
+"""
+
+
+def test_watch_survives_sigkill_mid_level(tmp_path):
+    """ACCEPTANCE: ``telemetry watch`` renders a run in ANOTHER
+    process from the run dir alone and survives that run being
+    SIGKILLed mid-level — the atomic STATUS.json is never torn, the
+    flight log's torn tail is tolerated, and the last in-flight
+    dispatch is named."""
+    run_dir = str(tmp_path / "run")
+    os.makedirs(run_dir)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _WATCH_KILL_CHILD, run_dir],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env, cwd=ROOT)
+    try:
+        line = proc.stdout.readline()       # blocks until mid-dispatch
+        assert "WEDGED" in line
+        time.sleep(0.3)                     # let the marker line flush
+        # The run is alive but wedged: the watcher (another process's
+        # view, same code path) already renders from the dir alone.
+        live = tel_mod.render_watch(run_dir)
+        assert "in-flight" in live
+        proc.send_signal(signal.SIGKILL)
+    finally:
+        proc.wait(timeout=30)
+    frame = tel_mod.render_watch(run_dir)
+    assert "engine device" in frame         # depth/rate line rendered
+    assert "depth" in frame and "rate" in frame
+    assert "in-flight" in frame, frame      # the dispatch it died in
+    assert tel_mod.main(["watch", run_dir, "--once"]) == 0
+
+
+# ------------------------------------------------- report --json schema
+
+def test_report_json_schema_pin(tmp_path, capsys):
+    """ISSUE 8 satellite: ``report --json`` emits the same sections as
+    the rendered report, machine-readable — ONE schema for grading
+    scripts and the ledger compare path (top-level keys pinned)."""
+    flight = str(tmp_path / "flight.jsonl")
+    tel = Telemetry(flight_log=flight)
+    search = TensorSearch(_pruned_pingpong(), max_depth=8,
+                          frontier_cap=1 << 10, visited_cap=1 << 12)
+    tel.attach(search)
+    out = search.run()
+    tel.close()
+    assert tel_mod.main(["report", flight, "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    for key in ("meta", "n_spans", "sites", "series", "timeline",
+                "outcomes", "counts", "total_wall", "compile_wall",
+                "in_flight", "source"):
+        assert key in rep, f"report --json missing {key!r}"
+    assert rep["source"] == flight
+    assert rep["in_flight"] is None
+    assert len(rep["series"]["device"]) == out.depth
+    # The per-device lanes ride the series records (the heatmap's and
+    # the graders' one source).
+    assert rep["series"]["device"][0]["per_device"]["explored"]
+    assert rep["outcomes"][-1]["end_condition"] == out.end_condition
+
+
+# --------------------------------------------------- bench ledger diff
+
+def test_ledger_compare_flags_injected_regression_and_parity(
+        tmp_path, capsys):
+    """ACCEPTANCE: ``telemetry compare`` on a ledger with an injected
+    slow run flags the regression with the offending phase and delta;
+    a parity run flags nothing."""
+    from dslabs_tpu.tpu.telemetry import (append_ledger, compare_ledger,
+                                          read_ledger)
+
+    ledger = str(tmp_path / "BENCH_HISTORY.jsonl")
+    base = {"t": "bench", "value": 4.0e6,
+            "strict": {"value": 4.0e6, "unique": 1000},
+            "swarm": {"value": 2.0e6}}
+    append_ledger(ledger, base)
+    append_ledger(ledger, {**base, "value": 3.9e6,
+                           "strict": {"value": 3.8e6}})  # parity noise
+    assert tel_mod.main(["compare", ledger]) == 0
+    text = capsys.readouterr().out
+    assert "parity: no phase regressed" in text
+    assert "REGRESSION" not in text
+
+    append_ledger(ledger, {**base, "value": 1.0e6,
+                           "strict": {"value": 0.9e6}})  # injected slow
+    assert tel_mod.main(["compare", ledger]) == 1
+    text = capsys.readouterr().out
+    assert "REGRESSION: phase=strict" in text
+    cmp = compare_ledger(read_ledger(ledger))
+    reg = {e["phase"]: e for e in cmp["regressions"]}
+    assert "strict" in reg and "headline" in reg
+    assert reg["strict"]["delta_pct"] < -25.0
+    # A torn tail (a run killed mid-append) must not kill the reader.
+    with open(ledger, "a") as f:
+        f.write('{"t": "ben')
+    assert compare_ledger(read_ledger(ledger))["regressions"]
+
+
 # ------------------------------------------------------------ report CLI
 
 def test_report_cli_golden_sections(tmp_path, capsys):
@@ -272,9 +513,16 @@ def test_report_cli_golden_sections(tmp_path, capsys):
     assert tel_mod.main(["report", str(tmp_path)]) == 0
     text = capsys.readouterr().out
     for header in ("== dslabs run report", "-- dispatch latency by site --",
-                   "-- per-level throughput --", "-- recovery timeline --",
+                   "-- per-level throughput --",
+                   "-- per-device skew (explored share per level) --",
+                   "-- recovery timeline --",
                    "-- spill / overflow / recovery counts --"):
         assert header in text, f"missing section {header!r}"
+    # Heatmap rows: one per level, 8 cells wide, with skew columns.
+    heat = [ln for ln in text.splitlines() if ln.startswith("d ")
+            or (ln.startswith("d") and "|" in ln and "imb=" in ln)]
+    assert len(heat) == out.depth
+    assert all(ln.count("|") == 2 and "cv=" in ln for ln in heat)
     assert "sharded.superstep" in text
     assert "[engine sharded]" in text
     assert f"outcome: {out.end_condition}" in text

@@ -1,0 +1,84 @@
+"""Observability smoke driver (`make obs-smoke`, ISSUE 8 satellite):
+the end-to-end CLI paths the pytest tier exercises through the API —
+
+1. run a tiny search with a run-dir recorder (flight.jsonl +
+   STATUS.json) and render it with ``telemetry watch --once`` and
+   ``telemetry report`` (the watch-on-a-finished-run step);
+2. build a parity ledger (no flag expected, rc 0) and an
+   injected-slow-run ledger (regression flagged, rc 1) and diff both
+   with ``telemetry compare`` (the ledger-compare step).
+
+Exits nonzero on any mismatch; prints one OK line per step."""
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache-cpu")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+from dslabs_tpu.tpu import telemetry as tel_mod
+
+
+def run_search(run_dir: str):
+    import dataclasses
+
+    from dslabs_tpu.tpu.engine import TensorSearch
+    from dslabs_tpu.tpu.protocols.pingpong import make_pingpong_protocol
+
+    pp = make_pingpong_protocol(workload_size=2)
+    pp = dataclasses.replace(
+        pp, goals={}, prunes={"CLIENTS_DONE": pp.goals["CLIENTS_DONE"]})
+    tel = tel_mod.Telemetry.for_checkpoint(
+        os.path.join(run_dir, "search.ckpt"), engine_hint="obs-smoke")
+    search = TensorSearch(pp, max_depth=8, frontier_cap=1 << 10,
+                          visited_cap=1 << 12, telemetry=tel)
+    out = search.run()
+    tel.close()
+    return out
+
+
+def main() -> int:
+    run_dir = tempfile.mkdtemp(prefix="dslabs_obs_smoke_")
+    out = run_search(run_dir)
+    assert out.end_condition == "SPACE_EXHAUSTED", out.end_condition
+
+    # -- watch on a finished run, from the run dir alone
+    frame = tel_mod.render_watch(run_dir)
+    for needle in ("depth", "rate", "engine device",
+                   f"end: {out.end_condition}"):
+        assert needle in frame, (needle, frame)
+    rc = tel_mod.main(["watch", run_dir, "--once"])
+    assert rc == 0, rc
+    rc = tel_mod.main(["report", run_dir])
+    assert rc == 0, rc
+    print("obs-smoke: watch + report on a finished run OK")
+
+    # -- ledger compare: parity flags nothing, a slow run is flagged
+    parity = os.path.join(run_dir, "parity.jsonl")
+    for v in (100.0, 98.0):
+        tel_mod.append_ledger(parity, {"t": "bench", "value": v,
+                                       "strict": {"value": v}})
+    rc = tel_mod.main(["compare", parity])
+    assert rc == 0, "parity ledger must not flag"
+    slow = os.path.join(run_dir, "slow.jsonl")
+    for v in (100.0, 40.0):
+        tel_mod.append_ledger(slow, {"t": "bench", "value": v,
+                                     "strict": {"value": v}})
+    rc = tel_mod.main(["compare", slow])
+    assert rc == 1, "injected slow run must flag a regression"
+    cmp = tel_mod.compare_ledger(tel_mod.read_ledger(slow))
+    assert any(e["phase"] == "strict" for e in cmp["regressions"]), cmp
+    print("obs-smoke: ledger compare (parity + injected regression) OK")
+    print(json.dumps({"obs_smoke": "ok", "run_dir": run_dir}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

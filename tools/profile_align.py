@@ -1,8 +1,15 @@
 """Self-feeding (dependency-chained) microbenchmark: wide row scatter /
-gather cost vs lane alignment. Dev tool."""
+gather cost vs lane alignment.
 
+A thin client of the telemetry API (tpu/telemetry.py): each iteration is
+a span (`align.l<lanes>.<op>`), the table is the shared per-site latency
+renderer, ``--flight <path>`` leaves a flight log the report CLI can
+render.  Dev tool."""
+
+import os
 import sys
-import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 
@@ -10,10 +17,13 @@ jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 import jax.numpy as jnp
 
+from dslabs_tpu.tpu.telemetry import Telemetry, render_sites
+
 B, F = 24064, 65537
+ITERS = 10
 
 
-def run(lanes):
+def run(tel, lanes):
     key = jax.random.PRNGKey(0)
     rows = jax.random.randint(key, (B, lanes), 0, 1000, jnp.int32)
     nxt = jnp.zeros((F, lanes), jnp.int32)
@@ -34,21 +44,38 @@ def run(lanes):
         nxt = nxt + rows[0, 0]
         return nxt, rows
 
+    gb = B * lanes * 4 / 1e9
     for name, fn in (("scatter", scatter_step), ("gather", gather_step)):
-        n2, r2 = fn(nxt, rows)
-        jax.block_until_ready(r2)
-        t0 = time.time()
+        site = f"align.l{lanes}.{name}"
+        with tel.span(f"{site}.compile"):
+            n2, r2 = fn(nxt, rows)
+            jax.block_until_ready(r2)
         n2, r2 = nxt, rows
-        iters = 10
-        for _ in range(iters):
-            n2, r2 = fn(n2, r2)
-        jax.block_until_ready(r2)
-        dt = (time.time() - t0) / iters
-        gb = B * lanes * 4 / 1e9
+        for _ in range(ITERS):
+            with tel.span(site, gb=gb):
+                n2, r2 = fn(n2, r2)
+                jax.block_until_ready(r2)
+        st = tel.summary()["sites"][site]
+        dt = max(st["p50"], 1e-9)
         print(f"lanes={lanes:5d} {name:8s} {dt*1e3:9.2f} ms "
               f"({gb/dt:7.1f} GB/s eff)")
 
 
+def main():
+    flight = None
+    if "--flight" in sys.argv:
+        flight = sys.argv[sys.argv.index("--flight") + 1]
+    tel = Telemetry(flight_log=flight, engine_hint="profile_align")
+    lane_args = [int(x) for x in sys.argv[1:] if x.isdigit()]
+    for lanes in (lane_args or [1354, 1408, 1280]):
+        run(tel, lanes)
+    print()
+    print(render_sites(tel.summary()))
+    if flight:
+        print(f"\nflight log: {flight} "
+              f"(python -m dslabs_tpu.tpu.telemetry report {flight})")
+    tel.close()
+
+
 if __name__ == "__main__":
-    for lanes in ([int(x) for x in sys.argv[1:]] or [1354, 1408, 1280]):
-        run(lanes)
+    main()

@@ -3,11 +3,16 @@ run a REAL search to load the frontier + visited table, snapshot the
 carry, then time progressively truncated variants of the genuine
 `_build_chunk_step` program (no drifting copy).  Self-feeding loops only
 (each step consumes the previous carry) — independent-arg microbenchmarks
-lie on the axon platform.  Dev tool."""
+lie on the axon platform.
+
+A thin client of the telemetry API (tpu/telemetry.py): every timed
+iteration is a span (`bisect.<stage>`; the compile-paying first dispatch
+is its own `.compile` site), the table is the shared per-site latency
+renderer, and ``--flight <path>`` leaves a flight log the report CLI can
+render.  Dev tool, not part of the test suite."""
 
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -15,15 +20,16 @@ import jax
 
 jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-import jax.numpy as jnp
-import numpy as np
 
 from dslabs_tpu.tpu.protocols.paxos import make_paxos_protocol
 from dslabs_tpu.tpu.sharded import ShardedTensorSearch, make_mesh
+from dslabs_tpu.tpu.telemetry import Telemetry, render_sites
 
-CHUNK = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
-EVB = int(sys.argv[2]) if len(sys.argv) > 2 else 48  # 48 -> (40, 8)
+ARGS = [a for a in sys.argv[1:] if not a.startswith("--")]
+CHUNK = int(ARGS[0]) if len(ARGS) > 0 else 1024
+EVB = int(ARGS[1]) if len(ARGS) > 1 else 48  # 48 -> (40, 8)
 WARM_DEPTH = 10
+ITERS = 20
 STAGES = ["events", "handlers", "tail", "fp", "expand", "route",
           "a2a", "probe", "back", None]
 
@@ -49,6 +55,8 @@ def warm_carry(s):
     """Run the REAL search (full program) to WARM_DEPTH, returning the
     loaded device-resident carry — no host roundtrip (a 1.5 GB carry
     device_get/put through the tunnel dominated the old design)."""
+    import time
+
     state = s.initial_state()
     carry = s._init_carry(state)
     max_n = 1
@@ -65,8 +73,14 @@ def warm_carry(s):
 
 
 def main():
+    flight = None
+    if "--flight" in sys.argv:
+        flight = sys.argv[sys.argv.index("--flight") + 1]
+    tel = Telemetry(flight_log=flight, engine_hint="profile_sharded2")
+
     for stop in STAGES:
         sv = make_search(None)          # warm with the FULL program
+        name = stop or "full"
         with sv.mesh:
             carry, max_n = warm_carry(sv)
             if stop is not None:        # then swap in the variant
@@ -74,22 +88,30 @@ def main():
                 sv._chunk_step = jax.jit(sv._build_chunk_step(),
                                          donate_argnums=0)
             c = carry
-            t0 = time.time()
-            c = sv._chunk_step(c)
-            jax.block_until_ready(c["explored"])
-            t_first = time.time() - t0
-            iters = 20
-            t0 = time.time()
-            for _ in range(iters):
+            with tel.span(f"bisect.{name}.compile", frontier=max_n):
                 c = sv._chunk_step(c)
-            jax.block_until_ready(c["explored"])
-            dt = (time.time() - t0) / iters
-            name = stop or "full"
+                jax.block_until_ready(c["explored"])
+            # Each iteration blocks inside its span (same discipline as
+            # tools/profile_sharded.py): the chunk step self-feeds, so
+            # the device work is serialized either way and the span
+            # wall is the honest per-step cost.
+            for _ in range(ITERS):
+                with tel.span(f"bisect.{name}"):
+                    c = sv._chunk_step(c)
+                    jax.block_until_ready(c["explored"])
+            st = tel.summary()["sites"][f"bisect.{name}"]
+            dt = max(st["total"] / max(st["count"], 1), 1e-9)
             print(f"{name:8s} (frontier/dev {max_n}) "
-                  f"compile+1st {t_first:6.1f}s  "
                   f"steady {dt*1e3:8.2f} ms  "
                   f"({CHUNK*sv._num_events()/dt/1e6:.2f}M pairs/s)",
                   flush=True)
+
+    print()
+    print(render_sites(tel.summary()))
+    if flight:
+        print(f"\nflight log: {flight} "
+              f"(python -m dslabs_tpu.tpu.telemetry report {flight})")
+    tel.close()
 
 
 if __name__ == "__main__":
