@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast perf-smoke fault-smoke swarm-smoke capacity-smoke obs-smoke lab0 lab1 lab2 lab3 lab4 bench dryrun handout clean
+.PHONY: test test-fast perf-smoke fault-smoke swarm-smoke capacity-smoke obs-smoke chaos-smoke lab0 lab1 lab2 lab3 lab4 bench dryrun handout clean
 
 test:            ## full acceptance + parity suite
 	$(PY) -m pytest tests/ -q
@@ -74,6 +74,18 @@ capacity-smoke:  ## host-RAM spill tier + capacity-ladder suite on CPU
 obs-smoke:       ## unified telemetry suite (flight recorder / metrics / reports / watch / ledger) on CPU
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m obs -p no:cacheprovider
 	JAX_PLATFORMS=cpu $(PY) tools/obs_smoke.py
+
+# chaos-smoke = the elastic-mesh resilience suite (tests/test_chaos.py):
+# the degraded-mesh width ladder sharded(D)->sharded(D/2)->...->device->
+# host with exact cross-width resume parity (8->4->2->1 on the CPU
+# dryrun mesh, strict pingpong + lab1, SIGKILL-mid-level warden
+# variant), the adaptive OOM knob-shrink re-level, and the seeded chaos
+# soak (>= 20 deterministic faults across >= 3 dispatch sites, exact
+# fault-free parity asserted) — plus the long soak variants tier-1
+# skips (marked slow).  `python -m dslabs_tpu.tpu.chaos` is the by-hand
+# entry point.
+chaos-smoke:     ## elastic-mesh resilience suite (degraded ladder / knob shrink / seeded chaos soak) on CPU
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m chaos -p no:cacheprovider
 
 dryrun:          ## multi-chip sharding dry run on a virtual CPU mesh
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

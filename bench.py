@@ -378,6 +378,8 @@ def _run_rung(chunk_per_device: int, frontier_cap: int, visited_cap: int,
         "retries": outcome.retries,
         "failovers": outcome.failovers,
         "resumed_from_depth": outcome.resumed_from_depth,
+        "mesh_shrinks": outcome.mesh_shrinks,
+        "knob_retries": outcome.knob_retries,
         "telemetry": tel.summary(),
     }
 
@@ -451,6 +453,12 @@ def _run_strict(ev_budget, budget_secs: float) -> dict:
         "failovers": outcome.failovers,
         "resumed_from_depth": outcome.resumed_from_depth,
         "abandoned_threads": outcome.abandoned_threads,
+        # Elastic-mesh resilience counters (ISSUE 9): how much mesh /
+        # knob degradation this number absorbed — `telemetry compare`
+        # flags a run that suddenly needs them (resilience regression).
+        "mesh_shrinks": outcome.mesh_shrinks,
+        "knob_retries": outcome.knob_retries,
+        "mesh_width": outcome.mesh_width,
         "telemetry": tel.summary(),
     }
 
@@ -841,7 +849,7 @@ def _set_headline(result: dict, phase: dict, kind: str, platform: str,
     # abandoned_threads included, so in-process watchdog degradation
     # (leaked wedged-dispatch threads) is visible in the JSON.
     for k in ("retries", "failovers", "resumed_from_depth",
-              "abandoned_threads"):
+              "abandoned_threads", "mesh_shrinks", "knob_retries"):
         result[k] = phase.get(k, 0)
 
 

@@ -44,7 +44,13 @@ widths, net/timer caps, node count) plus the strict and record_trace
 flags.  Engine knobs that do not change state identity (chunk sizes,
 frontier/visited capacities, device count, ev budgets) are deliberately
 EXCLUDED — a dump written by an 8-device sharded run resumes on a
-single-device engine, or under a different chunk size, unchanged.  A
+single-device engine, or under a different chunk size, unchanged.
+That width-freedom is load-bearing twice over: the supervisor's
+ELASTIC degraded-mesh ladder (ISSUE 9, docs/resilience.md) resumes the
+same dump on progressively halved meshes (frontier rows re-split into
+contiguous per-device shares, visited keys re-inserted per owner), and
+the swarm explorer's own fingerprint family follows the same rule (no
+D/K pin — walker state redistributes on load, tpu/swarm.py).  A
 fingerprint mismatch is refused LOUDLY (:class:`CheckpointMismatch`
 names both fingerprints); a checkpoint is never resumed silently into
 a search it does not describe.

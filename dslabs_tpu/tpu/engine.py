@@ -270,6 +270,16 @@ class SearchOutcome:
     spilled_keys: int = 0
     host_tier_hits: int = 0
     respilled_frontier: int = 0
+    # Elastic-mesh resilience accounting (ISSUE 9, tpu/supervisor.py,
+    # docs/resilience.md): the mesh width (device count) of the rung
+    # that produced this verdict, how many times the degraded-mesh
+    # ladder halved the mesh (``mesh_shrunk`` events), and how many
+    # in-place knob-shrink re-levels OOM-classified failures were
+    # answered with (``knobs_shrunk`` events) instead of burning a
+    # rung.  None/0 outside the supervisor.
+    mesh_width: Optional[int] = None
+    mesh_shrinks: int = 0
+    knob_retries: int = 0
 
     @property
     def dropped_states(self) -> int:

@@ -130,6 +130,7 @@ class ShardedTensorSearch(TensorSearch):
                  checkpoint_path: Optional[str] = None,
                  checkpoint_every: int = 0,
                  superstep: Optional[bool] = None,
+                 superstep_chunks: Optional[int] = None,
                  aot_warmup: Optional[bool] = None,
                  spill=None,
                  telemetry=None):
@@ -234,8 +235,13 @@ class ShardedTensorSearch(TensorSearch):
         # budget is active: bounds device work between host clock checks
         # so mid-level TIME_EXHAUSTED keeps its round-3 granularity (the
         # legacy driver blocked every 16 chunks for the same reason).
-        self._superstep_chunks = int(
-            os.environ.get("DSLABS_SUPERSTEP_CHUNKS", "16") or "16")
+        # First-class constructor knob since ISSUE 9: the supervisor's
+        # adaptive OOM backoff halves it per knob-shrink re-level
+        # (docs/resilience.md "knob-shrink ladder").
+        self._superstep_chunks = (
+            int(superstep_chunks) if superstep_chunks is not None
+            else int(os.environ.get("DSLABS_SUPERSTEP_CHUNKS", "16")
+                     or "16"))
 
         # ONE fused scalar vector per host sync: each device->host readback
         # over the runtime tunnel costs ~25 ms, and the naive sync did six

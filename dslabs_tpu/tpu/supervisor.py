@@ -46,6 +46,24 @@ production training/inference stack assumes:
   unified checkpoint — nothing leaks, and a hard runtime wedge cannot
   take the supervising process down.
 
+* **Elastic degraded-mesh ladder.**  ``SearchSupervisor(elastic=True)``
+  expands the ``"sharded"`` rung into a WIDTH ladder
+  ``sharded(D) -> sharded(D/2) -> ... -> sharded(2) -> device -> host``
+  (:func:`expand_ladder`): losing one chip — or a wedge/fatal error the
+  rung cannot absorb — costs HALF the mesh, not all of it, because the
+  engine-agnostic checkpoint re-shards the frontier and re-inserts the
+  visited keys per owner on whatever mesh resumes it
+  (tpu/checkpoint.py).  Every shrink is a ``mesh_shrunk`` telemetry
+  event and the verdict carries ``mesh_width`` / ``mesh_shrinks``.
+* **Adaptive in-rung degradation.**  A classified OOM/capacity dispatch
+  failure (:func:`classify_oom`: MemoryError, RESOURCE_EXHAUSTED /
+  out-of-memory markers) first retries IN PLACE from the checkpoint
+  with SHRUNK knobs — chunk size and the superstep chunk budget halve
+  per re-level, a bounded ladder of ``max_knob_shrinks``
+  (DSLABS_KNOB_SHRINKS) — before burning a rung: a transient memory
+  spike costs a re-level, not a mesh.  Re-levels are ``knobs_shrunk``
+  telemetry events and ``SearchOutcome.knob_retries``.
+
 * **Portfolio mode.**  ``SearchSupervisor(portfolio=True)`` runs the
   device-sharded swarm explorer (tpu/swarm.py) as a CONCURRENT lane
   beside the BFS ladder — the reference's BFS + RandomDFS portfolio
@@ -77,7 +95,8 @@ from dslabs_tpu.tpu import checkpoint as ckpt_mod
 __all__ = ["TransientDeviceError", "DispatchTimeout", "EngineFailure",
            "SupervisorExhausted", "RetryPolicy", "FaultRule", "FaultPlan",
            "DispatchBoundary", "SearchSupervisor", "classify_failure",
-           "install_retry", "probe_device"]
+           "classify_oom", "expand_ladder", "install_retry",
+           "probe_device"]
 
 # In-process watchdog abandonment LEAKS a blocked daemon thread (a
 # wedged XLA runtime cannot be interrupted from Python).  Past this many
@@ -160,6 +179,47 @@ def classify_failure(exc: BaseException) -> str:
     return "fatal"
 
 
+# Markers of a memory/capacity-shaped failure: what the adaptive
+# knob-shrink ladder answers with an in-place re-level (halved chunk +
+# superstep budget, resume from checkpoint) before burning a rung.
+_OOM_MARKERS = ("resource_exhausted", "out of memory", "hbm oom",
+                "allocation failure", "oom-kill")
+
+
+def classify_oom(exc: Optional[BaseException]) -> bool:
+    """True when a failure looks like memory/capacity exhaustion — a
+    MemoryError, or a runtime error whose message carries an OOM
+    marker.  Such failures are worth an in-place knob-shrink retry
+    (smaller chunks need less live HBM) where an arbitrary fatal error
+    is not."""
+    if exc is None:
+        return False
+    if isinstance(exc, MemoryError):
+        return True
+    msg = str(exc).lower()
+    return any(m in msg for m in _OOM_MARKERS)
+
+
+def expand_ladder(ladder, full_width: Optional[int] = None,
+                  elastic: bool = False):
+    """Expand a rung-name ladder into ``(rung, width)`` specs.  With
+    ``elastic`` set, every ``"sharded"`` entry becomes the degraded-
+    mesh width ladder ``sharded(D) -> sharded(D/2) -> ... ->
+    sharded(2)`` (width ``None`` = the full mesh) so a failing mesh
+    degrades by halves instead of cliff-dropping to one device.  The
+    engine NAME stays ``"sharded"`` for every width — fault plans,
+    retry budgets, and dispatch tags keep one stable vocabulary."""
+    specs = []
+    for rung in ladder:
+        specs.append((rung, None))
+        if rung == "sharded" and elastic and (full_width or 0) > 2:
+            w = int(full_width)
+            while w > 2:
+                w = max(2, w // 2)
+                specs.append(("sharded", w))
+    return specs
+
+
 @dataclasses.dataclass(frozen=True)
 class RetryPolicy:
     """Bounded-retry + watchdog knobs (docs/resilience.md)."""
@@ -217,6 +277,10 @@ class FaultPlan:
     def __init__(self):
         self.rules: List[FaultRule] = []
         self.fired: int = 0
+        # Every firing, attributably: (engine, site, kind, index) — the
+        # chaos soak (tpu/chaos.py) asserts its fault count and site
+        # coverage from this log.
+        self.fired_log: List[tuple] = []
 
     def raise_at(self, at: int, error: type = TransientDeviceError,
                  engine: Optional[str] = None, count: Optional[int] = 1,
@@ -259,6 +323,7 @@ class FaultPlan:
             if r.count is not None and idx >= r.at + r.count:
                 continue
             self.fired += 1
+            self.fired_log.append((engine, site, r.kind, idx))
             return r
         return None
 
@@ -304,6 +369,14 @@ class DispatchBoundary:
         """Watchdog-abandoned daemon threads still blocked right now."""
         return sum(1 for t in self.abandoned if t.is_alive())
 
+    def reset_budget(self, engine: str) -> None:
+        """Grant ``engine`` a fresh retry budget.  The supervisor calls
+        this at every rung (and knob-shrink re-level) start: the
+        elastic ladder reuses the engine NAME across its width rungs,
+        but the retry budget is per-RUNG — retries spent on the 8-wide
+        mesh must not starve the 4-wide one."""
+        self._engine_retries.pop(engine, None)
+
     def install(self, search, engine: Optional[str] = None) -> None:
         """Route ``search``'s dispatches through this boundary.  The
         optional ``engine`` override renames the tag prefix (the
@@ -325,6 +398,13 @@ class DispatchBoundary:
         # Telemetry spans read the retry counter off this attribute to
         # report retries-per-dispatch without new plumbing.
         search._dispatch_boundary = self
+        # A (re)installed search may carry freshly built programs — a
+        # degraded-width mesh or a knob-shrunk chunk size compiles new
+        # executables — so the first dispatch at each tag earns the
+        # compile-inclusive grace deadline again.  Without this reset a
+        # knob-shrink re-level's first compile would run under the
+        # steady deadline and read as a wedge.
+        self._seen_tags = set()
         if engine is None:
             search._dispatch_hook = self.dispatch
         else:
@@ -350,6 +430,15 @@ class DispatchBoundary:
             self.site_counts[(engine, site)] = sidx + 1
             rule = (self.plan.match(engine, idx, site, sidx)
                     if self.plan else None)
+            if rule is not None and self.telemetry is not None:
+                # Injections are first-class flight-log events: a chaos
+                # soak's recovery timeline names every fault it threw
+                # (tpu/chaos.py plans mark themselves ``chaos``).
+                self.telemetry.event(
+                    "chaos_inject" if getattr(self.plan, "chaos", False)
+                    else "fault_inject",
+                    engine=engine, site=site, index=idx,
+                    fault=rule.kind)
             try:
                 if self.observer is not None:
                     # Observer runs INSIDE the try: a fault it raises
@@ -552,7 +641,9 @@ class SearchSupervisor:
                  portfolio: bool = False,
                  swarm_kwargs: Optional[dict] = None,
                  spill=False,
-                 telemetry=None):
+                 telemetry=None,
+                 elastic: Optional[bool] = None,
+                 max_knob_shrinks: Optional[int] = None):
         for rung in ladder:
             if rung not in ("sharded", "device", "host"):
                 raise ValueError(f"unknown ladder rung {rung!r}")
@@ -625,6 +716,27 @@ class SearchSupervisor:
         # it builds, so dispatch spans, rung/failover events, and the
         # final outcome all land in one flight log.
         self.telemetry = telemetry
+        # Elastic degraded-mesh ladder (ISSUE 9, docs/resilience.md):
+        # expand the "sharded" rung into sharded(D) -> sharded(D/2) ->
+        # ... -> sharded(2) so a fatal/wedged mesh rung costs half the
+        # chips, not all of them.  Default off (the pinned historical
+        # ladder); DSLABS_ELASTIC=1 flips the default.
+        if elastic is None:
+            elastic = os.environ.get(
+                "DSLABS_ELASTIC", "").strip().lower() in ("1", "on",
+                                                          "true", "yes")
+        self.elastic = bool(elastic)
+        # Adaptive in-rung degradation: how many in-place knob-shrink
+        # re-levels (halved chunk + superstep budget, resume from
+        # checkpoint) an OOM-classified failure gets before the rung
+        # burns.
+        if max_knob_shrinks is None:
+            max_knob_shrinks = int(
+                os.environ.get("DSLABS_KNOB_SHRINKS", "2") or "2")
+        self.max_knob_shrinks = int(max_knob_shrinks)
+        self.knob_retries = 0
+        self.mesh_shrinks = 0
+        self._degraded_meshes: Dict[int, object] = {}
         self.boundary: Optional[DispatchBoundary] = None
         self.failures: List[EngineFailure] = []
         # Engines are cached per rung so repeated run() calls (e.g. the
@@ -640,37 +752,83 @@ class SearchSupervisor:
             return None
         return self.spill
 
-    def _build(self, rung: str, spill=None):
-        # Plain rungs keep their historical cache key (external code
-        # and tests index self._engines["sharded"]); spill-enabled
-        # variants key beside them, per host-tier size.
-        key = (rung if spill is None
-               else (rung, getattr(spill, "host_cap", True)))
+    def _full_width(self) -> int:
+        """The undegraded mesh width (device count of the configured
+        mesh, or every visible device)."""
+        if self.mesh is not None:
+            return int(self.mesh.devices.size)
+        import jax
+
+        return len(jax.devices())
+
+    def _mesh_for(self, width: Optional[int]):
+        """The mesh a sharded rung runs on: the configured/full mesh
+        for ``width=None``, else a cached DEGRADED mesh over the first
+        ``width`` devices of the full one — the elastic ladder's
+        "rebuild a smaller mesh" step."""
+        from dslabs_tpu.tpu.sharded import make_mesh
+
+        if width is None:
+            if self.mesh is None:
+                import jax
+
+                self.mesh = make_mesh(len(jax.devices()))
+            return self.mesh
+        mesh = self._degraded_meshes.get(width)
+        if mesh is None:
+            if self.mesh is not None:
+                import numpy as np
+                from jax.sharding import Mesh
+
+                devs = list(self.mesh.devices.flat)[:width]
+                mesh = Mesh(np.array(devs), self.mesh.axis_names)
+            else:
+                mesh = make_mesh(width)
+            self._degraded_meshes[width] = mesh
+        return mesh
+
+    def _build(self, rung: str, spill=None, width: Optional[int] = None,
+               shrink: int = 0):
+        # Plain full-width rungs keep their historical cache key
+        # (external code and tests index self._engines["sharded"]);
+        # spill-enabled variants key beside them per host-tier size,
+        # degraded-width / knob-shrunk variants per (width, shrink).
+        if spill is None and width is None and shrink == 0:
+            key = rung
+        elif width is None and shrink == 0:
+            key = (rung, getattr(spill, "host_cap", True))
+        else:
+            key = (rung, getattr(spill, "host_cap", None), width, shrink)
         cached = self._engines.get(key)
         if cached is not None:
             cached.max_depth = self.max_depth
             cached.max_secs = self.max_secs
             return cached
-        self._engines[key] = s = self._build_fresh(rung, spill)
+        self._engines[key] = s = self._build_fresh(rung, spill, width,
+                                                   shrink)
         return s
 
-    def _build_fresh(self, rung: str, spill=None):
+    def _build_fresh(self, rung: str, spill=None,
+                     width: Optional[int] = None, shrink: int = 0):
         from dslabs_tpu.tpu.engine import TensorSearch
 
         ck = {"checkpoint_path": self.checkpoint_path,
               "checkpoint_every": self.checkpoint_every,
               "spill": spill}
+        # The knob-shrink ladder: each re-level halves the chunk (the
+        # live-HBM-per-chunk-step knob) — and, below, the superstep
+        # chunk budget — so an OOM retry runs strictly lighter.
+        chunk = max(1, self.chunk >> shrink)
         if rung == "sharded":
-            import jax
+            from dslabs_tpu.tpu.sharded import ShardedTensorSearch
 
-            from dslabs_tpu.tpu.sharded import (ShardedTensorSearch,
-                                                make_mesh)
-
-            mesh = self.mesh
-            if mesh is None:
-                mesh = self.mesh = make_mesh(len(jax.devices()))
+            base_budget = int(
+                os.environ.get("DSLABS_SUPERSTEP_CHUNKS", "16") or "16")
             return ShardedTensorSearch(
-                self.protocol, mesh, chunk_per_device=self.chunk,
+                self.protocol, self._mesh_for(width),
+                chunk_per_device=chunk,
+                superstep_chunks=(max(1, base_budget >> shrink)
+                                  if shrink else None),
                 frontier_cap=self.frontier_cap,
                 visited_cap=self.visited_cap, max_depth=self.max_depth,
                 max_secs=self.max_secs, strict=self.strict,
@@ -678,7 +836,7 @@ class SearchSupervisor:
                 aot_warmup=self.aot_warmup, **ck)
         return TensorSearch(
             self.protocol, frontier_cap=self.frontier_cap,
-            chunk=self.chunk, max_depth=self.max_depth,
+            chunk=chunk, max_depth=self.max_depth,
             max_secs=self.max_secs, ev_budget=self.ev_budget,
             visited_cap=self.visited_cap, strict=self.strict,
             use_host_visited=(rung == "host"), **ck)
@@ -707,47 +865,104 @@ class SearchSupervisor:
         """The in-process failover ladder (the pre-portfolio ``run``
         body).  ``cancel`` (a threading.Event) is the portfolio lane's
         first-verdict-wins cut — installed on every rung so a cancelled
-        BFS returns at its next level boundary."""
+        BFS returns at its next level boundary.  With ``elastic`` the
+        rung list is the EXPANDED degraded-mesh ladder
+        (:func:`expand_ladder`), and an OOM-classified failure first
+        retries the rung in place with shrunk knobs (the adaptive
+        knob-shrink ladder) before failing over."""
         from dslabs_tpu.tpu.engine import CapacityOverflow
 
         self.boundary = DispatchBoundary(self.policy, self.fault_plan,
                                          observer=self.dispatch_observer,
                                          telemetry=self.telemetry)
         self.failures = []
-        for i, rung in enumerate(self.ladder):
-            search = self._build(rung, self._engine_spill())
-            self.boundary.install(search, engine=rung)
-            if self.telemetry is not None:
-                search._telemetry = self.telemetry
-            if cancel is not None:
-                search._cancel_event = cancel
-            do_resume = (resume or i > 0) and self._resumable(search)
-            if self.telemetry is not None:
-                self.telemetry.event("rung", engine=rung, index=i,
-                                     resume=bool(do_resume))
+        self.knob_retries = 0
+        self.mesh_shrinks = 0
+        specs = expand_ladder(
+            self.ladder,
+            self._full_width() if self.elastic else None, self.elastic)
+        prev_width = None
+        for i, (rung, width) in enumerate(specs):
+            eff_width = None
+            if rung == "sharded":
+                eff_width = width or self._full_width()
+                if prev_width is not None and eff_width < prev_width:
+                    # A burned mesh rung degrades by HALVES, resuming
+                    # the unified checkpoint re-sharded to the smaller
+                    # owner map — the telemetry recovery timeline shows
+                    # every step down.
+                    self.mesh_shrinks += 1
+                    if self.telemetry is not None:
+                        self.telemetry.event("mesh_shrunk",
+                                             from_width=prev_width,
+                                             to_width=eff_width)
+                prev_width = eff_width
+            shrink = 0
             out = None
-            try:
-                out = search.run(check_initial=check_initial,
-                                 initial=initial, resume=do_resume)
-            except EngineFailure as e:
-                self.failures.append(e)
+            search = None
+            while True:
+                search = self._build(rung, self._engine_spill(),
+                                     width=width, shrink=shrink)
+                self.boundary.install(search, engine=rung)
+                self.boundary.reset_budget(rung)
                 if self.telemetry is not None:
-                    self.telemetry.event("failover", engine=rung,
-                                         kind=e.kind,
-                                         error=str(e.cause)[:200])
-            except CapacityOverflow as e:
-                if self.spill != "ladder":
-                    # The historical contract: semantic/capacity errors
-                    # pass through unwrapped unless the caller opted
-                    # into the capacity ladder.
-                    raise
-                self.failures.append(EngineFailure(rung, "capacity", e))
-                out = self._capacity_retry(rung, initial, check_initial,
-                                           cancel)
-                search = self._last_capacity_search or search
+                    search._telemetry = self.telemetry
+                if cancel is not None:
+                    search._cancel_event = cancel
+                do_resume = ((resume or i > 0 or shrink > 0)
+                             and self._resumable(search))
+                if self.telemetry is not None:
+                    self.telemetry.event("rung", engine=rung, index=i,
+                                         resume=bool(do_resume),
+                                         width=eff_width or 1,
+                                         shrink=shrink)
+                try:
+                    out = search.run(check_initial=check_initial,
+                                     initial=initial, resume=do_resume)
+                except EngineFailure as e:
+                    if (classify_oom(e.cause)
+                            and shrink < self.max_knob_shrinks):
+                        # Adaptive in-rung degradation: an OOM-shaped
+                        # failure retries IN PLACE from the checkpoint
+                        # with halved chunk / superstep budget — a
+                        # memory spike costs a re-level, not a mesh.
+                        shrink += 1
+                        self.knob_retries += 1
+                        if self.telemetry is not None:
+                            self.telemetry.event(
+                                "knobs_shrunk", engine=rung,
+                                shrink=shrink,
+                                chunk=max(1, self.chunk >> shrink),
+                                width=eff_width or 1,
+                                error=str(e.cause)[:200])
+                        continue
+                    self.failures.append(e)
+                    if self.telemetry is not None:
+                        # (field name `failure`, not `kind` — the
+                        # recorder's positional is already `kind`.)
+                        self.telemetry.event("failover", engine=rung,
+                                             failure=e.kind,
+                                             width=eff_width or 1,
+                                             error=str(e.cause)[:200])
+                except CapacityOverflow as e:
+                    if self.spill != "ladder":
+                        # The historical contract: semantic/capacity
+                        # errors pass through unwrapped unless the
+                        # caller opted into the capacity ladder.
+                        raise
+                    self.failures.append(
+                        EngineFailure(rung, "capacity", e))
+                    out = self._capacity_retry(rung, width, shrink,
+                                               initial, check_initial,
+                                               cancel)
+                    search = self._last_capacity_search or search
+                break
             if out is None:
                 continue
             out.engine = rung
+            out.mesh_width = eff_width if eff_width is not None else 1
+            out.mesh_shrinks = self.mesh_shrinks
+            out.knob_retries = self.knob_retries
             out.retries = self.boundary.retries
             out.failovers = len(self.failures)
             out.resumed_from_depth = getattr(
@@ -756,7 +971,8 @@ class SearchSupervisor:
             return out
         raise SupervisorExhausted(self.failures)
 
-    def _capacity_retry(self, rung, initial, check_initial, cancel):
+    def _capacity_retry(self, rung, width, shrink, initial,
+                        check_initial, cancel):
         """The capacity ladder's recovery arm (docs/capacity.md): the
         overflowed rung is rebuilt WITH the host-RAM spill tier and
         resumes from the checkpoint (that is the point of the ladder —
@@ -775,7 +991,7 @@ class SearchSupervisor:
             self.spill, spill_mod.SpillConfig) else
             spill_mod.SpillConfig())
         for cfg in (base, _dc.replace(base, host_cap=base.host_cap * 8)):
-            search = self._build(rung, cfg)
+            search = self._build(rung, cfg, width=width, shrink=shrink)
             self.boundary.install(search, engine=rung)
             if self.telemetry is not None:
                 search._telemetry = self.telemetry
@@ -913,6 +1129,8 @@ class SearchSupervisor:
                 "process_isolation=True requires protocol_factory="
                 "'module:callable' (+ factory_kwargs) — a live protocol "
                 "object cannot cross the spawn boundary")
+        wkw = dict(self.warden_kwargs or {})
+        wkw.setdefault("elastic", self.elastic)
         warden = Warden(
             factory=self.protocol_factory,
             factory_kwargs=self.factory_kwargs,
@@ -925,7 +1143,7 @@ class SearchSupervisor:
             frontier_cap=self.frontier_cap,
             visited_cap=self.visited_cap, ev_budget=self.ev_budget,
             aot_warmup=self.aot_warmup, telemetry=self.telemetry,
-            **(self.warden_kwargs or {}))
+            **wkw)
         try:
             return warden.run(resume=resume)
         finally:

@@ -743,12 +743,18 @@ class SwarmSearch(TensorSearch):
 
     def _ckpt_fingerprint(self) -> str:
         """Swarm dumps are their own config family: a BFS engine must
-        never resume one (and vice versa), and the walker-array shapes
-        (D, K, S) plus the PRNG seed are part of the identity — resume
-        is a bit-exact continuation."""
+        never resume one (and vice versa).  The history length (S) and
+        the PRNG seed are part of the identity, but the mesh width (D)
+        and per-device walker count (K) are deliberately EXCLUDED
+        (ISSUE 9 satellite — the old ``D/K`` pin made every swarm dump
+        unresumable after any mesh-width change): on load the walker
+        rows, histories, PRNG keys, and per-device table key groups
+        REDISTRIBUTE across whatever fleet resumes them
+        (:meth:`_redistribute_swarm`); an unchanged-width resume takes
+        the bit-exact passthrough path.  ``CheckpointMismatch`` is
+        reserved for genuine protocol/strictness/seed mismatches."""
         base = ckpt_mod.config_fingerprint(self.p, self.strict, False)
-        return (f"swarm:{base}:D{self.n_devices}:K{self.walkers}"
-                f":S{self.max_steps}:seed{self.seed}")
+        return f"swarm:{base}:S{self.max_steps}:seed{self.seed}"
 
     def _save_swarm_ckpt(self, carry, rounds: int, elapsed: float
                          ) -> None:
@@ -786,11 +792,100 @@ class SwarmSearch(TensorSearch):
         self._ckpt_writer.kick(
             lambda: ckpt_mod.save(self.checkpoint_path, ck))
 
+    def _redistribute_swarm(self, ck, x):
+        """Cross-mesh-width resume (ISSUE 9 satellite): rewrite a dump
+        written by a (D', K') fleet into this search's (D, K) shapes.
+
+        Walker rows / depths / histories / streaks tile (or truncate)
+        onto the new fleet size; the seed pool's live rows re-split
+        into contiguous per-device shares; per-device PRNG keys map
+        ``new[d] = old[d % D']`` (fresh streams per device either way);
+        per-device visited key groups merge round-robin (duplicate keys
+        across old device-local tables resolve in the insert); counters
+        re-aggregate onto device 0 (sums — max for ``deepest`` — so
+        psum/pmax stats stay exact).  The continuation is sound, not
+        bit-exact — bit-exactness is reserved for the unchanged-width
+        passthrough path."""
+        import warnings
+
+        D, K = self.n_devices, self.walkers
+        vdev_old = np.asarray(x["vdev"], np.int64)
+        d_old = max(len(vdev_old), 1)
+        rows_old = np.asarray(ck.frontier, np.int32)
+        n_old = max(len(rows_old), 1)
+        m = D * K
+        if len(rows_old) != m:
+            warnings.warn(
+                f"{self.p.name}: swarm resume redistributes "
+                f"{len(rows_old)} walkers from a {d_old}-device dump "
+                f"onto {D}x{K}={m} walker slots "
+                f"({'tiling' if m > len(rows_old) else 'truncating'})",
+                RuntimeWarning, stacklevel=3)
+        idx = np.arange(m) % n_old
+        x = dict(x)
+        x["depths"] = np.asarray(x["depths"], np.int32)[idx]
+        x["hists"] = np.asarray(x["hists"], np.int32)[idx]
+        x["streak"] = np.asarray(x["streak"], np.int32)[idx]
+        # PRNG keys: one per device, reused round-robin.
+        key_old = np.asarray(x["key"], np.uint32).reshape(d_old, -1)
+        x["key"] = key_old[np.arange(D) % d_old]
+        # Seed pool: gather every device's live prefix, ceil-split into
+        # contiguous per-device shares (the _seed_pool discipline).
+        seeds_old = np.asarray(x["seeds"], np.int32)
+        sn_old = np.asarray(x["seeds_n"], np.int32).reshape(-1)
+        p_old = max(seeds_old.shape[0] // d_old, 1)
+        live = [seeds_old[d * p_old:d * p_old + int(sn_old[d])]
+                for d in range(d_old) if int(sn_old[d]) > 0]
+        live = (np.concatenate(live) if live else rows_old[:1])
+        per = max(1, -(-len(live) // D))
+        seeds = np.zeros((D, per, self.lanes), np.int32)
+        seeds_n = np.zeros((D,), np.int32)
+        for d in range(D):
+            part = live[d * per:(d + 1) * per]
+            if not len(part):
+                part = live[:1]     # never an empty pool
+            seeds[d, :len(part)] = part
+            seeds_n[d] = len(part)
+        x["seeds"] = seeds.reshape(D * per, self.lanes)
+        x["seeds_n"] = seeds_n
+        # seed_idx references the per-device pool — clamp each walker's
+        # index into its new device's pool size.
+        sidx = np.asarray(x["seed_idx"], np.int32)[idx]
+        owner = np.arange(m) // K
+        x["seed_idx"] = np.minimum(sidx, seeds_n[owner] - 1).clip(0)
+        # Per-device key groups merge round-robin onto the new width.
+        offs = np.concatenate([[0], np.cumsum(vdev_old)]).astype(int)
+        groups = [ck.visited_keys[offs[d]:offs[d + 1]]
+                  for d in range(len(vdev_old))]
+        merged = [[] for _ in range(D)]
+        for g, keys in enumerate(groups):
+            merged[g % D].append(keys)
+        new_groups = [(np.concatenate(gs) if gs
+                       else np.zeros((0, 4), np.uint32))
+                      for gs in merged]
+        x["vdev"] = np.asarray([len(g) for g in new_groups], np.int64)
+        visited_keys = (np.concatenate(new_groups) if len(ck.visited_keys)
+                        else ck.visited_keys)
+        # Counters: per-device partials re-aggregate onto device 0 —
+        # the stats psum (pmax for deepest) reads identical totals.
+        c_old = np.asarray(x["counters"], np.int64).reshape(7, d_old)
+        totals = c_old.sum(axis=1)
+        totals[6] = c_old[6].max(initial=0)
+        c_new = np.zeros((7, D), np.int64)
+        c_new[:, 0] = totals
+        x["counters"] = c_new
+        import dataclasses as _dc
+
+        return _dc.replace(ck, frontier=rows_old[idx],
+                           visited_keys=visited_keys), x
+
     def _load_swarm_ckpt(self):
         """-> (carry, rounds, elapsed) or None.  Rebuilds the full
         fleet carry — walker rows/depths/histories, PRNG keys, seed
         pool, per-device tables re-inserted from the dumped key groups
-        — so the continuation is bit-exact (the resume-parity test)."""
+        — so an unchanged-width continuation is bit-exact (the
+        resume-parity test); a dump from a DIFFERENT mesh width or
+        walker count redistributes first (:meth:`_redistribute_swarm`)."""
         ck = self._load_ckpt()
         if ck is None:
             return None
@@ -803,6 +898,9 @@ class SwarmSearch(TensorSearch):
         lanes = self.lanes
         nf = len(self._flag_names)
         x = ck.extra
+        if (len(np.asarray(x["vdev"]).reshape(-1)) != D
+                or len(ck.frontier) != D * K):
+            ck, x = self._redistribute_swarm(ck, x)
         vdev = np.asarray(x["vdev"], np.int64)
         kmax = int(max(vdev.max(initial=0), 1))
         kbuf = np.zeros((D, kmax, 4), np.uint32)

@@ -427,6 +427,12 @@ class Telemetry:
             "last_span": last_span,
             "in_flight": self._open_dispatch,
             "flight_log": self.flight_log,
+            # Live mesh width (ISSUE 9): how many devices the current
+            # rung is actually running on — fed by per-device level
+            # lanes and mesh_shrunk/rung events, so `telemetry watch`
+            # shows a degraded mesh the moment it shrinks.  Always
+            # present (schema-pinned); None until the first feed.
+            "mesh_width": None,
             **self._status,
         }
         tmp = self.status_path + ".tmp"
@@ -561,6 +567,17 @@ class Telemetry:
             if kind in ("rung", "capacity_retry"):
                 self._status["rung"] = {k: v for k, v in rec.items()
                                         if k not in ("t", "ts")}
+                if fields.get("width"):
+                    self._status["mesh_width"] = fields["width"]
+                self._write_status(force=True)
+            elif kind in ("mesh_shrunk", "knobs_shrunk"):
+                # Elastic-ladder degradations (ISSUE 9): the live
+                # monitor shows the CURRENT width and the last
+                # resilience action, not just that a rung changed.
+                self._status["resilience"] = {
+                    k: v for k, v in rec.items() if k not in ("t", "ts")}
+                if fields.get("to_width"):
+                    self._status["mesh_width"] = fields["to_width"]
                 self._write_status(force=True)
             elif kind in ("lane", "lane_winner", "failover",
                           "child_death"):
@@ -614,6 +631,11 @@ class Telemetry:
             delta = explored - self._prev_explored.get(engine, 0)
             self._prev_explored[engine] = explored
             wall = float(record.get("wall", 0.0) or 0.0)
+            pd = record.get("per_device") or {}
+            if pd.get("explored"):
+                # The per-device lanes ARE the live mesh width — a
+                # degraded rung's level records carry fewer lanes.
+                self._status["mesh_width"] = len(pd["explored"])
             self._status.update({
                 "engine": engine,
                 "depth": record.get("depth", 0),
@@ -655,7 +677,8 @@ class Telemetry:
         "failovers", "resumed_from_depth", "visited_overflow",
         "dropped", "spilled_keys", "host_tier_hits",
         "respilled_frontier", "walker_restarts", "swarm_overflow",
-        "child_restarts", "killed_dispatches", "abandoned_threads")
+        "child_restarts", "killed_dispatches", "abandoned_threads",
+        "mesh_width", "mesh_shrinks", "knob_retries")
 
     def on_outcome(self, out, engine: Optional[str] = None) -> None:
         """Ingest a SearchOutcome's accounting: one ``outcome`` record
@@ -813,7 +836,8 @@ def build_report(records: List[dict]) -> dict:
     for o in outcomes:
         for k in ("spilled_keys", "host_tier_hits", "respilled_frontier",
                   "visited_overflow", "dropped", "retries", "failovers",
-                  "walker_restarts", "swarm_overflow"):
+                  "walker_restarts", "swarm_overflow", "mesh_shrinks",
+                  "knob_retries"):
             if o.get(k):
                 counts[k] = counts.get(k, 0) + int(o[k])
     return {"meta": meta, "n_spans": len(spans),
@@ -1014,6 +1038,11 @@ def render_watch(path: str, now: Optional[float] = None) -> str:
             f"unique {st.get('unique', 0)}  "
             f"explored {st.get('explored', 0)}  "
             f"rate {rate if rate is not None else '?'} states/min")
+        if st.get("mesh_width"):
+            out.append(f"mesh width: {st['mesh_width']} device(s)")
+        if st.get("resilience"):
+            out.append("resilience: " + " ".join(
+                f"{k}={v}" for k, v in sorted(st["resilience"].items())))
         sk = st.get("skew") or {}
         if sk:
             parts = [f"{lane} imb={m.get('imbalance', 1.0):.2f} "
@@ -1092,6 +1121,21 @@ def read_ledger(path: str) -> List[dict]:
 _LEDGER_PHASES = ("headline", "strict", "beam", "swarm", "spill",
                   "cpu_fallback")
 
+# Resilience counters the ledger tracks beside the rates (ISSUE 9):
+# a bench run that suddenly needs mesh shrinks / knob re-levels /
+# failovers to land its number is a regression even at equal states/min.
+_RESILIENCE_COUNTERS = ("mesh_shrinks", "knob_retries", "failovers")
+
+
+def _counter_value(rec: dict, counter: str) -> Optional[int]:
+    v = rec.get(counter)
+    if v is None:
+        return None
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        return None
+
 
 def _phase_value(rec: dict, phase: str) -> Optional[float]:
     if phase == "headline":
@@ -1139,6 +1183,23 @@ def compare_ledger(records: List[dict],
             cmp["regressions"].append(entry)
         elif delta > threshold:
             cmp["improvements"].append(entry)
+    # Resilience regressions: the latest run needed MORE degradation
+    # (mesh shrinks / knob re-levels / failovers) than any prior run —
+    # flagged alongside the rate regressions (same rc).
+    cmp["resilience"] = {}
+    for counter in _RESILIENCE_COUNTERS:
+        lv = _counter_value(latest, counter)
+        if lv is None:
+            continue
+        priors = [v for v in (_counter_value(r, counter) for r in prior)
+                  if v is not None]
+        worst = max(priors) if priors else 0
+        entry = {"phase": f"resilience:{counter}", "latest": lv,
+                 "best_prior": worst,
+                 "delta_pct": 0.0}
+        cmp["resilience"][counter] = entry
+        if lv > worst:
+            cmp["regressions"].append(entry)
     return cmp
 
 
@@ -1157,6 +1218,9 @@ def render_compare(cmp: dict, source: str = "") -> str:
             continue
         out.append(f"{phase:14s} {e['latest']:12.1f} "
                    f"{e['best_prior']:12.1f} {e['delta_pct']:+7.1f}%")
+    for c, e in sorted(cmp.get("resilience", {}).items()):
+        out.append(f"resilience {c:14s} latest={e['latest']} "
+                   f"prior_worst={e['best_prior']}")
     for e in cmp["regressions"]:
         out.append(f"REGRESSION: phase={e['phase']} "
                    f"latest={e['latest']} vs best={e['best_prior']} "

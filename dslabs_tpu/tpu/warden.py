@@ -120,7 +120,8 @@ _SCALAR_FIELDS = (
     "elapsed_secs", "predicate_name", "exception_code", "trace",
     "dropped", "samples", "visited_overflow", "retries", "failovers",
     "resumed_from_depth", "engine", "levels", "compile_secs",
-    "child_restarts", "killed_dispatches", "abandoned_threads")
+    "child_restarts", "killed_dispatches", "abandoned_threads",
+    "mesh_width", "mesh_shrinks", "knob_retries")
 
 
 def outcome_to_dict(out) -> dict:
@@ -242,7 +243,10 @@ class Warden:
     ``fault`` injects a deterministic child-side fault for the CI
     matrix: ``{"kind": "hang"|"die"|"exit"|"raise", "at": k}`` fires at
     dispatch index ``k`` of the FIRST rung it matches (optional
-    ``"engine"`` restricts the rung) — a hang blocks the dispatch (the
+    ``"engine"`` restricts the rung; optional ``"spawns": [0, 1]``
+    targets spawn indices instead — how the elastic SIGKILL matrix
+    kills the 8-wide and 4-wide children but spares the 2-wide one) —
+    a hang blocks the dispatch (the
     warden must kill), ``die`` is SIGKILL-self (an external/OOM kill),
     ``exit`` is an abrupt ``os._exit``, ``raise`` a fatal in-child
     error reported over the pipe."""
@@ -270,7 +274,8 @@ class Warden:
                  fault: Optional[dict] = None,
                  env: Optional[dict] = None,
                  extra_sys_path: Optional[List[str]] = None,
-                 telemetry=None):
+                 telemetry=None,
+                 elastic: bool = False):
         # Unified telemetry (tpu/telemetry.py): child heartbeats from
         # the pipe protocol are re-emitted as parent-side telemetry
         # events, so the flight log shows the child's dispatch-level
@@ -305,6 +310,14 @@ class Warden:
         self.fault = fault
         self.env = env or {}
         self.extra_sys_path = list(extra_sys_path or [])
+        # Elastic degraded-mesh ladder (ISSUE 9): expand the "sharded"
+        # rung into width rungs sharded(D) -> ... -> sharded(2); each
+        # width runs in its own child on a rebuilt smaller mesh,
+        # resuming the unified checkpoint re-sharded to the new owner
+        # map (tpu/supervisor.py expand_ladder — one expansion rule for
+        # both modes).
+        self.elastic = bool(elastic)
+        self.mesh_shrinks = 0
         self.failures: List[EngineFailure] = []
         self.deaths: List[ChildDeath] = []
         self.killed_dispatches = 0
@@ -314,8 +327,13 @@ class Warden:
 
     # ------------------------------------------------------------- child io
 
-    def _spec(self, rung: str, resume: bool) -> dict:
+    def _spec(self, rung: str, resume: bool,
+              width: Optional[int] = None) -> dict:
         return {
+            # Degraded-mesh rung width (None = the child's full device
+            # set): the child builds make_mesh(width) for its sharded
+            # supervisor.
+            "mesh_width": width,
             "factory": self.factory,
             "factory_kwargs": self.factory_kwargs,
             "transform": self.transform,
@@ -357,11 +375,12 @@ class Warden:
         env.update(self.env)
         return env
 
-    def _run_child(self, rung: str, resume: bool) -> dict:
+    def _run_child(self, rung: str, resume: bool,
+                   width: Optional[int] = None) -> dict:
         """Spawn + supervise ONE rung child.  Returns the child's
         ``result`` message, or a death dict
         ``{"t": "death", "kind", "detail", "exitcode", "last_hb"}``."""
-        spec = self._spec(rung, resume)
+        spec = self._spec(rung, resume, width)
         proc = subprocess.Popen(
             [sys.executable, "-m", "dslabs_tpu.tpu.warden"],
             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
@@ -457,9 +476,32 @@ class Warden:
         self.failures = []
         self.deaths = []
         self.killed_dispatches = 0
+        self.mesh_shrinks = 0
+        if self.elastic:
+            import jax
+
+            from dslabs_tpu.tpu.supervisor import expand_ladder
+
+            specs = expand_ladder(self.ladder, len(jax.devices()), True)
+            full_width = len(jax.devices())
+        else:
+            specs = [(r, None) for r in self.ladder]
+            full_width = None
         spawned = 0
-        for i, rung in enumerate(self.ladder):
-            res = self._run_child(rung, resume=(resume or i > 0))
+        prev_width = None
+        for i, (rung, width) in enumerate(specs):
+            eff = None
+            if rung == "sharded" and self.elastic:
+                eff = width or full_width
+                if prev_width is not None and eff < prev_width:
+                    self.mesh_shrinks += 1
+                    if self.telemetry is not None:
+                        self.telemetry.event("mesh_shrunk",
+                                             from_width=prev_width,
+                                             to_width=eff)
+                prev_width = eff
+            res = self._run_child(rung, resume=(resume or i > 0),
+                                  width=eff)
             spawned += 1
             if res.get("t") == "result":
                 out = outcome_from_dict(res["outcome"])
@@ -468,6 +510,9 @@ class Warden:
                 out.failovers = len(self.failures)
                 out.child_restarts = spawned - 1
                 out.killed_dispatches = self.killed_dispatches
+                out.mesh_shrinks = self.mesh_shrinks
+                if out.mesh_width is None and eff is not None:
+                    out.mesh_width = eff
                 return out
             death = ChildDeath(rung=rung, kind=res["kind"],
                                exitcode=res.get("exitcode"),
@@ -537,7 +582,14 @@ def _child_main() -> int:
     fault = spec.get("fault")
     rung = spec["rung"]
     if fault is not None:
-        if fault.get("engine") is not None:
+        if fault.get("spawns") is not None:
+            # Explicit spawn targeting (the elastic SIGKILL matrix:
+            # kill the 8-wide AND the 4-wide child, let the 2-wide
+            # finish) — overrides the engine/first-child scoping, which
+            # cannot distinguish same-named width rungs.
+            if int(spec.get("spawn_index", 0)) not in fault["spawns"]:
+                fault = None
+        elif fault.get("engine") is not None:
             if fault["engine"] != rung:
                 fault = None
         elif int(spec.get("spawn_index", 0)) > 0:
@@ -614,8 +666,17 @@ def _child_main() -> int:
                 ckpt_path, engine_hint=f"warden-child:{rung}")
         except Exception:  # noqa: BLE001 — observability is optional
             child_tel = None
+    # Degraded-mesh rung: the child rebuilds the SMALLER mesh and its
+    # in-child supervisor resumes the unified checkpoint re-sharded to
+    # the new owner map (tpu/checkpoint.py carries everything needed).
+    mesh = None
+    width = spec.get("mesh_width")
+    if width and rung == "sharded":
+        from dslabs_tpu.tpu.sharded import make_mesh
+
+        mesh = make_mesh(int(width))
     sup = SearchSupervisor(
-        proto, ladder=(rung,), policy=policy,
+        proto, ladder=(rung,), policy=policy, mesh=mesh,
         checkpoint_path=ckpt_path,
         checkpoint_every=spec.get("checkpoint_every", 0),
         strict=spec.get("strict", True),

@@ -352,7 +352,7 @@ def test_status_json_schema_and_watch_finished_run(tmp_path, capsys):
     for key in ("t", "pid", "updated", "uptime", "spans", "levels",
                 "last_span", "in_flight", "flight_log", "engine",
                 "depth", "explored", "unique", "rate_per_min", "skew",
-                "per_device", "end_condition"):
+                "per_device", "end_condition", "mesh_width"):
         assert key in st, f"STATUS.json missing {key!r}"
     assert st["t"] == "status"
     assert st["pid"] == os.getpid()
@@ -361,6 +361,9 @@ def test_status_json_schema_and_watch_finished_run(tmp_path, capsys):
     assert st["end_condition"] == out.end_condition
     assert st["in_flight"] is None          # run finished cleanly
     assert len(st["per_device"]["explored"]) == 8
+    # Live mesh width (ISSUE 9): derived from the per-device lanes so
+    # `telemetry watch` shows a degraded mesh the moment it shrinks.
+    assert st["mesh_width"] == 8
 
     assert tel_mod.main(["watch", str(tmp_path), "--once"]) == 0
     text = capsys.readouterr().out
