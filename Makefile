@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast perf-smoke fault-smoke swarm-smoke capacity-smoke obs-smoke chaos-smoke lab0 lab1 lab2 lab3 lab4 bench dryrun handout clean
+.PHONY: test test-fast lint analysis-smoke perf-smoke fault-smoke swarm-smoke capacity-smoke obs-smoke chaos-smoke lab0 lab1 lab2 lab3 lab4 bench dryrun handout clean
 
 test:            ## full acceptance + parity suite
 	$(PY) -m pytest tests/ -q
@@ -14,6 +14,26 @@ test-fast:       ## skip the slowest files (TPU-engine parity compiles)
 
 lab0 lab1 lab2 lab3 lab4:   ## scored lab runs via the CLI driver
 	$(PY) run_tests.py --lab $(subst lab,,$@)
+
+# lint = the soundness sanitizer's full pass (ISSUE 10): the protocol
+# conformance linter (C1-C4 over specs/protocols/adapters/labs + the
+# ProtocolSpec compile gate) AND the jaxpr hot-path auditor (J0-J5
+# over the lowered dispatch-site programs of the pingpong engines on a
+# virtual CPU mesh, retrace check included).  Exit 1 on any unwaived
+# finding; .sanitizer-waivers documents the justified exceptions.
+# docs/analysis.md is the field guide.
+lint:            ## soundness sanitizer: conformance linter + jaxpr auditor
+	$(PY) -m dslabs_tpu.analysis all
+
+# analysis-smoke = the sanitizer's own test suite (tests/test_analysis.py):
+# one deliberately-violating red fixture per rule asserting the exact
+# finding code (C1-C4, J0-J5), the clean-pass pin on every shipped
+# protocol, the jaxpr zero-findings pin on the pingpong superstep +
+# promote for BOTH engines, SpecError compile-gate shapes, waiver-file
+# handling, and the CLI rc contract — then the CLI itself end to end.
+analysis-smoke:  ## sanitizer suite (red fixtures per rule + shipped-tree clean pin) on CPU
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m analysis -p no:cacheprovider
+	$(PY) -m dslabs_tpu.analysis all
 
 bench:           ## TPU states/min benchmark (one JSON line)
 	$(PY) bench.py

@@ -1038,6 +1038,26 @@ def main() -> None:
     else:
         result["spill_error"] = "skipped: deadline nearly exhausted"
 
+    # ---- phase 6: the soundness sanitizer (ISSUE 10) — findings per
+    # leg + waived count off `python -m dslabs_tpu.analysis all` in a
+    # CPU-pinned child (static: lowers, never compiles or dispatches).
+    # `telemetry compare` flags a findings increase over the best
+    # prior ledger entry as a regression, same rc-1 severity as a rate
+    # drop.  Never the headline, never fatal, skipped when the
+    # deadline is nearly spent.
+    if _remaining() - KILL_SLACK_SECS > 30:
+        try:
+            from dslabs_tpu import analysis
+
+            result["sanitizer"] = analysis.sanitizer_summary(
+                timeout=max(30, min(180, int(_remaining()
+                                             - KILL_SLACK_SECS))))
+        except Exception as e:  # noqa: BLE001 — JSON must still land
+            result["sanitizer"] = {"error": f"{type(e).__name__}: {e}"}
+    else:
+        result["sanitizer"] = {"error":
+                               "skipped: deadline nearly exhausted"}
+
     result["total_secs"] = round(time.time() - _T0, 1)
     _emit(result)
 

@@ -1,0 +1,268 @@
+"""Leg B of the soundness sanitizer: the jaxpr hot-path auditor
+(ISSUE 10).
+
+Every perf win since PR 3 rests on invariants nothing checked until
+now: supersteps make zero host round-trips, carries are donated so the
+table/frontier update in place, programs stay int32, single-device
+programs have no collectives, and the AOT-warmed executables keep
+hitting the persistent compile cache.  This module audits those
+invariants STATICALLY, over the lowered StableHLO of every registered
+dispatch-site program — enumerated from the same site registry
+telemetry keys its spans and profiler captures off
+(``tpu/telemetry.py DISPATCH_SITES``) via each engine's
+``dispatch_site_programs()``.  Lowering is trace-only: the audit never
+compiles and never dispatches device work (the one exception is
+SwarmSearch, whose carry shapes come from its real init program).
+
+Rules (codes pinned by tests/test_analysis.py; catalog in core.RULES):
+
+J0  registry coverage — an enumerated site missing from
+    ``DISPATCH_SITES``, or a program that failed to lower: audit rot
+    is itself a finding, never a silent skip.
+J1  host callback — ``custom_call``-lowered Python callbacks
+    (``jax.debug.print``, ``pure_callback``, ``io_callback``) or
+    infeed/outfeed inside a device program: each one is a host
+    round-trip per dispatch, exactly what the superstep refactor
+    removed.
+J2  float64 upcast — any ``f64`` tensor in the lowering: the engines
+    are int32/uint32 end to end; an f64 doubles HBM traffic and is
+    10x+ slower on TPU vector units.
+J3  donation audit — a site the registry declares donated
+    (``jit(..., donate_argnums=0)``) whose lowering kept NO
+    input/output aliasing for a large carry: the table+frontier would
+    reallocate every dispatch.
+J4  unexpected collective — ``all_reduce``/``all_gather``/… in a
+    program the registry declares single-device.
+J5  retrace hazard — rebuilding the program from its builder lowers
+    to DIFFERENT text: the compile-cache key churns, so every warden
+    child / failover rung / re-level pays a fresh XLA compile the
+    persistent cache was supposed to absorb.  (Deep check: run by the
+    CLI and ``DSLABS_SANITIZE=full``; plain ``DSLABS_SANITIZE=1``
+    skips the second trace at engine build time.)
+
+``DSLABS_SANITIZE=1`` runs J0–J4 at engine build time and records
+findings as telemetry ``sanitizer_finding`` events; off means off —
+zero added dispatches, zero host transfers, one env read
+(tests/test_telemetry.py overhead guard).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import re
+import warnings
+from typing import Dict, List, Optional
+
+from dslabs_tpu.analysis.core import (Finding, apply_waivers,
+                                      default_waiver_path, load_waivers)
+
+__all__ = ["audit_sites", "audit_search", "sanitize_engine",
+           "sanitize_enabled", "build_audit_engines"]
+
+_COLLECTIVES = ("stablehlo.all_reduce", "stablehlo.all_gather",
+                "stablehlo.all_to_all", "stablehlo.collective_permute",
+                "stablehlo.reduce_scatter",
+                "stablehlo.collective_broadcast")
+_ALIASING_MARKERS = ("tf.aliasing_output", "jax.buffer_donor")
+_F64_RE = re.compile(r"(?:<|x)f64\b")
+
+
+def sanitize_enabled() -> str:
+    """"" (off) | "on" (J0-J4) | "full" (adds the J5 double-trace)."""
+    v = os.environ.get("DSLABS_SANITIZE", "").strip().lower()
+    if v in ("1", "on", "true", "yes"):
+        return "on"
+    if v in ("2", "full", "deep"):
+        return "full"
+    return ""
+
+
+def _arg_bytes(args) -> int:
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(args):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        total += math.prod(shape) * dtype.itemsize
+    return total
+
+
+def _donate_min_bytes() -> int:
+    try:
+        return int(os.environ.get("DSLABS_SANITIZE_DONATE_MIN", "")
+                   or 65536)
+    except ValueError:
+        return 65536
+
+
+def _lower_text(fn, args) -> str:
+    return fn.lower(*args).as_text()
+
+
+def audit_sites(sites: Dict[str, dict], engine_name: str,
+                deep: bool = False) -> List[Finding]:
+    """Audit a ``{tag: entry}`` site map (the shape
+    ``dispatch_site_programs()`` returns):
+
+    entry = {"fn": jitted, "args": example (abstract ok) args,
+             "donate": declared donate_argnums tuple,
+             "multi": collectives expected?,
+             "builder": optional () -> fresh jitted fn (J5)}
+    """
+    from dslabs_tpu.tpu.telemetry import DISPATCH_SITES
+
+    findings: List[Finding] = []
+
+    def emit(code: str, tag: str, message: str) -> None:
+        findings.append(Finding(code=code, leg="jaxpr",
+                                path=engine_name, obj=tag,
+                                message=message))
+
+    for tag, entry in sorted(sites.items()):
+        meta = DISPATCH_SITES.get(tag)
+        if meta is None:
+            emit("J0", tag,
+                 "dispatch site is not in telemetry.DISPATCH_SITES — "
+                 "register it so spans, profiler captures, and this "
+                 "audit cover it")
+            meta = dict(hot=False, donated=bool(entry.get("donate")),
+                        multi=bool(entry.get("multi")), program=True)
+        try:
+            text = _lower_text(entry["fn"], entry["args"])
+        except Exception as e:  # noqa: BLE001 — an unlowerable site
+            emit("J0", tag,     # program is audit rot, loudly
+                 f"program failed to lower for audit: "
+                 f"{type(e).__name__}: {e}")
+            continue
+
+        for line in text.splitlines():
+            if ("custom_call" in line and "callback" in line.lower()) \
+                    or "stablehlo.infeed" in line \
+                    or "stablehlo.outfeed" in line:
+                emit("J1", tag,
+                     "host callback lowered into the device program "
+                     f"({line.strip()[:120]}) — one host round-trip "
+                     "per dispatch inside the hot loop")
+                break
+        if _F64_RE.search(text):
+            emit("J2", tag,
+                 "float64 tensor in the lowering — the engines are "
+                 "int32/uint32 end to end; find the upcast (an "
+                 "un-annotated np scalar or jnp.mean-style default)")
+        donated = bool(entry.get("donate")) or meta.get("donated")
+        if donated:
+            nbytes = _arg_bytes(entry.get("args", ()))
+            if nbytes >= _donate_min_bytes() and not any(
+                    m in text for m in _ALIASING_MARKERS):
+                emit("J3", tag,
+                     f"declared donated but the lowering kept no "
+                     f"input/output aliasing over ~{nbytes >> 10} KiB "
+                     f"of carry — the buffers reallocate every "
+                     f"dispatch (donate_argnums dropped, or shapes "
+                     f"mismatch the donated outputs)")
+        if not (entry.get("multi") or meta.get("multi")):
+            hit = next((c for c in _COLLECTIVES if c in text), None)
+            if hit is not None:
+                emit("J4", tag,
+                     f"{hit.split('.')[-1]} in a single-device "
+                     f"program — a cross-device collective here means "
+                     f"the program was built against the wrong mesh "
+                     f"scope")
+        if deep and entry.get("builder") is not None:
+            try:
+                text2 = _lower_text(entry["builder"](), entry["args"])
+            except Exception as e:  # noqa: BLE001
+                emit("J0", tag,
+                     f"builder failed to rebuild the program for the "
+                     f"retrace check: {type(e).__name__}: {e}")
+                continue
+            if text2 != text:
+                emit("J5", tag,
+                     "rebuilding the program lowers to different HLO "
+                     "— the compile-cache key churns, so every warden "
+                     "child / failover rung / knob re-level pays a "
+                     "fresh XLA compile (fresh per-build constants or "
+                     "id()-ordered iteration in the program builder)")
+    return findings
+
+
+def audit_search(search, deep: bool = False) -> List[Finding]:
+    """Audit one built engine via its ``dispatch_site_programs()``."""
+    sites = search.dispatch_site_programs()
+    return audit_sites(sites, type(search).__name__, deep=deep)
+
+
+def sanitize_engine(search) -> List[Finding]:
+    """The ``DSLABS_SANITIZE`` build-time hook (called from the tail of
+    each engine's ``__init__``): audit, apply waivers, record findings
+    as telemetry events, warn once.  Never raises — a sanitizer crash
+    must not take the engine down with it."""
+    mode = sanitize_enabled()
+    if not mode:
+        return []
+    try:
+        findings = audit_search(search, deep=(mode == "full"))
+        findings = apply_waivers(findings,
+                                 load_waivers(default_waiver_path()))
+    except Exception as e:  # noqa: BLE001 — never fatal at build time
+        warnings.warn(f"DSLABS_SANITIZE: audit failed on "
+                      f"{type(search).__name__}: "
+                      f"{type(e).__name__}: {e}", RuntimeWarning,
+                      stacklevel=2)
+        return []
+    tel = getattr(search, "_telemetry", None)
+    if tel is not None:
+        for f in findings:
+            tel.event("sanitizer_finding", code=f.code, site=f.obj,
+                      engine=f.path, message=f.message,
+                      waived=f.waived)
+    live = [f for f in findings if not f.waived]
+    if live:
+        warnings.warn(
+            f"DSLABS_SANITIZE: {len(live)} jaxpr-audit finding(s) on "
+            f"{type(search).__name__}: "
+            + "; ".join(f"[{f.code}] {f.obj}" for f in live[:6]),
+            RuntimeWarning, stacklevel=2)
+    return findings
+
+
+# ------------------------------------------------- CLI audit targets
+
+def build_audit_engines(mesh_devices: int = 2,
+                        with_swarm: bool = True,
+                        with_spill: bool = True) -> List:
+    """The CLI's standard audit set: pingpong twins on small caps —
+    single-device engine (plus its spill variant), the sharded
+    superstep engine, and the swarm — enough to cover every
+    program-bearing site family in DISPATCH_SITES.  Built, never run
+    (construction wraps jits lazily; only the audit's ``.lower()``
+    traces them)."""
+    from dslabs_tpu.tpu.engine import TensorSearch
+    from dslabs_tpu.tpu.protocols.pingpong import make_pingpong_protocol
+    from dslabs_tpu.tpu.sharded import ShardedTensorSearch, make_mesh
+
+    proto = make_pingpong_protocol(workload_size=2)
+    engines = [
+        TensorSearch(proto, max_depth=8, frontier_cap=1 << 8,
+                     visited_cap=1 << 10),
+        ShardedTensorSearch(proto, make_mesh(mesh_devices),
+                            chunk_per_device=16, frontier_cap=1 << 8,
+                            visited_cap=1 << 10, max_depth=8),
+    ]
+    if with_spill:
+        from dslabs_tpu.tpu.spill import spill_manager_for_audit
+
+        engines.append(TensorSearch(
+            proto, max_depth=8, frontier_cap=1 << 8,
+            visited_cap=1 << 10, spill=spill_manager_for_audit()))
+    if with_swarm:
+        from dslabs_tpu.tpu.swarm import SwarmSearch
+
+        engines.append(SwarmSearch(
+            proto, make_mesh(mesh_devices), walkers_per_device=8,
+            max_steps=8, max_rounds=2, visited_cap=1 << 10))
+    return engines

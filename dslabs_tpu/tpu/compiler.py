@@ -41,7 +41,39 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 __all__ = ["Field", "MessageType", "TimerType", "NodeKind",
-           "ProtocolSpec", "Ctx"]
+           "ProtocolSpec", "Ctx", "SpecError"]
+
+
+class SpecError(Exception):
+    """A structured spec-conformance failure raised at
+    :meth:`ProtocolSpec.compile` time (ISSUE 10 satellite: malformed
+    specs used to surface as bare KeyError/shape errors deep inside the
+    engine; now the offending handler and field are named at the
+    compile gate, which is what lets the conformance linter —
+    ``python -m dslabs_tpu.analysis conformance`` — treat compile as
+    the C4 spec-hygiene authority for generated twins, ROADMAP #3).
+
+    ``handler``/``kind``/``field``/``line`` carry the structured
+    location; ``code`` is the sanitizer rule that owns the failure
+    (C4 unless stated otherwise)."""
+
+    def __init__(self, message: str, *, spec: Optional[str] = None,
+                 handler: Optional[str] = None,
+                 kind: Optional[str] = None,
+                 field: Optional[str] = None,
+                 line: Optional[int] = None,
+                 code: str = "C4"):
+        self.spec = spec
+        self.handler = handler
+        self.kind = kind
+        self.field = field
+        self.line = line
+        self.code = code
+        loc = ""
+        if handler:
+            loc = f" [handler {handler}" + (
+                f" @ line {line}]" if line else "]")
+        super().__init__(f"{code}: {message}{loc}")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -85,7 +117,8 @@ class Ctx:
     ``when`` refinement): the compiler merges every branch with
     jnp.where, exactly the hand-twin discipline."""
 
-    def __init__(self, spec, st, kind, idx, cond, sends, sets):
+    def __init__(self, spec, st, kind, idx, cond, sends, sets,
+                 handler=None):
         self._spec = spec
         self._st = st
         self._kind = kind
@@ -93,18 +126,34 @@ class Ctx:
         self._cond = cond
         self._sends = sends
         self._sets = sets
+        self._handler = handler        # (name, firstlineno) or None
+
+    def _err(self, message: str, field: Optional[str] = None):
+        name, line = self._handler or (None, None)
+        return SpecError(message, spec=self._spec.name, handler=name,
+                         kind=self._kind, field=field, line=line)
+
+    def _key(self, field: str, op: str):
+        key = (self._kind, self._idx, field)
+        if key not in self._st:
+            declared = sorted({f for k, _, f in self._st
+                               if k == self._kind})
+            raise self._err(
+                f"{op} of undeclared field {field!r} on kind "
+                f"{self._kind!r} (declared: {declared})", field=field)
+        return key
 
     # ---------------------------------------------------------- accessors
 
     def get(self, field: str):
         """Current value of ``field`` (scalar, or [size] vector)."""
-        return self._st[(self._kind, self._idx, field)]
+        return self._st[self._key(field, "get")]
 
     def put(self, field: str, value, when=True):
         """Conditionally set ``field`` (guard & when)."""
         import jax.numpy as jnp
 
-        key = (self._kind, self._idx, field)
+        key = self._key(field, "put")
         cur = self._st[key]
         val = jnp.asarray(value, jnp.int32)
         self._st[key] = jnp.where(self._cond & when, val, cur).astype(
@@ -117,14 +166,14 @@ class Ctx:
         scalars; treat them as one-element vectors."""
         import jax.numpy as jnp
 
-        vec = jnp.atleast_1d(self.get(field))
+        vec = jnp.atleast_1d(self._st[self._key(field, "get_at")])
         oh = jnp.arange(vec.shape[0]) == i
         return jnp.sum(jnp.where(oh, vec, 0))
 
     def put_at(self, field: str, i, value, when=True):
         import jax.numpy as jnp
 
-        key = (self._kind, self._idx, field)
+        key = self._key(field, "put_at")
         cur = self._st[key]
         vec = jnp.atleast_1d(cur)
         oh = (jnp.arange(vec.shape[0]) == i) & self._cond & when
@@ -135,16 +184,45 @@ class Ctx:
     def cond(self, extra):
         """A refined child context (guard & extra) for nested logic."""
         return Ctx(self._spec, self._st, self._kind, self._idx,
-                   self._cond & extra, self._sends, self._sets)
+                   self._cond & extra, self._sends, self._sets,
+                   handler=self._handler)
 
     # ------------------------------------------------------------ effects
 
     def send(self, msg: str, to, when=True, **fields):
+        m = self._spec._mspec.get(msg)
+        if m is None:
+            raise self._err(
+                f"send of undeclared message {msg!r} (declared: "
+                f"{sorted(self._spec._mspec)})", field=msg)
+        unknown = sorted(set(fields) - set(m.fields))
+        missing = sorted(set(m.fields) - set(fields))
+        if unknown or missing:
+            raise self._err(
+                f"send({msg!r}): "
+                + (f"unknown fields {unknown}" if unknown else "")
+                + (" and " if unknown and missing else "")
+                + (f"missing fields {missing}" if missing else ""),
+                field=(unknown or missing)[0])
         self._sends.append(
             (self._spec._msg_row(msg, self.node_index(), to, fields),
              self._cond & when))
 
     def set_timer(self, timer: str, when=True, **fields):
+        t = self._spec._tspec.get(timer)
+        if t is None:
+            raise self._err(
+                f"set_timer of undeclared timer {timer!r} (declared: "
+                f"{sorted(self._spec._tspec)})", field=timer)
+        unknown = sorted(set(fields) - set(t.fields))
+        missing = sorted(set(t.fields) - set(fields))
+        if unknown or missing:
+            raise self._err(
+                f"set_timer({timer!r}): "
+                + (f"unknown fields {unknown}" if unknown else "")
+                + (" and " if unknown and missing else "")
+                + (f"missing fields {missing}" if missing else ""),
+                field=(unknown or missing)[0])
         self._sets.append(
             (self._spec._timer_row(timer, self.node_index(), fields),
              self._cond & when))
@@ -254,6 +332,62 @@ class ProtocolSpec:
             lanes.append(jnp.zeros((), jnp.int32))
         return jnp.stack(lanes)
 
+    # ----------------------------------------------------------- validate
+
+    def _handler_id(self, fn):
+        try:
+            return (fn.__name__, fn.__code__.co_firstlineno)
+        except AttributeError:
+            return (getattr(fn, "__name__", repr(fn)), None)
+
+    def validate(self) -> None:
+        """The C4 spec-hygiene compile gate (ISSUE 10): handler
+        registrations must reference declared node kinds and declared
+        message/timer types, and initial messages/timers must name
+        declared types — raised as structured :class:`SpecError`
+        instead of the bare KeyError/shape errors malformed specs used
+        to die with deep inside the engine.  Run automatically at the
+        top of :meth:`compile`; the conformance linter
+        (dslabs_tpu/analysis/conformance.py) reports the same failures
+        as findings without raising."""
+        kinds = {k.name for k in self.nodes}
+        for (kind, msg), fn in self.handlers.items():
+            name, line = self._handler_id(fn)
+            if kind not in kinds:
+                raise SpecError(
+                    f"handler registered for unknown node kind "
+                    f"{kind!r} (declared: {sorted(kinds)})",
+                    spec=self.name, handler=name, kind=kind, line=line)
+            if msg not in self._mtag:
+                raise SpecError(
+                    f"handler registered for unknown message {msg!r} "
+                    f"(declared: {sorted(self._mtag)})",
+                    spec=self.name, handler=name, kind=kind, field=msg,
+                    line=line)
+        for (kind, timer), fn in self.timer_handlers.items():
+            name, line = self._handler_id(fn)
+            if kind not in kinds:
+                raise SpecError(
+                    f"timer handler registered for unknown node kind "
+                    f"{kind!r} (declared: {sorted(kinds)})",
+                    spec=self.name, handler=name, kind=kind, line=line)
+            if timer not in self._ttag:
+                raise SpecError(
+                    f"timer handler registered for unknown timer "
+                    f"{timer!r} (declared: {sorted(self._ttag)})",
+                    spec=self.name, handler=name, kind=kind,
+                    field=timer, line=line)
+        for name, *_ in self.initial_messages:
+            if name not in self._mspec:
+                raise SpecError(
+                    f"initial message of undeclared type {name!r}",
+                    spec=self.name, field=name)
+        for name, *_ in self.initial_timers:
+            if name not in self._tspec:
+                raise SpecError(
+                    f"initial timer of undeclared type {name!r}",
+                    spec=self.name, field=name)
+
     # ------------------------------------------------------------ compile
 
     def compile(self):
@@ -262,6 +396,7 @@ class ProtocolSpec:
 
         from dslabs_tpu.tpu.engine import SENTINEL, TensorProtocol
 
+        self.validate()
         table, nw = self._layout()
         n_nodes = sum(k.count for k in self.nodes)
         spec = self
@@ -310,8 +445,9 @@ class ProtocolSpec:
                     payload = {f: msg[3 + j]
                                for j, f in enumerate(m.fields)}
                     payload["_from"] = frm
-                    ctx = Ctx(spec, st, kind.name, i, cond, sends, sets)
-                    fn(ctx, payload)
+                    ctx = Ctx(spec, st, kind.name, i, cond, sends, sets,
+                              handler=spec._handler_id(fn))
+                    spec._invoke(fn, ctx, payload, m.name)
             return (repack(st), _finalize(sends, max_sends, spec._mw),
                     _finalize(sets, max_sets, 1 + spec._tw))
 
@@ -328,8 +464,9 @@ class ProtocolSpec:
                     cond = here & (tag == spec._ttag[t.name])
                     payload = {f: timer[3 + j]
                                for j, f in enumerate(t.fields)}
-                    ctx = Ctx(spec, st, kind.name, i, cond, sends, sets)
-                    fn(ctx, payload)
+                    ctx = Ctx(spec, st, kind.name, i, cond, sends, sets,
+                              handler=spec._handler_id(fn))
+                    spec._invoke(fn, ctx, payload, t.name)
             return (repack(st), _finalize(sends, max_sends, spec._mw),
                     _finalize(sets, max_sets, 1 + spec._tw))
 
@@ -393,6 +530,23 @@ class ProtocolSpec:
             decode_timer=self.decode_timer,
         )
 
+    def _invoke(self, fn, ctx: "Ctx", payload: dict, typ: str):
+        """Run one handler under the compile gate: a KeyError on the
+        payload dict (reading a field the message/timer type does not
+        declare) surfaces as a structured SpecError naming the handler
+        — the bare-KeyError shape this satellite retires."""
+        try:
+            return fn(ctx, payload)
+        except KeyError as e:
+            name, line = self._handler_id(fn)
+            missing = e.args[0] if e.args else "?"
+            raise SpecError(
+                f"read of field {missing!r} not declared by "
+                f"{typ!r} (payload fields: "
+                f"{sorted(k for k in payload if k != '_from')})",
+                spec=self.name, handler=name, field=str(missing),
+                line=line) from e
+
     def _count_budgets(self) -> Tuple[int, int]:
         """Count worst-case send/set rows by running every handler once
         with a counting context (handlers are straight-line over the
@@ -418,10 +572,11 @@ class ProtocolSpec:
                     continue
                 sends, sets = [], []
                 ctx = Ctx(self, dummy_state(), kind.name, i, false,
-                          sends, sets)
-                fn(ctx, {f: jnp.zeros((), jnp.int32)
-                         for f in m.fields} | {"_from": jnp.zeros(
-                             (), jnp.int32)})
+                          sends, sets, handler=self._handler_id(fn))
+                self._invoke(
+                    fn, ctx, {f: jnp.zeros((), jnp.int32)
+                              for f in m.fields} | {"_from": jnp.zeros(
+                                  (), jnp.int32)}, m.name)
                 msg_sends += len(sends)
                 msg_sets += len(sets)
             for t in self.timers:
@@ -430,8 +585,11 @@ class ProtocolSpec:
                     continue
                 sends, sets = [], []
                 ctx = Ctx(self, dummy_state(), kind.name, i, false,
-                          sends, sets)
-                fn(ctx, {f: jnp.zeros((), jnp.int32) for f in t.fields})
+                          sends, sets, handler=self._handler_id(fn))
+                self._invoke(
+                    fn, ctx,
+                    {f: jnp.zeros((), jnp.int32) for f in t.fields},
+                    t.name)
                 tmr_sends += len(sends)
                 tmr_sets += len(sets)
         return (max(msg_sends, tmr_sends), max(msg_sets, tmr_sets))

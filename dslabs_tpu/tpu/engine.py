@@ -57,6 +57,7 @@ fingerprints by hash ownership (see ``dslabs_tpu/tpu/sharded.py``).
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -856,8 +857,67 @@ class TensorSearch:
         # Jitted device-loop programs, keyed by frontier-buffer capacity
         # (the buffer grows geometrically on overflow — see _run_device).
         self._dev_progs: Dict[int, tuple] = {}
+        # Soundness sanitizer (ISSUE 10): DSLABS_SANITIZE=1 statically
+        # audits this engine's dispatch-site programs at build time.
+        # Subclasses call _maybe_sanitize at the END of their own
+        # __init__ (their programs are not built yet here).
+        if type(self) is TensorSearch:
+            self._maybe_sanitize()
 
     # ------------------------------------------------------------- plumbing
+
+    def _maybe_sanitize(self) -> None:
+        """DSLABS_SANITIZE build-time hook (dslabs_tpu/analysis): off
+        means off — one env read, zero imports, zero dispatches (the
+        overhead-guard test pins it).  On, the jaxpr auditor lowers
+        every site program and records findings as telemetry events."""
+        if os.environ.get("DSLABS_SANITIZE", "").strip().lower() in (
+                "", "0", "off", "false", "no"):
+            return
+        from dslabs_tpu.analysis.jaxpr_audit import sanitize_engine
+
+        sanitize_engine(self)
+
+    def dispatch_site_programs(self) -> Dict[str, dict]:
+        """The site-program registry for the sanitizer's jaxpr auditor
+        (ISSUE 10): every lowered program this engine dispatches
+        through :meth:`_dispatch`, keyed by its dispatch tag (the same
+        tags telemetry.DISPATCH_SITES enumerates), with example
+        abstract args, the declared donation, and a ``builder`` that
+        re-derives the program for the retrace-hazard check.  Pure
+        host work: programs are jit-wrapped (already cached) and args
+        are ShapeDtypeStructs — nothing here traces, compiles, or
+        touches a device."""
+        C = self.chunk
+        cap = -(-self.frontier_cap // C) * C        # run()'s user_cap
+        step, promote, init = self._dev_programs(cap)
+        row_sds = jax.ShapeDtypeStruct((1, self.lanes), jnp.int32)
+        carry_sds = jax.eval_shape(init, row_sds)
+        rt = getattr(self, "_rt_masks", None)
+        sites = {
+            "device.init": dict(
+                fn=init, args=(row_sds,), donate=(), multi=False,
+                builder=lambda: jax.jit(self._build_dev_init(cap))),
+            "device.step": dict(
+                fn=step, args=(carry_sds, rt), donate=(0,),
+                multi=False,
+                builder=lambda: jax.jit(self._build_dev_step(cap),
+                                        donate_argnums=0)),
+            "device.promote": dict(
+                fn=promote, args=(carry_sds,), donate=(0,),
+                multi=False,
+                builder=lambda: jax.jit(self._build_dev_promote(cap),
+                                        donate_argnums=0)),
+        }
+        if self._spill is not None:
+            progs = self._spill_progs(cap)
+            sites["device.spill_drain"] = dict(
+                fn=progs["reset"], args=(carry_sds,), donate=(0,),
+                multi=False, builder=None)
+            sites["device.spill_evict"] = dict(
+                fn=progs["evict"], args=(carry_sds,), donate=(0,),
+                multi=False, builder=None)
+        return sites
 
     def _dispatch(self, tag: str, fn, *args):
         """THE device-dispatch boundary: every hot-loop dispatch and

@@ -289,6 +289,9 @@ class ShardedTensorSearch(TensorSearch):
         if (_env_on("DSLABS_AOT_WARMUP", False)
                 if aot_warmup is None else bool(aot_warmup)):
             self.aot_warmup()
+        # Soundness sanitizer (ISSUE 10): audit the freshly-built
+        # superstep/promote/init programs when DSLABS_SANITIZE is on.
+        self._maybe_sanitize()
 
     # --------------------------------------------------------- level chunk
 
@@ -950,6 +953,59 @@ class ShardedTensorSearch(TensorSearch):
         """The AOT-compiled executable for a program when the warm-up
         built one (invoked directly — zero retrace), else the lazy jit."""
         return getattr(self, "_aot_exes", {}).get(name) or default
+
+    def dispatch_site_programs(self):
+        """Sanitizer site registry (ISSUE 10; see the base-class
+        docstring): the ACTIVE driver's programs — the fused superstep
+        by default, the legacy per-chunk step + stats pair under
+        DSLABS_SHARDED_SUPERSTEP=0 — plus the level promote, the root
+        carry initializer, and the spill reset/evict shard_map programs
+        when the host tier is wired.  Args are the same abstract carry
+        (ShapeDtypeStruct + NamedSharding) the AOT warm-up lowers, so
+        the audit sees byte-identical programs to the ones dispatched."""
+        sds = self._carry_sds()
+        rt = getattr(self, "_rt_masks", None)
+        if self._has_rt_masks() and rt is None:
+            raise RuntimeError(
+                "runtime-mask protocol: call set_runtime_masks() "
+                "before dispatch_site_programs()")
+        mask_args = (rt,) if rt is not None else ()
+        b = jnp.asarray(1 << 30, jnp.int32)
+        sites = {}
+        if self.use_superstep:
+            sites["sharded.superstep"] = dict(
+                fn=self._superstep, args=(sds, b, *mask_args),
+                donate=(0,), multi=True,
+                builder=lambda: jax.jit(self._build_superstep(),
+                                        donate_argnums=0))
+        else:
+            sites["sharded.step"] = dict(
+                fn=self._chunk_step, args=(sds, *mask_args),
+                donate=(0,), multi=True,
+                builder=lambda: jax.jit(self._build_chunk_step(),
+                                        donate_argnums=0))
+            sites["sharded.sync"] = dict(
+                fn=self._stats, args=(sds,), donate=(), multi=False,
+                builder=None)
+        sites["sharded.promote"] = dict(
+            fn=self._finish_level, args=(sds,), donate=(0,),
+            multi=True,
+            builder=lambda: jax.jit(self._build_finish(),
+                                    donate_argnums=0))
+        rows0, key0, owner, home = self._root_ids(self.initial_state())
+        sites["sharded.init"] = dict(
+            fn=self._init_prog(owner, home),
+            args=(rows0[0], jnp.asarray(key0)), donate=(),
+            multi=True, builder=None)
+        if self._spill_on:
+            progs = self._sh_spill_progs()
+            sites["sharded.spill_drain"] = dict(
+                fn=progs["reset"], args=(sds,), donate=(0,),
+                multi=True, builder=None)
+            sites["sharded.spill_evict"] = dict(
+                fn=progs["evict"], args=(sds,), donate=(0,),
+                multi=True, builder=None)
+        return sites
 
     def _terminal_from_flags(self, carry, explored, vis_total, depth, t0):
         """Resolve the first terminal flag (checkState order) from the

@@ -9,7 +9,16 @@ hand-written twins in tpu/protocols/ (tests/test_compiler.py pins the
 unique-state counts and verdicts against both the hand twins and the
 object oracle; lane layouts differ — e.g. the compiler's uniform
 [tag, frm, to, payload] message records — which changes fingerprints
-but not the state graph)."""
+but not the state graph).
+
+Conformance contract (ISSUE 10): every spec in this module is
+sanitizer-clean — ``python -m dslabs_tpu.analysis conformance`` lints
+the handlers (purity / determinism / spec hygiene, rules C1-C4 in
+docs/analysis.md) and ``ProtocolSpec.compile()`` raises a structured
+``SpecError`` on hygiene violations, so a handler that mutates its
+payload or reads an undeclared field fails HERE, at the compile gate,
+not as a silent generated-vs-hand parity break deep in a search
+(tests/test_analysis.py pins the clean pass)."""
 
 from __future__ import annotations
 

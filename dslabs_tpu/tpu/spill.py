@@ -81,6 +81,7 @@ import numpy as np
 
 __all__ = ["SpillConfig", "SpillStats", "HostVisitedTier",
            "FrontierSpool", "SpillManager", "spill_env_default",
+           "spill_manager_for_audit",
            "VISITED_WARN_DEFAULT", "DROPPED_WARN_DEFAULT",
            "visited_warn_threshold", "dropped_warn_threshold"]
 
@@ -93,6 +94,15 @@ def spill_env_default() -> bool:
     if v is None:
         return False
     return v.strip().lower() not in ("0", "", "off", "false", "no")
+
+
+def spill_manager_for_audit() -> "SpillManager":
+    """A minimally-configured manager whose only job is flipping an
+    engine into spill mode so the sanitizer's jaxpr audit
+    (dslabs_tpu/analysis/jaxpr_audit.py) can lower and check the
+    spill-variant step/drain/evict programs — the audit never runs a
+    search, so the tier stays empty and the tiny host cap is free."""
+    return SpillManager(SpillConfig(high_water=0.60, host_cap=1 << 16))
 
 
 def visited_warn_threshold() -> float:

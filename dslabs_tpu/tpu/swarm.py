@@ -364,6 +364,34 @@ class SwarmSearch(TensorSearch):
         # legitimately runs up to steps_per_round walk steps.
         self._dispatch_deadline_scales = {
             "round": float(max(1, self.steps_per_round))}
+        # Soundness sanitizer (ISSUE 10): audit the fused round program
+        # when DSLABS_SANITIZE is on (base __init__ skips subclasses).
+        self._maybe_sanitize()
+
+    def dispatch_site_programs(self):
+        """Sanitizer site registry (ISSUE 10; base-class docstring):
+        the ONE hot swarm program — the fused round superstep.  Unlike
+        the BFS engines the round's carry shapes live on device (the
+        init shard_map builds them), so this runs the real swarm.init
+        once and abstracts its result; the audit itself still only
+        lowers."""
+        carry = self._init_carry(self.initial_state())
+
+        def _sds(x):
+            return jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=getattr(x, "sharding", None))
+
+        carry_sds = jax.tree.map(_sds, carry)
+        b = jnp.asarray(self.steps_per_round, jnp.int32)
+        rt = getattr(self, "_rt_masks", None)
+        args = ((carry_sds, b, rt) if rt is not None
+                else (carry_sds, b))
+        return {
+            "swarm.round": dict(
+                fn=self._round, args=args, donate=(0,), multi=True,
+                builder=lambda: jax.jit(self._build_round(),
+                                        donate_argnums=0)),
+        }
 
     # --------------------------------------------------- diversification
 

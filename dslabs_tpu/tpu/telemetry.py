@@ -98,11 +98,72 @@ __all__ = ["Telemetry", "MetricsRegistry", "Counter", "Gauge",
            "render_report", "render_sites", "skew_metrics",
            "device_memory_stats", "default_status_path", "load_status",
            "render_watch", "append_ledger", "read_ledger",
-           "compare_ledger", "render_compare", "main"]
+           "compare_ledger", "render_compare", "DISPATCH_SITES", "main"]
+
+# THE canonical dispatch-site registry (ISSUE 10): every tag the
+# engines route through ``TensorSearch._dispatch``, with the static
+# contract each site's lowered program is audited against by the
+# soundness sanitizer (dslabs_tpu/analysis/jaxpr_audit.py — the same
+# enumeration feeds the profiler-site selection below and the
+# sanitizer's coverage check, so a new dispatch site that skips this
+# table is a loud J0 finding, not silent audit rot).
+#
+#   hot      — steady-state dispatches worth a profiler capture
+#   donated  — the program's carry is declared jit(donate_argnums=0);
+#              the auditor verifies the lowering kept the aliasing
+#   multi    — cross-device collectives are EXPECTED (mesh programs);
+#              False means any collective is a J4 finding
+#   program  — the tag dispatches a lowered device program (False =
+#              a bare readback / host helper; nothing to audit)
+DISPATCH_SITES = {
+    "device.init":           dict(hot=False, donated=False, multi=False,
+                                  program=True),
+    "device.step":           dict(hot=True, donated=True, multi=False,
+                                  program=True),
+    "device.promote":        dict(hot=False, donated=True, multi=False,
+                                  program=True),
+    "device.sync":           dict(hot=False, donated=False, multi=False,
+                                  program=False),
+    "device.flags":          dict(hot=False, donated=False, multi=False,
+                                  program=False),
+    "device.spill_drain":    dict(hot=False, donated=True, multi=False,
+                                  program=True),
+    "device.spill_evict":    dict(hot=False, donated=True, multi=False,
+                                  program=True),
+    "device.spill_reinject": dict(hot=False, donated=True, multi=False,
+                                  program=False),
+    "sharded.superstep":     dict(hot=True, donated=True, multi=True,
+                                  program=True),
+    "sharded.step":          dict(hot=True, donated=True, multi=True,
+                                  program=True),
+    "sharded.promote":       dict(hot=False, donated=True, multi=True,
+                                  program=True),
+    "sharded.init":          dict(hot=False, donated=False, multi=True,
+                                  program=True),
+    "sharded.sync":          dict(hot=False, donated=False, multi=False,
+                                  program=True),
+    "sharded.spill_drain":   dict(hot=False, donated=True, multi=True,
+                                  program=True),
+    "sharded.spill_evict":   dict(hot=False, donated=True, multi=True,
+                                  program=True),
+    "sharded.spill_reinject": dict(hot=False, donated=True, multi=True,
+                                   program=False),
+    "swarm.round":           dict(hot=True, donated=True, multi=True,
+                                  program=True),
+    "swarm.init":            dict(hot=False, donated=False, multi=True,
+                                  program=False),
+    "swarm.flags":           dict(hot=False, donated=False, multi=True,
+                                  program=False),
+    "host.expand":           dict(hot=True, donated=False, multi=False,
+                                  program=False),
+}
 
 # Hot-loop sites whose steady-state dispatches are worth a profiler
-# capture (the compile-paying first dispatch at a site is skipped).
-_PROFILE_SITES = ("superstep", "step", "round", "expand")
+# capture (the compile-paying first dispatch at a site is skipped) —
+# derived from the registry so the two views cannot drift.
+_PROFILE_SITES = tuple(sorted({t.split(".", 1)[1]
+                               for t, m in DISPATCH_SITES.items()
+                               if m["hot"]}))
 
 
 def _env_float(name: str, default: float) -> float:
@@ -1126,6 +1187,22 @@ _LEDGER_PHASES = ("headline", "strict", "beam", "swarm", "spill",
 # failovers to land its number is a regression even at equal states/min.
 _RESILIENCE_COUNTERS = ("mesh_shrinks", "knob_retries", "failovers")
 
+# Sanitizer counters off the bench JSON's ``sanitizer`` block
+# (ISSUE 10): a run whose soundness-sanitizer findings INCREASE over
+# the best (fewest-findings) prior run regressed static correctness —
+# flagged with the same rc-1 severity as a rate regression.
+_SANITIZER_COUNTERS = ("findings", "conformance", "jaxpr")
+
+
+def _sanitizer_value(rec: dict, counter: str) -> Optional[int]:
+    s = rec.get("sanitizer")
+    if not isinstance(s, dict) or counter not in s:
+        return None
+    try:
+        return int(s[counter])
+    except (TypeError, ValueError):
+        return None
+
 
 def _counter_value(rec: dict, counter: str) -> Optional[int]:
     v = rec.get(counter)
@@ -1200,6 +1277,25 @@ def compare_ledger(records: List[dict],
         cmp["resilience"][counter] = entry
         if lv > worst:
             cmp["regressions"].append(entry)
+    # Sanitizer regressions (ISSUE 10): the latest run's soundness
+    # findings vs the BEST (fewest) prior — any increase is a
+    # regression; waived findings never count (they are documented
+    # exceptions, not drift).
+    cmp["sanitizer"] = {}
+    for counter in _SANITIZER_COUNTERS:
+        lv = _sanitizer_value(latest, counter)
+        if lv is None:
+            continue
+        priors = [v for v in (_sanitizer_value(r, counter)
+                              for r in prior) if v is not None]
+        if not priors:
+            continue
+        best = min(priors)
+        entry = {"phase": f"sanitizer:{counter}", "latest": lv,
+                 "best_prior": best, "delta_pct": 0.0}
+        cmp["sanitizer"][counter] = entry
+        if lv > best:
+            cmp["regressions"].append(entry)
     return cmp
 
 
@@ -1221,6 +1317,9 @@ def render_compare(cmp: dict, source: str = "") -> str:
     for c, e in sorted(cmp.get("resilience", {}).items()):
         out.append(f"resilience {c:14s} latest={e['latest']} "
                    f"prior_worst={e['best_prior']}")
+    for c, e in sorted(cmp.get("sanitizer", {}).items()):
+        out.append(f"sanitizer {c:15s} latest={e['latest']} "
+                   f"prior_best={e['best_prior']}")
     for e in cmp["regressions"]:
         out.append(f"REGRESSION: phase={e['phase']} "
                    f"latest={e['latest']} vs best={e['best_prior']} "

@@ -59,6 +59,11 @@ def _parse_args(argv):
     p.add_argument("--checks", action="store_true",
                    help="enable conformance checks (determinism, "
                         "idempotence, clone consistency)")
+    p.add_argument("--lint", action="store_true",
+                   help="run the static protocol conformance linter "
+                        "(dslabs_tpu/analysis, rules C1-C4) before the "
+                        "selected labs; unwaived findings fail the run "
+                        "(docs/analysis.md)")
     p.add_argument("--no-timeouts", action="store_true",
                    help="disable per-test timeouts")
     p.add_argument("--single-threaded", action="store_true",
@@ -200,6 +205,20 @@ def main(argv=None) -> int:
     if backend != "tensor":
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
     _apply_flags(args)
+
+    if args.lint:
+        # The static half of --checks (ISSUE 10): the runtime checks
+        # catch a mutation when a run happens to hit it; the linter
+        # catches the pattern before any search runs.  Findings gate
+        # the labs — a protocol that fails conformance would produce
+        # untrustworthy verdicts anyway.
+        from dslabs_tpu import analysis
+
+        findings = analysis.run_conformance()
+        print(analysis.render_findings(findings,
+                                       header="conformance lint"))
+        if any(not f.waived for f in findings):
+            return 1
 
     if args.replay_traces:
         return _replay_traces()

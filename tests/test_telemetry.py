@@ -102,12 +102,15 @@ def test_span_count_equals_dispatch_count_sharded_engine():
 
 def test_overhead_guard_no_added_dispatches_or_transfers(
         monkeypatch, tmp_path):
-    """ACCEPTANCE (extended by ISSUE 8): telemetry adds ZERO device
-    dispatches and ZERO device->host readbacks — dispatch counts and
-    device_get call counts are bit-identical with and without the
-    recorder, both engines, WITH the per-device stats lanes and the
-    STATUS.json live-monitor writer enabled (full flight-recorder
-    config, not a RAM-only stub)."""
+    """ACCEPTANCE (extended by ISSUE 8, then ISSUE 10): telemetry adds
+    ZERO device dispatches and ZERO device->host readbacks — dispatch
+    counts and device_get call counts are bit-identical with and
+    without the recorder, both engines, WITH the per-device stats
+    lanes and the STATUS.json live-monitor writer enabled (full
+    flight-recorder config, not a RAM-only stub).  ISSUE 10 extension:
+    the soundness sanitizer OFF (DSLABS_SANITIZE unset or =0) adds
+    zero dispatches, zero transfers, and zero telemetry events too."""
+    monkeypatch.delenv("DSLABS_SANITIZE", raising=False)
     proto = _pruned_pingpong()
     gets = []
     real = engine.device_get
@@ -141,6 +144,18 @@ def test_overhead_guard_no_added_dispatches_or_transfers(
     assert (o0.unique_states, o0.end_condition) == \
         (o1.unique_states, o1.end_condition)
     assert (tmp_path / "dev" / "STATUS.json").exists()
+
+    # ISSUE 10: DSLABS_SANITIZE=0 is bit-identical to unset — same
+    # dispatch schedule, same transfer count, and no sanitizer events
+    # in the recorder.
+    monkeypatch.setenv("DSLABS_SANITIZE", "0")
+    tel_off = full_tel("dev-sanitize-off")
+    c2, g2, _o2 = run_device(tel_off)
+    assert c2 == c0, "DSLABS_SANITIZE=0 changed the dispatch schedule"
+    assert g2 == g0, "DSLABS_SANITIZE=0 added device->host transfers"
+    assert not [e for e in tel_off.events
+                if e.get("kind") == "sanitizer_finding"]
+    monkeypatch.delenv("DSLABS_SANITIZE", raising=False)
 
     def run_sharded(telemetry):
         counts = {}
