@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast lint analysis-smoke perf-smoke fault-smoke swarm-smoke capacity-smoke obs-smoke chaos-smoke lab0 lab1 lab2 lab3 lab4 bench dryrun handout clean
+.PHONY: test test-fast lint analysis-smoke perf-smoke fault-smoke swarm-smoke capacity-smoke obs-smoke chaos-smoke service-smoke lab0 lab1 lab2 lab3 lab4 bench dryrun handout clean
 
 test:            ## full acceptance + parity suite
 	$(PY) -m pytest tests/ -q
@@ -106,6 +106,22 @@ obs-smoke:       ## unified telemetry suite (flight recorder / metrics / reports
 # entry point.
 chaos-smoke:     ## elastic-mesh resilience suite (degraded ladder / knob shrink / seeded chaos soak) on CPU
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m chaos -p no:cacheprovider
+
+# service-smoke = the multi-tenant checking-service suite
+# (tests/test_service.py): the unified child-death taxonomy table
+# (warden exit codes + stderr OOM markers, agreeing with
+# supervisor.classify_oom), the structured queue-full retry-after
+# rejection (never raises, never blocks), journal torn-tail replay +
+# tmp/replace compaction, DRR fairness + per-tenant quotas, the
+# CPU-pinned conformance admission gate rejecting an unsound spec with
+# SpecError-derived findings BEFORE any twin compiles, and the
+# tenant-isolation chaos soak (3 tenants, seeded oom/hang/crash fault
+# schedule on one tenant: neighbors' verdicts bit-exact vs solo
+# baselines, the victim degraded-but-sound or structured-failed) —
+# then the `python -m dslabs_tpu.service` CLI end to end.
+# docs/service.md is the field guide.
+service-smoke:   ## multi-tenant checking service suite (queue / admission / fairness / isolation soak) on CPU
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m service -p no:cacheprovider
 
 dryrun:          ## multi-chip sharding dry run on a virtual CPU mesh
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -86,6 +86,7 @@ STRICT_CAP_SECS = 420.0      # child budget cap; parent adds kill slack
 BEAM_CAP_SECS = 300.0
 SWARM_CAP_SECS = 150.0       # swarm-explorer phase (ISSUE 5)
 SPILL_CAP_SECS = 120.0       # capacity-ladder phase (ISSUE 6)
+SERVICE_CAP_SECS = 120.0     # multi-tenant service phase (ISSUE 11)
 # Parent backstop beyond the child's budget.  Generous on purpose: the
 # child's time checks are level-granular (a slow level can overrun
 # max_secs by ~30 s, sharded.py round-3 note), the strict child floors
@@ -643,6 +644,70 @@ def _run_spill(budget_secs: float) -> dict:
     }
 
 
+def _run_service(budget_secs: float) -> dict:
+    """Checking-as-a-service phase (ISSUE 11, dslabs_tpu/service/): a
+    multi-tenant drain — three tenants submit small exhaustive
+    pingpong jobs through the admission gate into the bounded journal
+    queue, the DRR scheduler runs each as its own warden fault domain
+    — reporting PER-TENANT throughput and the fairness index
+    (max/mean verdicts-per-tenant-budget; `telemetry compare` flags a
+    rise past the threshold as a regression).  Same always-reports
+    guarantees as every phase: child-side time bound, heartbeats on
+    stderr, one JSON line on stdout."""
+    import tempfile
+
+    _persistent_cache()
+
+    from dslabs_tpu.service import CheckServer
+
+    t_phase = time.time()
+    root = tempfile.mkdtemp(prefix="service-", dir=_rundir())
+    tenants = ("alice", "bob", "carol")
+    jobs_per = max(1, int(os.environ.get("DSLABS_SERVICE_BENCH_JOBS",
+                                         "2") or "2"))
+    # Warden job children are grandchildren of the bench parent:
+    # _persistent_cache() only touches THIS process's jax config, so
+    # hand them the shared cache dir explicitly (same resolution as
+    # _persistent_cache) or every job pays a cold XLA build.
+    cache_dir = os.environ.get("DSLABS_COMPILE_CACHE") or (
+        "/tmp/jaxcache-cpu" if os.environ.get("DSLABS_FORCE_CPU")
+        else "/tmp/jaxcache")
+    srv = CheckServer(
+        root, workers=2, queue_cap=max(8, 3 * jobs_per + 1),
+        elastic=False, env={"DSLABS_COMPILE_CACHE": cache_dir})
+    rejected = 0
+    for j in range(jobs_per):
+        for t in tenants:
+            res = srv.submit(
+                factory="dslabs_tpu.tpu.protocols.pingpong:"
+                        "make_exhaustive_pingpong",
+                factory_kwargs={"workload_size": 2}, tenant=t,
+                chunk=64, frontier_cap=1 << 8, visited_cap=1 << 12,
+                max_secs=30.0)
+            if not res.get("accepted"):
+                rejected += 1
+    _hb(f"service: {3 * jobs_per} jobs submitted "
+        f"({rejected} rejected), draining")
+    summary = srv.drain(
+        max_secs=max(20.0, budget_secs - (time.time() - t_phase) - 10))
+    srv.close()
+    return {
+        "value": summary["verdicts_per_min"],
+        "jobs": summary["jobs"],
+        "completed": summary["completed"],
+        "failed": summary["failed"],
+        "rejected": rejected,
+        "fairness_index": summary["fairness_index"],
+        "per_tenant": {
+            t: {"verdicts": s["verdicts"],
+                "verdicts_per_min": s["verdicts_per_min"],
+                "budget_spent": s["budget_spent"]}
+            for t, s in summary["per_tenant"].items()},
+        "queue": summary["queue"],
+        "total_secs": round(time.time() - t_phase, 1),
+    }
+
+
 # ----------------------------------------------------------------- parent
 
 _CURRENT_CHILD = None     # live phase Popen, killed by the signal handler
@@ -936,6 +1001,13 @@ def main() -> None:
             if spill_res is not None:
                 result["spill"] = spill_res
                 _note_phase_telemetry(result, "spill", spill_res)
+        if _remaining() > 75:
+            svc, _svc_err = _sub(
+                ["--service", str(min(90.0, _remaining() - 15))],
+                min(90.0, _remaining() - 10), "service-cpu",
+                silence=PHASE_SILENCE_SECS)
+            if svc is not None:
+                result["service"] = svc
         _emit(result)
         return
 
@@ -1038,6 +1110,21 @@ def main() -> None:
     else:
         result["spill_error"] = "skipped: deadline nearly exhausted"
 
+    # ---- phase 5.5: the multi-tenant service drain (ISSUE 11) —
+    # per-tenant throughput + the fairness index the ledger compare
+    # tracks.  Never the headline; skipped rather than raced when the
+    # deadline is nearly spent.
+    budget = min(SERVICE_CAP_SECS, _remaining() - KILL_SLACK_SECS - 10)
+    if budget > 45:
+        svc, svc_err = _sub(["--service", str(budget)], budget,
+                            "service", silence=PHASE_SILENCE_SECS)
+        if svc is not None:
+            result["service"] = svc
+        else:
+            result["service_error"] = svc_err
+    else:
+        result["service_error"] = "skipped: deadline nearly exhausted"
+
     # ---- phase 6: the soundness sanitizer (ISSUE 10) — findings per
     # leg + waived count off `python -m dslabs_tpu.analysis all` in a
     # CPU-pinned child (static: lowers, never compiles or dispatches).
@@ -1085,6 +1172,11 @@ if __name__ == "__main__":
         budget = (float(sys.argv[2]) if len(sys.argv) > 2
                   else SPILL_CAP_SECS)
         print(json.dumps(_run_spill(budget)))
+        sys.exit(0)
+    if len(sys.argv) >= 2 and sys.argv[1] == "--service":
+        budget = (float(sys.argv[2]) if len(sys.argv) > 2
+                  else SERVICE_CAP_SECS)
+        print(json.dumps(_run_service(budget)))
         sys.exit(0)
     if len(sys.argv) >= 2 and sys.argv[1] == "--calibrate":
         print(json.dumps(_calibrate()))

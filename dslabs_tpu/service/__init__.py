@@ -1,0 +1,18 @@
+"""Checking-as-a-service (ISSUE 11): the resident multi-tenant search
+server — bounded persistent queue (service/queue.py), conformance
+admission gate + per-job warden fault domains (service/server.py), and
+the fairness-preserving DRR scheduler with taxonomy-driven degradation
+(service/scheduler.py).  CLI: ``python -m dslabs_tpu.service``.
+Field guide: docs/service.md."""
+
+from dslabs_tpu.service.queue import Job, ServiceQueue, replay_journal
+from dslabs_tpu.service.scheduler import (AttemptPlan, DeficitRoundRobin,
+                                          RetrySpec, degrade,
+                                          fairness_index)
+from dslabs_tpu.service.server import (CheckServer, SERVER_STATUS_NAME,
+                                       admission_check)
+
+__all__ = ["Job", "ServiceQueue", "replay_journal", "AttemptPlan",
+           "DeficitRoundRobin", "RetrySpec", "degrade",
+           "fairness_index", "CheckServer", "SERVER_STATUS_NAME",
+           "admission_check"]

@@ -1,0 +1,581 @@
+"""Checking-as-a-service: the resident multi-tenant search server
+(ISSUE 11 tentpole).
+
+The composition layer ROADMAP #2 asked for: every prerequisite landed
+in earlier PRs and this module only WIRES them —
+
+* **Admission gate** (PR 10): an untrusted (factory spec, predicate)
+  submission is linted by ``analysis.conformance`` in a **CPU-pinned
+  subprocess** (the spec's own code runs there, never in the server,
+  and never near the accelerator) BEFORE any twin is compiled.
+  Unsound protocols are rejected with structured ``SpecError``-derived
+  verdicts (rule code + location + message); a hung or crashing
+  admission child is itself a rejection, never a server stall.
+* **One fault domain per job** (PR 4): accepted jobs run as warden
+  children with their own run dir
+  (``<root>/jobs/<job_id>/`` — checkpoint, flight.jsonl, STATUS.json,
+  compile_cache: tpu/checkpoint.py ``run_dir_layout``), heartbeat-
+  reaped, so one tenant's OOM/hang/crash is a SIGKILL + classified
+  death in ITS domain — a neighbor's verdict stays bit-exact (proven
+  by the chaos soak in tests/test_service.py).
+* **Fairness-preserving degradation** (PR 9 + service/scheduler.py):
+  deaths classify through the unified taxonomy and buy strictly
+  lighter retries (oom -> knob-shrink re-level, wedge -> rung-step),
+  resumed from the job's durable checkpoint; a reported deterministic
+  failure lands a structured failure verdict — never a silent partial
+  one, and never an unbounded retry loop burning the queue.
+* **Bounded backpressure** (service/queue.py): a full queue answers
+  submission with a structured retry-after rejection instead of
+  blocking the front end.
+
+``SERVER_STATUS.json`` (atomic tmp+replace, same discipline as the
+per-run STATUS.json) aggregates what ``telemetry watch`` shows per
+job: queue depth/cap, backpressure state, per-tenant
+pending/running/completed/failed/rejected, and the live fairness
+index.  Knobs: the ``DSLABS_SERVICE_*`` table in docs/service.md.
+
+CLI: ``python -m dslabs_tpu.service {submit,status,drain}``
+(service/__main__.py).  Running THIS module as ``__main__`` is the
+admission child half, mirroring tpu/warden.py's parent/child split.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from dslabs_tpu.service.queue import Job, ServiceQueue
+from dslabs_tpu.service.scheduler import (AttemptPlan, DeficitRoundRobin,
+                                          RetrySpec, degrade,
+                                          fairness_index)
+
+__all__ = ["CheckServer", "SERVER_STATUS_NAME", "admission_check"]
+
+SERVER_STATUS_NAME = "SERVER_STATUS.json"
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _zero_stats() -> dict:
+    return {"submitted": 0, "completed": 0, "failed": 0, "rejected": 0,
+            "verdicts": 0, "budget_spent": 0.0}
+
+
+# -------------------------------------------------------------- admission
+
+def admission_check(factory: str, factory_kwargs: Optional[dict],
+                    transform: Optional[str],
+                    extra_sys_path: Optional[List[str]] = None,
+                    env: Optional[dict] = None,
+                    timeout: Optional[float] = None) -> List[dict]:
+    """Run the conformance gate over one factory spec in a CPU-pinned
+    subprocess.  Returns the finding dicts (``analysis.core.Finding``
+    shape, waivers applied); an empty list means admissible.  A child
+    that hangs past ``timeout`` (DSLABS_SERVICE_ADMIT_SECS, default
+    120) or dies abruptly IS a finding — a hostile spec must not be
+    able to wedge or crash its way past the gate."""
+    if timeout is None:
+        timeout = _env_float("DSLABS_SERVICE_ADMIT_SECS", 120.0)
+    child_env = dict(os.environ)
+    child_env["JAX_PLATFORMS"] = "cpu"
+    paths = [_REPO_ROOT] + list(extra_sys_path or [])
+    if child_env.get("PYTHONPATH"):
+        paths.append(child_env["PYTHONPATH"])
+    child_env["PYTHONPATH"] = os.pathsep.join(paths)
+    child_env.update(env or {})
+    spec = {"factory": factory, "factory_kwargs": factory_kwargs or {},
+            "transform": transform}
+
+    def _gate_error(message: str) -> List[dict]:
+        return [{"code": "C4", "leg": "conformance", "path": factory,
+                 "obj": "<admission>", "line": 0, "waived": False,
+                 "waiver": "", "message": message}]
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "dslabs_tpu.service.server"],
+            input=json.dumps(spec), capture_output=True, text=True,
+            env=child_env, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return _gate_error(
+            f"admission child exceeded {timeout:.0f}s (hung import or "
+            "hostile spec); rejected")
+    except OSError as e:
+        return _gate_error(f"admission child failed to spawn: {e}")
+    if proc.returncode != 0 or not proc.stdout.strip():
+        tail = (proc.stderr or "").strip().splitlines()[-1:][:1]
+        return _gate_error(
+            f"admission child died rc={proc.returncode} "
+            f"(stderr tail: {tail}); rejected")
+    try:
+        return json.loads(
+            proc.stdout.strip().splitlines()[-1]).get("findings", [])
+    except ValueError:
+        return _gate_error("admission child produced unparsable output")
+
+
+# ------------------------------------------------------------------ server
+
+class CheckServer:
+    """The resident server: bounded persistent queue + admission gate
+    + DRR scheduler + per-job warden fault domains.  Thread-safe;
+    ``drain`` runs the backlog on ``workers`` worker threads (each job
+    is its own child process tree, so workers only pay coordination).
+    """
+
+    def __init__(self, root: str,
+                 queue_cap: Optional[int] = None,
+                 quota: Optional[int] = None,
+                 quotas: Optional[Dict[str, int]] = None,
+                 workers: Optional[int] = None,
+                 admission: Optional[bool] = None,
+                 retry: Optional[RetrySpec] = None,
+                 warden_kwargs: Optional[dict] = None,
+                 env: Optional[dict] = None,
+                 extra_sys_path: Optional[List[str]] = None,
+                 elastic: bool = True):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.queue = ServiceQueue(self.root, cap=queue_cap)
+        self.workers = (workers if workers is not None
+                        else _env_int("DSLABS_SERVICE_WORKERS", 2))
+        if admission is None:
+            admission = os.environ.get(
+                "DSLABS_SERVICE_ADMISSION", "1").strip().lower() not in (
+                    "0", "off", "false", "no")
+        self.admission = bool(admission)
+        self.retry = retry or RetrySpec.from_env()
+        self.warden_kwargs = dict(warden_kwargs or {})
+        self.env = dict(env or {})
+        self.extra_sys_path = list(extra_sys_path or [])
+        self.elastic = bool(elastic)
+        self.sched = DeficitRoundRobin(
+            quota=(quota if quota is not None
+                   else _env_int("DSLABS_SERVICE_QUOTA", 1)),
+            quotas=quotas)
+        self.status_path = os.path.join(self.root, SERVER_STATUS_NAME)
+        self._lock = threading.Lock()
+        self._running: Dict[str, int] = {}
+        self._active = 0
+        self.stats: Dict[str, dict] = {}
+        self._admission_cache: Dict[tuple, List[dict]] = {}
+        self.results: List[dict] = []
+        # Crash recovery: the queue replays its journal on open; every
+        # still-pending job re-enters the scheduler (and will resume
+        # its own run-dir checkpoint when it runs).
+        for job in list(self.queue.pending):
+            self.sched.push(job)
+            self.stats.setdefault(job.tenant, _zero_stats())
+        self._write_status()
+
+    # ------------------------------------------------------------- submit
+
+    def submit(self, factory: str, tenant: str = "default",
+               factory_kwargs: Optional[dict] = None,
+               transform: Optional[str] = None,
+               strict: bool = True,
+               max_depth: Optional[int] = None,
+               max_secs: Optional[float] = None,
+               budget_units: float = 1.0,
+               chunk: int = 1 << 10,
+               frontier_cap: int = 1 << 14,
+               visited_cap: int = 1 << 20,
+               ladder: Tuple[str, ...] = ("device", "host"),
+               fault: Optional[dict] = None) -> dict:
+        """The submission protocol (docs/service.md).  Returns one of
+        three STRUCTURED results — never raises, never blocks:
+
+        * ``{"accepted": True, "job_id", "queue_depth"}``
+        * ``{"accepted": False, "reason": "unsound_spec",
+          "findings": […]}``  (admission gate, before any compile)
+        * ``{"accepted": False, "reason": "queue_full",
+          "retry_after_secs", "queue_depth", "queue_cap"}``
+        """
+        with self._lock:
+            st = self.stats.setdefault(tenant, _zero_stats())
+        if self.admission:
+            findings = self._admit(factory, factory_kwargs, transform)
+            unwaived = [f for f in findings if not f.get("waived")]
+            if unwaived:
+                self.queue.mark_rejected(
+                    tenant, "unsound_spec",
+                    {"factory": factory, "findings": unwaived[:8]})
+                with self._lock:
+                    st["rejected"] += 1
+                self._write_status()
+                return {"accepted": False, "rejected": True,
+                        "reason": "unsound_spec", "factory": factory,
+                        "findings": unwaived}
+        job = Job(job_id=self.queue.next_id(tenant), tenant=tenant,
+                  factory=factory, factory_kwargs=factory_kwargs,
+                  transform=transform, strict=strict,
+                  max_depth=max_depth, max_secs=max_secs,
+                  budget_units=budget_units, chunk=chunk,
+                  frontier_cap=frontier_cap, visited_cap=visited_cap,
+                  ladder=tuple(ladder), fault=fault)
+        res = self.queue.submit(job)
+        if res.get("accepted"):
+            with self._lock:
+                self.sched.push(job)
+                st["submitted"] += 1
+        else:
+            self.queue.mark_rejected(tenant, "queue_full")
+            with self._lock:
+                st["rejected"] += 1
+        self._write_status()
+        return res
+
+    def _admit(self, factory, factory_kwargs, transform) -> List[dict]:
+        key = (factory,
+               json.dumps(factory_kwargs or {}, sort_keys=True),
+               transform or "")
+        with self._lock:
+            cached = self._admission_cache.get(key)
+        if cached is not None:
+            return cached
+        findings = admission_check(factory, factory_kwargs, transform,
+                                   extra_sys_path=self.extra_sys_path,
+                                   env=self.env)
+        with self._lock:
+            self._admission_cache[key] = findings
+        return findings
+
+    # ------------------------------------------------------------ run job
+
+    def job_dir(self, job_id: str) -> str:
+        return os.path.join(self.root, "jobs", job_id)
+
+    def run_job(self, job: Job) -> dict:
+        """Run ONE job to a verdict or a structured failure, applying
+        the bounded degrade-and-retry policy (scheduler.degrade) across
+        warden launches.  Every attempt resumes the job's own durable
+        checkpoint; the fault domain is the warden child tree — nothing
+        here can take the server down."""
+        from dslabs_tpu.tpu.supervisor import SupervisorExhausted
+        from dslabs_tpu.tpu.warden import Warden
+
+        rd = self.job_dir(job.job_id)
+        os.makedirs(rd, exist_ok=True)
+        ckpt = os.path.join(rd, "ckpt.npz")
+        plan = AttemptPlan(attempt=1, chunk=job.chunk,
+                           ladder=tuple(job.ladder))
+        deaths: List[dict] = []
+        t0 = time.time()
+        while True:
+            self.queue.mark_started(job.job_id, plan.attempt)
+            w = Warden(
+                factory=job.factory,
+                factory_kwargs=job.factory_kwargs,
+                transform=job.transform,
+                ladder=plan.ladder,
+                checkpoint_path=ckpt, checkpoint_every=1,
+                strict=job.strict, max_depth=job.max_depth,
+                max_secs=job.max_secs, chunk=plan.chunk,
+                frontier_cap=job.frontier_cap,
+                visited_cap=job.visited_cap,
+                # Injected faults model an environment condition of the
+                # FIRST attempt; a scheduler-level retry runs clean.
+                fault=(job.fault if plan.attempt == 1 else None),
+                env=dict(self.env),
+                extra_sys_path=self.extra_sys_path,
+                elastic=self.elastic,
+                **self.warden_kwargs)
+            try:
+                out = w.run(resume=plan.attempt > 1)
+            except SupervisorExhausted:
+                deaths += [{"rung": d.rung, "kind": d.kind,
+                            "detail": d.detail[:200]} for d in w.deaths]
+                kind = w.deaths[-1].kind if w.deaths else "failed"
+                nxt = degrade(plan, kind, self.retry)
+                if nxt is None:
+                    failure = {
+                        "job_id": job.job_id, "tenant": job.tenant,
+                        "status": "failed", "kind": kind,
+                        "attempts": plan.attempt,
+                        "knob_shrinks": plan.knob_shrinks,
+                        "rung_steps": plan.rung_steps,
+                        "deaths": deaths,
+                        "run_dir": rd,
+                        "elapsed_secs": round(time.time() - t0, 2),
+                    }
+                    self.queue.mark_failed(job.job_id, {
+                        "kind": kind, "attempts": plan.attempt,
+                        "deaths": len(deaths)})
+                    return failure
+                time.sleep(self.retry.backoff(plan.attempt - 1))
+                plan = nxt
+                continue
+            except BaseException as e:  # noqa: BLE001 — structured, never silent
+                failure = {
+                    "job_id": job.job_id, "tenant": job.tenant,
+                    "status": "failed", "kind": "error",
+                    "error": f"{type(e).__name__}: {e}"[:300],
+                    "attempts": plan.attempt, "deaths": deaths,
+                    "run_dir": rd,
+                    "elapsed_secs": round(time.time() - t0, 2),
+                }
+                self.queue.mark_failed(job.job_id, {
+                    "kind": "error",
+                    "error": failure["error"][:200]})
+                return failure
+            deaths += [{"rung": d.rung, "kind": d.kind,
+                        "detail": d.detail[:200]} for d in w.deaths]
+            verdict = {
+                "job_id": job.job_id, "tenant": job.tenant,
+                "status": "done",
+                "end": out.end_condition,
+                "unique": out.unique_states,
+                "explored": out.states_explored,
+                "depth": out.depth,
+                "engine": out.engine,
+                "attempts": plan.attempt,
+                "failovers": out.failovers,
+                "child_restarts": out.child_restarts,
+                "knob_shrinks": plan.knob_shrinks,
+                "rung_steps": plan.rung_steps,
+                "resumed_from_depth": out.resumed_from_depth,
+                "degraded": bool(deaths or plan.knob_shrinks
+                                 or plan.rung_steps),
+                "deaths": deaths,
+                "run_dir": rd,
+                "elapsed_secs": round(time.time() - t0, 2),
+            }
+            self.queue.mark_done(job.job_id, {
+                "end": out.end_condition, "unique": out.unique_states,
+                "explored": out.states_explored, "depth": out.depth,
+                "attempts": plan.attempt,
+                "degraded": verdict["degraded"]})
+            return verdict
+
+    # -------------------------------------------------------------- drain
+
+    def drain(self, max_secs: Optional[float] = None,
+              workers: Optional[int] = None) -> dict:
+        """Run the backlog to completion (or the deadline) and return
+        the aggregate summary — per-tenant throughput, fairness index,
+        queue state.  Each worker thread coordinates; the actual
+        search work lives in per-job warden child processes."""
+        n_workers = max(1, workers if workers is not None
+                        else self.workers)
+        deadline = (time.time() + max_secs) if max_secs else None
+        t0 = time.time()
+
+        def worker():
+            while True:
+                if deadline is not None and time.time() > deadline:
+                    return
+                job = None
+                with self._lock:
+                    job = self.sched.pick(self._running)
+                    if job is None:
+                        if self.sched.pending() == 0 and self._active == 0:
+                            return
+                    else:
+                        self.queue.pop(job.job_id)
+                        self._running[job.tenant] = \
+                            self._running.get(job.tenant, 0) + 1
+                        self._active += 1
+                        st = self.stats.setdefault(job.tenant,
+                                                   _zero_stats())
+                        st["budget_spent"] += job.budget_units
+                if job is None:
+                    time.sleep(0.05)
+                    continue
+                try:
+                    res = self.run_job(job)
+                finally:
+                    with self._lock:
+                        self._running[job.tenant] -= 1
+                        self._active -= 1
+                with self._lock:
+                    st = self.stats.setdefault(job.tenant, _zero_stats())
+                    if res.get("status") == "done":
+                        st["completed"] += 1
+                        st["verdicts"] += 1
+                    else:
+                        st["failed"] += 1
+                    self.results.append(res)
+                self._write_status()
+
+        threads = [threading.Thread(target=worker, daemon=True,
+                                    name=f"dslabs-service-worker-{i}")
+                   for i in range(n_workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        self._write_status(force=True)
+        with self._lock:
+            results = list(self.results)
+            per_tenant = {t: dict(s) for t, s in self.stats.items()}
+        done = [r for r in results if r.get("status") == "done"]
+        failed = [r for r in results if r.get("status") != "done"]
+        wall = max(time.time() - t0, 1e-9)
+        for stats in per_tenant.values():
+            stats["verdicts_per_min"] = round(
+                stats["verdicts"] / wall * 60.0, 2)
+        return {
+            "jobs": len(results),
+            "completed": len(done),
+            "failed": len(failed),
+            "verdicts_per_min": round(len(done) / wall * 60.0, 2),
+            "fairness_index": fairness_index(per_tenant),
+            "per_tenant": per_tenant,
+            "queue": self.queue.summary(),
+            "wall_secs": round(wall, 2),
+            "results": results,
+        }
+
+    # ------------------------------------------------------------- status
+
+    def server_status(self) -> dict:
+        qs = self.queue.summary()
+        with self._lock:
+            pending = self.sched.pending_by_tenant()
+            tenants = {}
+            for t in set(list(self.stats) + list(pending)
+                         + list(self._running)):
+                s = self.stats.get(t, _zero_stats())
+                tenants[t] = {
+                    "pending": pending.get(t, 0),
+                    "running": self._running.get(t, 0),
+                    "completed": s["completed"],
+                    "failed": s["failed"],
+                    "rejected": s["rejected"],
+                    "budget_spent": round(s["budget_spent"], 3),
+                }
+            return {
+                "t": "server_status",
+                "updated": round(time.time(), 3),
+                "pid": os.getpid(),
+                "workers": self.workers,
+                "queue_depth": qs["queue_depth"],
+                "queue_cap": qs["queue_cap"],
+                "backpressure": qs["backpressure"],
+                "journal_error": qs["journal_error"],
+                "tenants": tenants,
+                "fairness_index": fairness_index(self.stats),
+            }
+
+    def _write_status(self, force: bool = False) -> None:
+        """Atomic SERVER_STATUS.json rewrite (tmp + ``os.replace``) —
+        a reader or a SIGKILL never sees a torn file; an unwritable
+        root disables the monitor, never the service."""
+        st = self.server_status()
+        tmp = self.status_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                f.write(json.dumps(st))
+            os.replace(tmp, self.status_path)
+        except OSError:
+            self.status_path = None
+
+    def close(self) -> None:
+        self.queue.close()
+
+
+# ------------------------------------------------------- admission child
+
+def _resolve(ref: str):
+    import importlib
+
+    mod, _, name = ref.partition(":")
+    obj = importlib.import_module(mod)
+    for part in name.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def _admission_main() -> int:
+    """The CPU-pinned admission child: read one factory spec from
+    stdin, lint its module with the conformance linter, build the spec
+    object (NEVER a twin/engine — no search is constructed here), run
+    the live C4 introspection when it is a ProtocolSpec, and print the
+    waiver-applied findings as one JSON line.  Any escape is the
+    parent's "child died" rejection — a hostile spec cannot get past
+    the gate by crashing it."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 — jax may be absent for pure lint
+        pass
+    spec = json.load(sys.stdin)
+    factory = spec["factory"]
+    mod_name = factory.partition(":")[0]
+
+    from dslabs_tpu.analysis import conformance
+    from dslabs_tpu.analysis.core import (Finding, apply_waivers,
+                                          load_waivers, repo_root)
+
+    findings: List[Finding] = []
+
+    def _gate(message: str, code: str = "C4", line: int = 0) -> None:
+        findings.append(Finding(
+            code=code, leg="conformance", path=factory,
+            obj="<admission>", line=line, message=message))
+
+    mod = None
+    try:
+        import importlib
+
+        mod = importlib.import_module(mod_name)
+    except BaseException as e:  # noqa: BLE001 — import errors are findings
+        _gate(f"factory import failed: {type(e).__name__}: {e}")
+    if mod is not None and getattr(mod, "__file__", None):
+        try:
+            with open(mod.__file__) as f:
+                src = f.read()
+            rel = os.path.relpath(mod.__file__, repo_root())
+            if rel.startswith(".."):
+                rel = mod.__file__
+            findings += conformance.lint_source(src, rel)
+        except OSError as e:
+            _gate(f"factory module unreadable: {e}")
+        from dslabs_tpu.tpu.compiler import ProtocolSpec, SpecError
+
+        try:
+            proto = _resolve(factory)(**(spec.get("factory_kwargs")
+                                         or {}))
+            if spec.get("transform"):
+                proto = _resolve(spec["transform"])(proto)
+            if isinstance(proto, ProtocolSpec):
+                findings += conformance.check_spec(
+                    proto, origin=rel if mod else factory)
+        except SpecError as e:
+            _gate(str(e), code=e.code, line=e.line or 0)
+        except BaseException as e:  # noqa: BLE001 — a raising factory is unsound
+            _gate(f"factory raised {type(e).__name__}: {e}")
+    try:
+        apply_waivers(findings, load_waivers())
+    except ValueError as e:
+        _gate(f"waiver file malformed: {e}")
+    sys.stdout.write(json.dumps(
+        {"findings": [f.as_dict() for f in findings]}) + "\n")
+    sys.stdout.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(_admission_main())

@@ -22,7 +22,8 @@ import numpy as np
 
 from dslabs_tpu.tpu.engine import SENTINEL, TensorProtocol
 
-__all__ = ["make_pingpong_protocol", "SERVER", "CLIENT"]
+__all__ = ["make_pingpong_protocol", "make_exhaustive_pingpong",
+           "SERVER", "CLIENT"]
 
 SERVER, CLIENT = 0, 1
 REQ, REPLY = 0, 1
@@ -142,3 +143,17 @@ def make_pingpong_protocol(workload_size: int) -> TensorProtocol:
         decode_message=decode_message,
         decode_timer=decode_timer,
     )
+
+
+def make_exhaustive_pingpong(workload_size: int = 2) -> TensorProtocol:
+    """The goal-pruned exhaustive variant: CLIENTS_DONE becomes a prune
+    so a strict search measures full-space parity instead of a
+    first-goal race — the canonical small JOB UNIT the checking
+    service, its chaos-isolation soak, and the bench's ``service``
+    phase all submit (a ``"module:callable"`` factory spec that crosses
+    the warden spawn boundary with no transform needed)."""
+    import dataclasses
+
+    p = make_pingpong_protocol(workload_size)
+    return dataclasses.replace(
+        p, goals={}, prunes={"CLIENTS_DONE": p.goals["CLIENTS_DONE"]})

@@ -85,6 +85,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import random
+import signal
 import threading
 import time
 import warnings
@@ -95,8 +96,8 @@ from dslabs_tpu.tpu import checkpoint as ckpt_mod
 __all__ = ["TransientDeviceError", "DispatchTimeout", "EngineFailure",
            "SupervisorExhausted", "RetryPolicy", "FaultRule", "FaultPlan",
            "DispatchBoundary", "SearchSupervisor", "classify_failure",
-           "classify_oom", "expand_ladder", "install_retry",
-           "probe_device"]
+           "classify_oom", "classify_child_death", "CHILD_RC_FAILED",
+           "expand_ladder", "install_retry", "probe_device"]
 
 # In-process watchdog abandonment LEAKS a blocked daemon thread (a
 # wedged XLA runtime cannot be interrupted from Python).  Past this many
@@ -198,6 +199,66 @@ def classify_oom(exc: Optional[BaseException]) -> bool:
         return True
     msg = str(exc).lower()
     return any(m in msg for m in _OOM_MARKERS)
+
+
+# Exit code a warden/service child uses after REPORTING a classified
+# failure over its pipe — a clean "failed", as opposed to an abrupt
+# crash/kill.  Lives here (not tpu/warden.py) because the taxonomy
+# below is the SHARED vocabulary: the warden's rung failover, the
+# elastic ladder's in-process classify_oom, and the service scheduler's
+# retry policy (dslabs_tpu/service/scheduler.py) all agree through it
+# on what an "oom" is.
+CHILD_RC_FAILED = 3
+
+# Stderr-tail markers for the child-death taxonomy: everything
+# classify_oom recognises in an exception MESSAGE, plus the exception
+# NAMES a dying child's traceback tail shows instead (classify_oom
+# gets the live object and uses isinstance; a reaped child leaves only
+# text).
+_OOM_STDERR_MARKERS = _OOM_MARKERS + ("memoryerror",)
+
+
+def classify_child_death(exitcode: Optional[int],
+                         killed_by_warden: bool,
+                         stderr_markers=()) -> str:
+    """The ONE child-death taxonomy (ISSUE 11 satellite: the warden's
+    exit-code classifier and :func:`classify_oom` used to disagree —
+    an abrupt exit whose stderr carried a MemoryError traceback was a
+    "crash" to the warden but OOM-shaped to the elastic ladder, so the
+    scheduler's retry policy and the knob-shrink re-level pulled in
+    different directions).  Pinned by the table-driven test in
+    tests/test_service.py:
+
+    * ``wedge``  — the supervising parent SIGKILLed the child after
+      heartbeat silence (a hung dispatch / wedged runtime);
+    * ``oom``    — an UNPROMPTED SIGKILL (the kernel OOM killer or an
+      external ``kill -9``), OR any other abrupt death whose
+      ``stderr_markers`` text carries one of the :func:`classify_oom`
+      markers (a MemoryError traceback, RESOURCE_EXHAUSTED, an
+      oom-kill notice) — either way the memory/host is suspect and the
+      right answer is a knob-shrink re-level, not a plain retry;
+    * ``failed`` — the child exited :data:`CHILD_RC_FAILED` after
+      reporting a classified in-child failure over its pipe;
+    * ``crash``  — anything else: another signal (SIGSEGV, SIGBUS, …)
+      or an abrupt nonzero exit with no report and no OOM marker.
+
+    ``stderr_markers`` is any iterable of text (a stderr tail, a
+    heartbeat detail string); it refines only the abrupt-death kinds —
+    a warden kill stays a wedge and a clean report stays failed even
+    when earlier stderr chatter mentioned memory."""
+    if killed_by_warden:
+        return "wedge"
+    if exitcode == CHILD_RC_FAILED:
+        return "failed"
+    if exitcode is not None and exitcode < 0:
+        if -exitcode == signal.SIGKILL:
+            return "oom"
+    elif exitcode == 0:
+        return "crash"     # rc 0 with no result: still an abrupt death
+    text = " ".join(str(s) for s in stderr_markers).lower()
+    if text and any(m in text for m in _OOM_STDERR_MARKERS):
+        return "oom"
+    return "crash"
 
 
 def expand_ladder(ladder, full_width: Optional[int] = None,

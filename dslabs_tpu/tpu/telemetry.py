@@ -1180,7 +1180,7 @@ def read_ledger(path: str) -> List[dict]:
 # The bench phases a ledger compare diffs ("headline" is the last-line
 # JSON's top-level value — the number the BENCH_r0N trajectory tracks).
 _LEDGER_PHASES = ("headline", "strict", "beam", "swarm", "spill",
-                  "cpu_fallback")
+                  "service", "cpu_fallback")
 
 # Resilience counters the ledger tracks beside the rates (ISSUE 9):
 # a bench run that suddenly needs mesh shrinks / knob re-levels /
@@ -1296,6 +1296,33 @@ def compare_ledger(records: List[dict],
         cmp["sanitizer"][counter] = entry
         if lv > best:
             cmp["regressions"].append(entry)
+    # Fairness regressions (ISSUE 11): the service phase's fairness
+    # index (max/mean verdicts-per-tenant-budget; 1.0 = perfectly
+    # fair) vs the BEST (lowest) prior — a rise past the threshold
+    # means one tenant converted shared budget into verdicts at a
+    # neighbor's expense, a regression even at equal aggregate rate.
+    cmp["fairness"] = {}
+
+    def _fair(rec):
+        s = rec.get("service")
+        if not isinstance(s, dict):
+            return None
+        try:
+            v = float(s.get("fairness_index"))
+        except (TypeError, ValueError):
+            return None
+        return v if v > 0 else None
+
+    lv = _fair(latest)
+    priors_f = [v for v in (_fair(r) for r in prior) if v is not None]
+    if lv is not None and priors_f:
+        best = min(priors_f)
+        entry = {"phase": "service:fairness_index",
+                 "latest": round(lv, 4), "best_prior": round(best, 4),
+                 "delta_pct": round((lv - best) / best * 100, 1)}
+        cmp["fairness"]["fairness_index"] = entry
+        if lv > best * (1.0 + threshold):
+            cmp["regressions"].append(entry)
     return cmp
 
 
@@ -1320,6 +1347,10 @@ def render_compare(cmp: dict, source: str = "") -> str:
     for c, e in sorted(cmp.get("sanitizer", {}).items()):
         out.append(f"sanitizer {c:15s} latest={e['latest']} "
                    f"prior_best={e['best_prior']}")
+    for c, e in sorted(cmp.get("fairness", {}).items()):
+        out.append(f"fairness {c:16s} latest={e['latest']} "
+                   f"prior_best={e['best_prior']} "
+                   f"({e['delta_pct']:+.1f}%)")
     for e in cmp["regressions"]:
         out.append(f"REGRESSION: phase={e['phase']} "
                    f"latest={e['latest']} vs best={e['best_prior']} "

@@ -72,8 +72,9 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from dslabs_tpu.tpu import checkpoint as ckpt_mod
-from dslabs_tpu.tpu.supervisor import (EngineFailure, RetryPolicy,
-                                       SupervisorExhausted)
+from dslabs_tpu.tpu.supervisor import (CHILD_RC_FAILED, EngineFailure,
+                                       RetryPolicy, SupervisorExhausted,
+                                       classify_child_death)
 
 __all__ = ["Warden", "LineWatch", "classify_death", "outcome_to_dict",
            "outcome_from_dict", "CHILD_RC_FAILED"]
@@ -84,33 +85,28 @@ __all__ = ["Warden", "LineWatch", "classify_death", "outcome_to_dict",
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-# Exit code a child uses after REPORTING a classified failure over the
-# pipe (SupervisorExhausted, fatal errors, …) — a clean "failed", as
-# opposed to an abrupt crash/kill.
-CHILD_RC_FAILED = 3
-
 
 def classify_death(exitcode: Optional[int],
-                   killed_by_warden: bool) -> str:
-    """The exit-code taxonomy (pinned by tests/test_warden.py):
+                   killed_by_warden: bool,
+                   stderr_markers=()) -> str:
+    """The exit-code taxonomy (pinned by tests/test_warden.py and the
+    table-driven test in tests/test_service.py) — a thin alias of the
+    SHARED :func:`~dslabs_tpu.tpu.supervisor.classify_child_death`, so
+    the warden's failover, the elastic ladder's ``classify_oom``, and
+    the service scheduler's retry policy agree on one vocabulary:
 
     * ``wedge``  — the warden SIGKILLed the child after heartbeat
       silence (a hung dispatch / wedged runtime);
-    * ``oom``    — the child died to an UNPROMPTED SIGKILL: on Linux
-      that is the kernel OOM killer or an external ``kill -9`` — either
-      way the rung's memory/host is suspect, fail over;
+    * ``oom``    — an UNPROMPTED SIGKILL (kernel OOM killer / external
+      ``kill -9``), or an abrupt death whose stderr tail carries an
+      OOM marker (MemoryError traceback, RESOURCE_EXHAUSTED, …);
     * ``failed`` — the child exited :data:`CHILD_RC_FAILED` after
       reporting a classified in-child failure over the pipe;
     * ``crash``  — anything else: another signal (SIGSEGV, SIGBUS, …)
       or an abrupt nonzero exit with no report.
     """
-    if killed_by_warden:
-        return "wedge"
-    if exitcode is not None and exitcode < 0:
-        return "oom" if -exitcode == signal.SIGKILL else "crash"
-    if exitcode == CHILD_RC_FAILED:
-        return "failed"
-    return "crash"
+    return classify_child_death(exitcode, killed_by_warden,
+                                stderr_markers)
 
 
 # ---------------------------------------------------------- serialization
@@ -384,7 +380,19 @@ class Warden:
         proc = subprocess.Popen(
             [sys.executable, "-m", "dslabs_tpu.tpu.warden"],
             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-            stderr=None, text=True, env=self._child_env(spec))
+            stderr=subprocess.PIPE, text=True,
+            env=self._child_env(spec))
+
+        def _tee(line):
+            # stderr passes straight through (live heartbeats in the
+            # driver tail) while LineWatch keeps the last lines — the
+            # tail feeds the UNIFIED death taxonomy so an abrupt exit
+            # with a MemoryError traceback classifies "oom", not
+            # "crash" (supervisor.classify_child_death).
+            sys.stderr.write(line)
+            sys.stderr.flush()
+
+        err_watch = LineWatch(proc, proc.stderr, on_line=_tee)
         try:
             proc.stdin.write(json.dumps(spec))
             proc.stdin.close()
@@ -453,17 +461,19 @@ class Warden:
                     proc.kill()
                     rc = proc.wait()
                 return {"t": "death",
-                        "kind": classify_death(rc, False),
+                        "kind": classify_death(rc, False,
+                                               err_watch.tail),
                         "exitcode": rc, "last_hb": last_hb,
                         "detail": msg.get("error", "child failure")}
             if t == "eof":
                 rc = proc.wait()
-                kind = classify_death(rc, False)
+                kind = classify_death(rc, False, err_watch.tail)
                 return {"t": "death", "kind": kind, "exitcode": rc,
                         "last_hb": last_hb,
                         "detail": (f"child exited rc={rc} without a "
                                    f"result (classified {kind}; last "
-                                   f"heartbeat: {last_hb})")}
+                                   f"heartbeat: {last_hb}; stderr "
+                                   f"tail: {err_watch.tail[-2:]})")}
 
     # ----------------------------------------------------------------- run
 
