@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast lint analysis-smoke perf-smoke fault-smoke swarm-smoke capacity-smoke obs-smoke chaos-smoke service-smoke lab0 lab1 lab2 lab3 lab4 bench dryrun handout clean
+.PHONY: test test-fast lint analysis-smoke perf-smoke fault-smoke swarm-smoke capacity-smoke obs-smoke chaos-smoke service-smoke mesh-smoke lab0 lab1 lab2 lab3 lab4 bench dryrun handout clean
 
 test:            ## full acceptance + parity suite
 	$(PY) -m pytest tests/ -q
@@ -122,6 +122,22 @@ chaos-smoke:     ## elastic-mesh resilience suite (degraded ladder / knob shrink
 # docs/service.md is the field guide.
 service-smoke:   ## multi-tenant checking service suite (queue / admission / fairness / isolation soak) on CPU
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m service -p no:cacheprovider
+
+# mesh-smoke = the owner-sharded multi-chip superstep suite
+# (tests/test_mesh_exchange.py, ISSUE 12): the width-parity matrix —
+# exact unique/explored/verdict parity between the fused in-superstep
+# row exchange and the legacy promote-boundary driver at n_devices in
+# {1, 2, 4, 8} on pingpong + lab1 — the <= 2 dispatches/level budget
+# pin with a zero-collective promote lowering, Pallas-vs-jnp
+# visited-table bit-exact parity (incl. the table-full overflow
+# contract) standalone AND through a full sharded search, the
+# cross-width checkpoint resume chain 8->4->2->1, first-class carry
+# placement (partition rules -> NamedSharding everywhere), and the
+# bench --mesh phase schema — all on the CPU virtual 8-device mesh, no
+# TPU hardware needed.  docs/perf.md "mesh dispatch model" is the
+# field guide.
+mesh-smoke:      ## owner-sharded superstep width-parity matrix + Pallas kernel suite on CPU
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m mesh -p no:cacheprovider
 
 dryrun:          ## multi-chip sharding dry run on a virtual CPU mesh
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -87,6 +87,7 @@ BEAM_CAP_SECS = 300.0
 SWARM_CAP_SECS = 150.0       # swarm-explorer phase (ISSUE 5)
 SPILL_CAP_SECS = 120.0       # capacity-ladder phase (ISSUE 6)
 SERVICE_CAP_SECS = 120.0     # multi-tenant service phase (ISSUE 11)
+MESH_CAP_SECS = 150.0        # 8-device mesh headline phase (ISSUE 12)
 # Parent backstop beyond the child's budget.  Generous on purpose: the
 # child's time checks are level-granular (a slow level can overrun
 # max_secs by ~30 s, sharded.py round-3 note), the strict child floors
@@ -460,6 +461,105 @@ def _run_strict(ev_budget, budget_secs: float) -> dict:
         "mesh_shrinks": outcome.mesh_shrinks,
         "knob_retries": outcome.knob_retries,
         "mesh_width": outcome.mesh_width,
+        "telemetry": tel.summary(),
+    }
+
+
+def _run_mesh(budget_secs: float) -> dict:
+    """The 8-device mesh headline phase (ISSUE 12): a strict BFS whose
+    frontier, visited table, and expansion run owner-sharded over a
+    width-``DSLABS_MESH_WIDTH`` (default 8) mesh with the fused
+    in-superstep row exchange — the configuration ROADMAP #1 promotes
+    to the headline.  On a box with >= width real accelerators the
+    full paxos bench protocol runs on them; otherwise the phase runs
+    on the CPU VIRTUAL mesh (tagged ``virtual_cpu_mesh``) with the
+    lab1 workload the cpu-fallback phase already benches — an honest,
+    always-reports mesh number instead of a skipped phase.
+
+    The JSON carries what the acceptance criteria read: ``mesh_width``,
+    aggregate ``skew`` (finite — derived from the per-level per-device
+    lanes, which ride on ``levels``), and the recovery counters
+    (``mesh_shrinks``/``knob_retries`` must be 0 for the number to be
+    trusted as a full-width rate)."""
+    import dataclasses
+
+    width = int(os.environ.get("DSLABS_MESH_WIDTH", "8") or "8")
+    _persistent_cache()
+    import jax
+
+    from dslabs_tpu.tpu.sharded import make_mesh
+    from dslabs_tpu.tpu.supervisor import RetryPolicy, SearchSupervisor
+
+    t_phase = time.time()
+    tel = _phase_telemetry("mesh")
+    mesh = make_mesh(width)
+    platform = mesh.devices.flat[0].platform
+    virtual = platform == "cpu"
+    if virtual:
+        from dslabs_tpu.tpu.protocols.clientserver import \
+            make_clientserver_protocol
+
+        proto = dataclasses.replace(
+            make_clientserver_protocol(n_clients=3, w=4, net_cap=32),
+            goals={})
+        config = f"lab1-clientserver c3-w4 strict mesh x{width}"
+        kw = dict(chunk=256, frontier_cap=1 << 13,
+                  visited_cap=1 << 17)
+        depth = int(os.environ.get("DSLABS_MESH_DEPTH", "12"))
+    else:
+        proto = _bench_protocol()
+        config = f"lab3-paxos strict mesh x{width}"
+        kw = dict(chunk=4096, frontier_cap=1 << 18,
+                  visited_cap=1 << 22, ev_budget=FALLBACK_EV_BUDGET)
+        depth = int(os.environ.get("DSLABS_MESH_DEPTH", "10"))
+    sup = SearchSupervisor(
+        proto, ladder=("sharded",), mesh=mesh, max_depth=2,
+        strict=True, policy=RetryPolicy(max_retries=3),
+        aot_warmup=True, telemetry=tel, **kw)
+    t_c = time.time()
+    sup.run()   # warm-up: AOT + residual compiles, outside the window
+    compile_secs = time.time() - t_c
+    sup.max_depth = depth
+    # 90 s of measured search is plenty for a stable rate; the floor
+    # keeps a compile-heavy cold run landing a partial number.
+    sup.max_secs = max(20.0, min(
+        budget_secs - (time.time() - t_phase), 90.0))
+    t0 = time.time()
+    outcome = sup.run()
+    elapsed = max(time.time() - t0, 1e-9)
+    levels = outcome.levels or []
+    imb = [lv["skew"]["explored"]["imbalance"] for lv in levels
+           if lv.get("skew")]
+    cv = [lv["skew"]["explored"]["cv"] for lv in levels
+          if lv.get("skew")]
+    skew = {
+        "imbalance_max": round(max(imb), 4) if imb else 1.0,
+        "imbalance_mean": round(sum(imb) / len(imb), 4) if imb else 1.0,
+        "cv_max": round(max(cv), 4) if cv else 0.0,
+        "levels_measured": len(imb),
+    }
+    return {
+        "value": outcome.unique_states / elapsed * 60.0,
+        "unique": outcome.unique_states,
+        "explored": outcome.states_explored,
+        "depth": outcome.depth,
+        "end": outcome.end_condition,
+        "dropped": outcome.dropped,
+        "dropped_states": outcome.dropped_states,
+        "elapsed": round(elapsed, 2),
+        "compile_secs": round(compile_secs, 1),
+        "aot_compile_secs": outcome.compile_secs,
+        "config": config,
+        "platform": platform,
+        "mesh_width": width,
+        "virtual_cpu_mesh": virtual,
+        "skew": skew,
+        "levels": levels,
+        "retries": outcome.retries,
+        "failovers": outcome.failovers,
+        "resumed_from_depth": outcome.resumed_from_depth,
+        "mesh_shrinks": outcome.mesh_shrinks,
+        "knob_retries": outcome.knob_retries,
         "telemetry": tel.summary(),
     }
 
@@ -896,9 +996,9 @@ def _install_signal_emitters(result: dict) -> None:
 
 
 def _set_headline(result: dict, phase: dict, kind: str, platform: str,
-                  n_dev) -> None:
+                  n_dev, workload: str = "lab3-paxos") -> None:
     """Install a phase's rate as the bench's single headline number."""
-    result["metric"] = (f"lab3-paxos {kind} unique states/min "
+    result["metric"] = (f"{workload} {kind} unique states/min "
                         f"(sharded tensor backend, {platform} x{n_dev})")
     result["value"] = round(phase["value"], 1)
     result["vs_baseline"] = round(
@@ -916,6 +1016,47 @@ def _set_headline(result: dict, phase: dict, kind: str, platform: str,
     for k in ("retries", "failovers", "resumed_from_depth",
               "abandoned_threads", "mesh_shrinks", "knob_retries"):
         result[k] = phase.get(k, 0)
+    # Mesh-scope headline context (ISSUE 12): the width the number was
+    # measured at (telemetry compare flags a silent narrow-mesh
+    # fallback as a regression even at equal states/min), the
+    # aggregate shard skew, and the virtual-mesh tag when the phase
+    # ran on forced host-platform devices.
+    for k in ("mesh_width", "skew", "virtual_cpu_mesh"):
+        if phase.get(k) is not None:
+            result[k] = phase[k]
+
+
+def _mesh_phase(result: dict, force_cpu: bool,
+                headline_ok=lambda phase: True) -> bool:
+    """Run the 8-device mesh phase child (ISSUE 12) and install it;
+    promotes the phase to the HEADLINE when its recovery timeline is
+    clean (``mesh_shrinks == 0 && knob_retries == 0`` — a degraded run
+    is recorded but never trusted as the full-width rate) and
+    ``headline_ok`` agrees.  Returns True iff the headline was set."""
+    if _remaining() < 60:
+        result["mesh_error"] = "skipped: deadline nearly exhausted"
+        return False
+    budget = min(MESH_CAP_SECS, max(_remaining() - 40, 45))
+    args = ["--mesh"] + (["cpu"] if force_cpu else []) + [str(budget)]
+    mesh_res, mesh_err = _sub(args, budget, "mesh", kill_slack=30.0,
+                              silence=PHASE_SILENCE_SECS)
+    if mesh_res is None:
+        result["mesh_error"] = mesh_err
+        return False
+    result["mesh"] = mesh_res
+    _note_phase_telemetry(result, "mesh", mesh_res)
+    clean = (mesh_res.get("mesh_shrinks", 0) == 0
+             and mesh_res.get("knob_retries", 0) == 0
+             and mesh_res.get("value", 0) > 0)
+    if not (clean and headline_ok(mesh_res)):
+        return False
+    workload = ("lab1-clientserver c3-w4"
+                if mesh_res.get("virtual_cpu_mesh") else "lab3-paxos")
+    _set_headline(result, mesh_res,
+                  f"strict BFS (mesh x{mesh_res['mesh_width']})",
+                  mesh_res["platform"], mesh_res["mesh_width"],
+                  workload=workload)
+    return True
 
 
 def main() -> None:
@@ -962,6 +1103,12 @@ def main() -> None:
                 fb["value"] / BASELINE_STATES_PER_MIN, 6)
         else:
             result["error"] += f"; cpu-fallback failed: {fb_err}"
+        # The 8-device mesh headline on the CPU VIRTUAL mesh (ISSUE
+        # 12): a wedged TPU must not cost the round its mesh number —
+        # the phase runs CPU-pinned, is tagged virtual_cpu_mesh, and
+        # upgrades the headline over the single-chip fallback rate
+        # when its recovery timeline is clean.
+        _mesh_phase(result, force_cpu=True)
         result["total_secs"] = round(time.time() - _T0, 1)
         _emit(result)
         return
@@ -973,17 +1120,21 @@ def main() -> None:
     _note_phase_telemetry(result, "preflight", pf)
 
     if on_cpu:
-        # CI / smoke shape: one small beam rung, no calibration.
+        # CI / smoke shape: the 8-device virtual-mesh phase is the
+        # headline (ISSUE 12), one small beam rung rides along.
+        mesh_headline = _mesh_phase(result, force_cpu=True)
         beam, beam_err = _sub(
             ["--rung", "64", str(1 << 12), str(1 << 18), "30.0",
              str(FALLBACK_EV_BUDGET[0]), str(FALLBACK_EV_BUDGET[1])],
             min(BEAM_CAP_SECS, max(_remaining() - 15, 45)), "beam-cpu",
             silence=PHASE_SILENCE_SECS)
         if beam:
-            _set_headline(result, beam, "BFS (beam)", platform, n_dev)
+            if not mesh_headline:
+                _set_headline(result, beam, "BFS (beam)", platform,
+                              n_dev)
             result["beam"] = beam
             _note_phase_telemetry(result, "beam", beam)
-        else:
+        elif not mesh_headline:
             result["error"] = beam_err
         if _remaining() > 75:
             swarm, swarm_err = _sub(
@@ -1077,6 +1228,15 @@ def main() -> None:
     elif strict is None:
         result["error"] = "; ".join(
             str(e) for e in (strict_err, beam_err) if e)
+
+    # ---- phase 3.5: the 8-device mesh phase (ISSUE 12).  With >= 8
+    # real accelerators it IS the headline (the paper's target
+    # configuration); on a narrower box it runs the CPU virtual mesh —
+    # recorded with per-device lanes + skew and compared by the
+    # ledger's mesh_width guard, but never allowed to displace a real
+    # accelerator headline with a virtual-mesh rate.
+    _mesh_phase(result, force_cpu=False,
+                headline_ok=lambda p: not p.get("virtual_cpu_mesh"))
 
     # ---- phase 4: the swarm explorer's deep-probe rates (walkers/sec,
     # unique-states/min, deepest depth) — the portfolio's other half.
@@ -1177,6 +1337,24 @@ if __name__ == "__main__":
         budget = (float(sys.argv[2]) if len(sys.argv) > 2
                   else SERVICE_CAP_SECS)
         print(json.dumps(_run_service(budget)))
+        sys.exit(0)
+    if len(sys.argv) >= 2 and sys.argv[1] == "--mesh":
+        # The 8-wide mesh needs 8 devices SOMEWHERE: force the host
+        # platform's virtual device count before jax loads so
+        # make_mesh(8) can fall back to the CPU virtual mesh on narrow
+        # boxes.  A leading "cpu" arg pins the whole child to the CPU
+        # backend (the wedged-TPU branch must never touch the runtime).
+        _args = sys.argv[2:]
+        if _args and _args[0] == "cpu":
+            os.environ["DSLABS_FORCE_CPU"] = "1"
+            _args = _args[1:]
+        _xf = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in _xf:
+            os.environ["XLA_FLAGS"] = (
+                _xf + " --xla_force_host_platform_device_count="
+                + os.environ.get("DSLABS_MESH_WIDTH", "8")).strip()
+        print(json.dumps(_run_mesh(
+            float(_args[0]) if _args else MESH_CAP_SECS)))
         sys.exit(0)
     if len(sys.argv) >= 2 and sys.argv[1] == "--calibrate":
         print(json.dumps(_calibrate()))

@@ -917,6 +917,12 @@ class TensorSearch:
             sites["device.spill_evict"] = dict(
                 fn=progs["evict"], args=(carry_sds,), donate=(0,),
                 multi=False, builder=None)
+        # The bucket-probe kernel (ISSUE 12): the ACTIVE
+        # visited.insert variant (Pallas/jnp per DSLABS_VISITED_PALLAS)
+        # standalone over one wave's successor batch, so the auditor
+        # and profiler cover the kernel itself.
+        sites["visited.insert"] = visited_mod.dispatch_site_program(
+            self.visited_cap, C * self._num_events())
         return sites
 
     def _dispatch(self, tag: str, fn, *args):

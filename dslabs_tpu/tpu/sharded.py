@@ -10,12 +10,18 @@ exchanged by **key ownership** (device = key_hi mod D) with
 ``lax.all_to_all`` over ICI.  Each owner deduplicates the keys it owns
 against its **open-addressing hash table in HBM** — 8-slot buckets read
 as one aligned 128-byte line, membership and insert in one bounded probe
-loop, claim conflicts serialised by a per-bucket min-index reservation —
-and returns a fresh flag to each key's producer via a reverse
-all_to_all; producers append their own fresh rows locally.  Between
-levels the frontier is REBALANCED across the mesh (contiguous shares +
-one all_to_all + one compaction — the only wide row movement, at level
-granularity).  This is the classic hash-partitioned distributed BFS,
+loop (the Pallas bucket kernel / jnp oracle in tpu/visited.py), claim
+conflicts serialised by a per-bucket min-index reservation.  Under the
+default **fused row exchange** (ISSUE 12, ``DSLABS_SHARDED_EXCHANGE``)
+the successor rows ride the same owner buckets as their keys, so fresh
+states land on their OWNER's frontier shard as they are produced and
+the between-level promote is a local buffer swap — no reverse
+fresh-flag exchange, no boundary rebalance, no wide compaction.  The
+round-5 promote-boundary exchange (fresh flags returned to the
+producer via a reverse all_to_all, frontier REBALANCED between levels
+with contiguous shares + one all_to_all + one compaction) survives in
+the legacy per-chunk driver as the width-parity oracle.  This is the
+classic hash-partitioned distributed BFS,
 mapped onto XLA collectives instead of the reference's shared-memory
 ConcurrentHashMap (Search.java:405-505); with a 1-device mesh the
 collectives are identities, which is how the TPU bench runs.
@@ -44,6 +50,7 @@ from __future__ import annotations
 
 import math
 import os
+import re
 import time
 from typing import List, Optional
 
@@ -63,7 +70,8 @@ from dslabs_tpu.tpu.spill import (dropped_warn_threshold as
                                   visited_warn_threshold as
                                   _VISITED_WARN)
 
-__all__ = ["ShardedTensorSearch", "make_mesh"]
+__all__ = ["ShardedTensorSearch", "make_mesh",
+           "CARRY_PARTITION_RULES", "match_partition_rules"]
 
 
 def _env_on(name: str, default: bool = True) -> bool:
@@ -80,6 +88,52 @@ MAXU32 = visited_mod.MAXU32
 BKT = visited_mod.BKT
 # Dev: print per-level wall time / chunk rate from run().
 _LEVEL_TIMING = bool(os.environ.get("DSLABS_LEVEL_TIMING"))
+
+
+# ------------------------------------------------------- carry placement
+#
+# First-class NamedSharding/PartitionSpec placement of the search carry
+# (ISSUE 12, following the SNIPPETS [1] regex-partition-rule pattern):
+# ONE rule table maps carry leaf names to PartitionSpecs over the named
+# mesh axis, and every placement consumer — the shard_map in/out specs,
+# the hot programs' jit in/out shardings, the carry initialiser's
+# out_shardings, the AOT ShapeDtypeStructs, and the resume/spill
+# device_puts — derives from it.  Width-free by construction: the
+# elastic ladder (tpu/supervisor.py) re-derives the identical layout on
+# any narrower mesh, and XLA sees one consistent placement end to end
+# instead of inferring (and defensively resharding) between dispatches.
+
+CARRY_PARTITION_RULES = (
+    # Wide SoA buffers: frontier shards, next-frontier accumulator,
+    # per-row trace meta — row-sharded over the search axis.
+    (r"^(cur|nxt|tmeta)$", lambda ax: P(ax)),
+    # The owner-sharded visited hash table (one [V+1, 4] shard per
+    # device; owner = key lane 0 mod D picks the shard).
+    (r"^visited$", lambda ax: P(ax)),
+    # Terminal-flag rows/meta/counters: one n_flags block per device.
+    (r"^(flag_rows|flag_meta|flag_cnt)$", lambda ax: P(ax)),
+    # Per-device scalar lanes: occupancies, loop counters, stats.
+    (r"^(cur_n|nxt_n|vis_n|j|evp|noapp|explored|overflow|vis_over"
+     r"|drops|f_full)$", lambda ax: P(ax)),
+)
+
+
+def match_partition_rules(rules, names, axis):
+    """SNIPPETS [1]'s regex-rules -> PartitionSpec mapping, applied to
+    carry leaf NAMES: the first matching rule wins; an unmatched leaf
+    is a loud error (a new carry entry must declare its placement, not
+    inherit one by accident)."""
+    out = {}
+    for name in names:
+        for pat, spec in rules:
+            if re.search(pat, name):
+                out[name] = spec(axis) if callable(spec) else spec
+                break
+        else:
+            raise ValueError(
+                f"no partition rule for carry leaf {name!r} — add it "
+                "to CARRY_PARTITION_RULES")
+    return out
 
 
 def make_mesh(n_devices: int = None, axis: str = "search") -> Mesh:
@@ -131,6 +185,7 @@ class ShardedTensorSearch(TensorSearch):
                  checkpoint_every: int = 0,
                  superstep: Optional[bool] = None,
                  superstep_chunks: Optional[int] = None,
+                 row_exchange: Optional[bool] = None,
                  aot_warmup: Optional[bool] = None,
                  spill=None,
                  telemetry=None):
@@ -211,11 +266,6 @@ class ShardedTensorSearch(TensorSearch):
         # level rebalance needs no permutation bookkeeping) and replays
         # the grid event ids on the object twin via tpu/trace.py.
         self._fp_map = {}                  # child fp bytes -> (parent, ev)
-        # _flag_names is set by super().__init__ (shared with the
-        # single-device device-resident loop).
-        self._chunk_step = jax.jit(self._build_chunk_step(),
-                                   donate_argnums=0)
-        self._finish_level = jax.jit(self._build_finish(), donate_argnums=0)
         # On-device level superstep (default; DSLABS_SHARDED_SUPERSTEP=0
         # keeps the legacy host-driven per-chunk driver as the parity
         # oracle).  The superstep fuses each level's whole chunk loop —
@@ -230,7 +280,30 @@ class ShardedTensorSearch(TensorSearch):
             # condition; the legacy per-chunk parity driver stays the
             # oracle for UNCAPPED runs only.
             self.use_superstep = True
-        self._superstep = jax.jit(self._build_superstep(), donate_argnums=0)
+        # In-superstep owner-routed row exchange (ISSUE 12): the fused
+        # chunk body routes the successor ROWS through the same
+        # owner-hashed all_to_all as their keys, so fresh states land
+        # on their owner's frontier shard as they are produced — the
+        # promote-boundary rebalance (one wide all_to_all + compaction
+        # per level) and the reverse fresh-flag exchange both
+        # disappear, and the level promote shrinks to a local buffer
+        # swap.  Default ON under the superstep driver;
+        # DSLABS_SHARDED_EXCHANGE=0 (or the legacy per-chunk driver,
+        # which IS the promote-boundary oracle) keeps the round-5
+        # exchange for the width-parity matrix.
+        self.row_exchange = (_env_on("DSLABS_SHARDED_EXCHANGE", True)
+                             if row_exchange is None
+                             else bool(row_exchange))
+        if not self.use_superstep:
+            self.row_exchange = False
+        # _flag_names is set by super().__init__ (shared with the
+        # single-device device-resident loop).  Hot programs are jitted
+        # with the rule-derived carry shardings pinned on BOTH sides
+        # (in_shardings/out_shardings): placement is an explicit
+        # contract, not an inference XLA re-derives per dispatch.
+        self._chunk_step = self._chunk_jit()
+        self._finish_level = self._sharded_jit(self._build_finish())
+        self._superstep = self._superstep_jit()
         # Chunk-step budget per superstep dispatch when a wall-clock
         # budget is active: bounds device work between host clock checks
         # so mid-level TIME_EXHAUSTED keeps its round-3 granularity (the
@@ -293,9 +366,56 @@ class ShardedTensorSearch(TensorSearch):
         # superstep/promote/init programs when DSLABS_SANITIZE is on.
         self._maybe_sanitize()
 
+    # ------------------------------------------------- placement helpers
+
+    def _carry_names(self) -> list:
+        keys = ["cur", "cur_n", "j", "evp", "noapp", "nxt", "nxt_n",
+                "visited", "vis_n", "explored", "overflow", "vis_over",
+                "drops", "flag_cnt", "flag_rows"]
+        if self.record_trace:
+            keys += ["tmeta", "flag_meta"]
+        if self._spill_on:
+            keys += ["f_full"]
+        return keys
+
+    def _carry_shardings(self) -> dict:
+        """Rule-derived NamedSharding per carry leaf — the ONE
+        placement authority (CARRY_PARTITION_RULES) every consumer
+        shares; rebuilt per mesh so the elastic ladder's narrower
+        rungs get the identical layout."""
+        return {k: NamedSharding(self.mesh, s)
+                for k, s in self._carry_specs().items()}
+
+    def _sharded_jit(self, fn, extra_in=(), extra_out=None):
+        """jit a carry-first program with the rule-derived placement
+        pinned on both sides and the carry donated.  ``extra_in`` /
+        ``extra_out`` list the shardings of any non-carry operands
+        (replicated scalars/masks) after the carry."""
+        cs = self._carry_shardings()
+        ins = (cs,) + tuple(extra_in)
+        outs = cs if extra_out is None else (cs,) + tuple(extra_out)
+        return jax.jit(fn, donate_argnums=0, in_shardings=ins,
+                       out_shardings=outs)
+
+    def _replicated(self):
+        return NamedSharding(self.mesh, P())
+
+    def _superstep_jit(self):
+        rep = self._replicated()
+        extra = ((rep, (rep, rep)) if self._has_rt_masks()
+                 else (rep,))
+        return self._sharded_jit(self._build_superstep(),
+                                 extra_in=extra, extra_out=(rep,))
+
+    def _chunk_jit(self):
+        rep = self._replicated()
+        extra = (((rep, rep),) if self._has_rt_masks() else ())
+        return self._sharded_jit(self._build_chunk_step(),
+                                 extra_in=extra)
+
     # --------------------------------------------------------- level chunk
 
-    def _make_local_step(self):
+    def _make_local_step(self, route_rows: bool = False):
         """The per-device chunk-step body (runs INSIDE shard_map): one
         chunk expand + key routing + owner dedup + frontier append.
         Shared by the legacy per-chunk program (_build_chunk_step, one
@@ -437,6 +557,24 @@ class ShardedTensorSearch(TensorSearch):
             counts = ends - starts
             route_drop = jnp.sum(jnp.maximum(counts - bucket, 0)).astype(
                 jnp.int32)
+            if route_rows:
+                # Fused row exchange (ISSUE 12): the successor ROW,
+                # its pruned flag, and (in trace mode) its meta ride
+                # the SAME owner buckets as the keys — one extra
+                # all_to_all per chunk lands every fresh state on its
+                # OWNER's frontier shard as it is produced.  The
+                # reverse fresh-flag exchange and the promote-boundary
+                # rebalance (the per-level wide row movement + its
+                # compaction scatter) both disappear; the level
+                # promote shrinks to a local buffer swap
+                # (_build_finish).
+                parts = [rows, pruned[:, None].astype(jnp.int32)]
+                if self.record_trace:
+                    parts.append(jax.lax.bitcast_convert_type(
+                        meta, jnp.int32))
+                payload = jnp.concatenate(parts, axis=1)
+                send_rows = payload[gidx.reshape(-1)].reshape(
+                    D, bucket, payload.shape[1])
             if stop_after == "route":
                 return _stopped(carry, rows, send_keys, send_valid)
 
@@ -448,6 +586,9 @@ class ShardedTensorSearch(TensorSearch):
             recv_keys = jnp.where(recv_valid.reshape(rb, 1),
                                   recv_keys.reshape(rb, 4), MAXU32)
             recv_valid = recv_valid.reshape(rb)
+            if route_rows:
+                recv_rows = jax.lax.all_to_all(
+                    send_rows, ax, 0, 0).reshape(rb, -1)
             if stop_after == "a2a":
                 return _stopped(carry, rows, recv_keys, recv_valid)
 
@@ -479,24 +620,49 @@ class ShardedTensorSearch(TensorSearch):
                 out["visited"] = new_visited
                 return out
 
-            # ---- return each key's fresh flag to its producer (reverse
-            # all_to_all — an involution on the leading axis; recv order
-            # was never permuted) and map it back onto the producer's
-            # local successor rows.  Narrow bool scatters only; `.max`
-            # (boolean or) so the clipped dump writes of invalid slots
-            # can never clobber a true flag.
-            fresh_back = jax.lax.all_to_all(
-                fresh_s.reshape(D, bucket), ax, 0, 0)
-            fresh_rows = jnp.zeros(owner.shape[0], bool).at[
-                gidx.reshape(-1)].max(
-                fresh_back.reshape(-1) & send_valid.reshape(-1))
-            if stop_after == "back":
-                out = _stopped(carry, rows, fresh_rows)
-                out["visited"] = new_visited
-                return out
+            if route_rows:
+                # Owner-side append: the received rows ARE this
+                # device's share of the next frontier (owner-hashed
+                # placement — the distribution the per-device skew
+                # lanes judge).  No flag needs to travel back to the
+                # producer, so the reverse all_to_all is gone.
+                app_rows = recv_rows[:, :lanes]
+                app_pruned = recv_rows[:, lanes] != 0
+                app_fresh = fresh_s            # implies recv_valid
+                if self.record_trace:
+                    app_meta = jax.lax.bitcast_convert_type(
+                        recv_rows[:, lanes + 1:], jnp.uint32)
+                if stop_after == "back":
+                    out = _stopped(carry, rows, app_fresh, app_pruned)
+                    out["visited"] = new_visited
+                    return out
+            else:
+                # ---- return each key's fresh flag to its producer
+                # (reverse all_to_all — an involution on the leading
+                # axis; recv order was never permuted) and map it back
+                # onto the producer's local successor rows.  Narrow
+                # bool scatters only; `.max` (boolean or) so the
+                # clipped dump writes of invalid slots can never
+                # clobber a true flag.
+                fresh_back = jax.lax.all_to_all(
+                    fresh_s.reshape(D, bucket), ax, 0, 0)
+                fresh_rows = jnp.zeros(owner.shape[0], bool).at[
+                    gidx.reshape(-1)].max(
+                    fresh_back.reshape(-1) & send_valid.reshape(-1))
+                if stop_after == "back":
+                    out = _stopped(carry, rows, fresh_rows)
+                    out["visited"] = new_visited
+                    return out
+                app_rows = rows
+                app_pruned = pruned
+                app_fresh = fresh_rows
+                if self.record_trace:
+                    app_meta = meta
 
-            # ---- append fresh, un-pruned successors (still in producer
-            # order, no row permutation) to the local next frontier.
+            # ---- append fresh, un-pruned successors (producer order
+            # under the legacy exchange, owner-received order under the
+            # fused row exchange — BFS level semantics are order-free)
+            # to the local next frontier.
             # noapp (set by run() for the FINAL depth-limited level):
             # fresh states still count into vis_n/flags — discovered,
             # checked, never expanded — but skip the frontier append, so
@@ -505,18 +671,18 @@ class ShardedTensorSearch(TensorSearch):
             # DEPTH_EXHAUSTED would; the reference's BFS likewise never
             # queues states at the cutoff depth).
             noapp = carry["noapp"][0] == 1
-            sel_would = fresh_rows & ~pruned
+            sel_would = app_fresh & ~app_pruned
             # Spill mode appends pruned-but-fresh rows too: every fresh
             # insert must reach the host refilter (the drain recomputes
             # the prune/exception mask before anything re-expands), or
             # a post-eviction re-discovery of a pruned state would
             # double-count.  noapp counting stays on sel_would — the
             # DEPTH-vs-SPACE decision is about expandable successors.
-            sel = (fresh_rows if spill_on else sel_would) & ~noapp
+            sel = (app_fresh if spill_on else sel_would) & ~noapp
             spos = jnp.cumsum(sel) - 1
             nxt, nxt_n = carry["nxt"], carry["nxt_n"][0]
             sdst = jnp.where(sel & (nxt_n + spos < F), nxt_n + spos, F)
-            nxt = nxt.at[sdst].set(rows)
+            nxt = nxt.at[sdst].set(app_rows)
             n_sel = jnp.sum(sel).astype(jnp.int32)
             frontier_drop = jnp.maximum(nxt_n + n_sel - F, 0)
             # Occupancy counts only rows that actually landed (<= F), else
@@ -558,7 +724,7 @@ class ShardedTensorSearch(TensorSearch):
             }
             if self.record_trace:
                 # Trace meta rides the SAME append scatter as the rows.
-                out["tmeta"] = carry["tmeta"].at[sdst].set(meta)
+                out["tmeta"] = carry["tmeta"].at[sdst].set(app_meta)
                 out["flag_meta"] = flag_meta
             if spill_on:
                 front_full = (nxt_n + jnp.sum(sel).astype(jnp.int32)
@@ -583,7 +749,10 @@ class ShardedTensorSearch(TensorSearch):
                 or self.p.deliver_timer_rt is not None)
 
     def _build_chunk_step(self):
-        local = self._make_local_step()
+        # The legacy per-chunk driver IS the promote-boundary exchange
+        # oracle: rows stay with their producer, the rebalance moves
+        # them between levels (route_rows never applies here).
+        local = self._make_local_step(route_rows=False)
         spec = self._carry_specs()
         if self._has_rt_masks():
             # Runtime delivery masks ride as a replicated ARGUMENT: every
@@ -621,7 +790,7 @@ class ShardedTensorSearch(TensorSearch):
         in-program (psum/pmax over the mesh axis) folds the level sync
         into the same dispatch: host involvement per level becomes
         superstep + promote."""
-        local = self._make_local_step()
+        local = self._make_local_step(route_rows=self.row_exchange)
         C = self.cpd
         ax = self.axis
 
@@ -749,7 +918,12 @@ class ShardedTensorSearch(TensorSearch):
         def local(carry):
             carry = dict(carry)
             nxt, nxt_n = carry["nxt"], carry["nxt_n"][0]
-            if D == 1:
+            if D == 1 or self.row_exchange:
+                # Fused row exchange (ISSUE 12): successors already
+                # landed on their owner's shard inside the superstep,
+                # so the promote is a LOCAL buffer swap — zero ICI
+                # traffic, zero wide compaction; on one device the
+                # round-5 rebalance was an identity anyway.
                 carry["cur"] = nxt[:F]
                 carry["cur_n"] = carry["nxt_n"]
             else:
@@ -784,15 +958,12 @@ class ShardedTensorSearch(TensorSearch):
                          check_rep=False)
 
     def _carry_specs(self):
-        ax = self.axis
-        keys = ["cur", "cur_n", "j", "evp", "noapp", "nxt", "nxt_n",
-                "visited", "vis_n", "explored", "overflow", "vis_over",
-                "drops", "flag_cnt", "flag_rows"]
-        if self.record_trace:
-            keys += ["tmeta", "flag_meta"]
-        if self._spill_on:
-            keys += ["f_full"]
-        return {k: P(ax) for k in keys}
+        """shard_map in/out specs for the carry — derived from the
+        partition-rule table (CARRY_PARTITION_RULES), not hand-listed,
+        so shard_map conventions and NamedSharding placement cannot
+        drift apart."""
+        return match_partition_rules(CARRY_PARTITION_RULES,
+                                     self._carry_names(), self.axis)
 
     # ----------------------------------------------------------------- run
 
@@ -833,7 +1004,6 @@ class ShardedTensorSearch(TensorSearch):
             return fn
         D, F, V, lanes = self.n_devices, self.f_cap, self.v_cap, self.lanes
         nf = len(self._flag_names)
-        shard = NamedSharding(self.mesh, P(self.axis))
 
         def build(row0, k0):
             onehot_d = jnp.arange(D) == owner
@@ -864,8 +1034,7 @@ class ShardedTensorSearch(TensorSearch):
                 out["f_full"] = jnp.zeros((D,), jnp.int32)
             return out
 
-        fn = jax.jit(build, out_shardings={
-            k: shard for k in self._carry_specs()})
+        fn = jax.jit(build, out_shardings=self._carry_shardings())
         cache[(owner, home)] = fn
         return fn
 
@@ -873,30 +1042,37 @@ class ShardedTensorSearch(TensorSearch):
 
     def _carry_sds(self):
         """Abstract (ShapeDtypeStruct + NamedSharding) carry pytree for
-        AOT lowering — mirrors the shapes _init_prog builds."""
+        AOT lowering — shapes mirror _init_prog's builds, shardings come
+        from the SAME partition-rule table every dispatch uses."""
         D, F, V, lanes = self.n_devices, self.f_cap, self.v_cap, self.lanes
         nf = len(self._flag_names)
-        shard = NamedSharding(self.mesh, P(self.axis))
+        shards = self._carry_shardings()
 
-        def sd(shape, dtype=jnp.int32):
-            return jax.ShapeDtypeStruct(shape, dtype, sharding=shard)
+        def sd(name, shape, dtype=jnp.int32):
+            return jax.ShapeDtypeStruct(shape, dtype,
+                                        sharding=shards[name])
 
         out = {
-            "cur": sd((D * F, lanes)), "cur_n": sd((D,)),
-            "j": sd((D,)), "evp": sd((D,)), "noapp": sd((D,)),
-            "nxt": sd((D * (F + 1), lanes)), "nxt_n": sd((D,)),
-            "visited": sd((D * (V + 1), 4), jnp.uint32),
-            "vis_n": sd((D,)), "explored": sd((D,)),
-            "overflow": sd((D,)), "vis_over": sd((D,)),
-            "drops": sd((D,)),
-            "flag_cnt": sd((D * nf,)),
-            "flag_rows": sd((D * nf, lanes)),
+            "cur": sd("cur", (D * F, lanes)),
+            "cur_n": sd("cur_n", (D,)),
+            "j": sd("j", (D,)), "evp": sd("evp", (D,)),
+            "noapp": sd("noapp", (D,)),
+            "nxt": sd("nxt", (D * (F + 1), lanes)),
+            "nxt_n": sd("nxt_n", (D,)),
+            "visited": sd("visited", (D * (V + 1), 4), jnp.uint32),
+            "vis_n": sd("vis_n", (D,)),
+            "explored": sd("explored", (D,)),
+            "overflow": sd("overflow", (D,)),
+            "vis_over": sd("vis_over", (D,)),
+            "drops": sd("drops", (D,)),
+            "flag_cnt": sd("flag_cnt", (D * nf,)),
+            "flag_rows": sd("flag_rows", (D * nf, lanes)),
         }
         if self.record_trace:
-            out["tmeta"] = sd((D * (F + 1), 9), jnp.uint32)
-            out["flag_meta"] = sd((D * nf, 9), jnp.uint32)
+            out["tmeta"] = sd("tmeta", (D * (F + 1), 9), jnp.uint32)
+            out["flag_meta"] = sd("flag_meta", (D * nf, 9), jnp.uint32)
         if self._spill_on:
-            out["f_full"] = sd((D,))
+            out["f_full"] = sd("f_full", (D,))
         return out
 
     def aot_warmup(self) -> float:
@@ -976,22 +1152,31 @@ class ShardedTensorSearch(TensorSearch):
             sites["sharded.superstep"] = dict(
                 fn=self._superstep, args=(sds, b, *mask_args),
                 donate=(0,), multi=True,
-                builder=lambda: jax.jit(self._build_superstep(),
-                                        donate_argnums=0))
+                builder=self._superstep_jit)
         else:
             sites["sharded.step"] = dict(
                 fn=self._chunk_step, args=(sds, *mask_args),
                 donate=(0,), multi=True,
-                builder=lambda: jax.jit(self._build_chunk_step(),
-                                        donate_argnums=0))
+                builder=self._chunk_jit)
             sites["sharded.sync"] = dict(
                 fn=self._stats, args=(sds,), donate=(), multi=False,
                 builder=None)
         sites["sharded.promote"] = dict(
             fn=self._finish_level, args=(sds,), donate=(0,),
             multi=True,
-            builder=lambda: jax.jit(self._build_finish(),
-                                    donate_argnums=0))
+            builder=lambda: self._sharded_jit(self._build_finish()))
+        # The bucket-probe kernel (ISSUE 12): the ACTIVE visited.insert
+        # variant (Pallas or jnp per DSLABS_VISITED_PALLAS) as a
+        # standalone single-device program over one owner-side dedup
+        # batch — the profiler's hot-site table and the J1/J2/J4 audit
+        # cover the kernel itself, not just the superstep it inlines
+        # into.
+        ne = self._num_events()
+        bucket = (self.cpd * ne if self.n_devices == 1
+                  else (self.cpd * ne // self.n_devices + 1)
+                  * OVERFLOW_FACTOR)
+        sites["visited.insert"] = visited_mod.dispatch_site_program(
+            self.v_cap, self.n_devices * bucket)
         rows0, key0, owner, home = self._root_ids(self.initial_state())
         sites["sharded.init"] = dict(
             fn=self._init_prog(owner, home),
@@ -1307,12 +1492,12 @@ class ShardedTensorSearch(TensorSearch):
             return out
 
         progs = self._sh_spill_prog_cache = {
-            "reset": jax.jit(shard_map(
+            "reset": self._sharded_jit(shard_map(
                 reset, mesh=self.mesh, in_specs=(spec,),
-                out_specs=spec, check_rep=False), donate_argnums=0),
-            "evict": jax.jit(shard_map(
+                out_specs=spec, check_rep=False)),
+            "evict": self._sharded_jit(shard_map(
                 evict, mesh=self.mesh, in_specs=(spec,),
-                out_specs=spec, check_rep=False), donate_argnums=0),
+                out_specs=spec, check_rep=False)),
             "inject": {},
         }
         return progs
@@ -1392,10 +1577,11 @@ class ShardedTensorSearch(TensorSearch):
                 out["f_full"] = jnp.zeros((1,), jnp.int32)
                 return out
 
-            fn = progs["inject"][m] = jax.jit(shard_map(
+            seg_shard = NamedSharding(self.mesh, P(ax))
+            fn = progs["inject"][m] = self._sharded_jit(shard_map(
                 inject, mesh=self.mesh,
                 in_specs=(spec, P(ax), P(ax)), out_specs=spec,
-                check_rep=False), donate_argnums=0)
+                check_rep=False), extra_in=(seg_shard, seg_shard))
         buf = np.zeros((D, m, lanes), np.int32)
         counts = np.zeros((D,), np.int32)
         for d in range(D):
@@ -1714,8 +1900,13 @@ class ShardedTensorSearch(TensorSearch):
         ceil-split can hand one device up to ``max_n + D - 1`` rows — but
         a 1-device mesh's rebalance is an identity, so the extra
         (mostly-invalid) chunk the slack would force is pure waste on
-        the TPU bench path and is skipped."""
-        return self.n_devices - 1 if self.n_devices > 1 else 0
+        the TPU bench path and is skipped.  The fused row exchange has
+        no rebalance at all (owner-side appends ARE the placement, and
+        the level sync's nxt_max is already the exact per-device
+        bound), so it needs no slack either."""
+        if self.n_devices == 1 or self.row_exchange:
+            return 0
+        return self.n_devices - 1
 
     def _level_superstep(self, carry, depth, t0, max_n):
         """One BFS level via the fused on-device superstep: each

@@ -704,7 +704,8 @@ class SearchSupervisor:
                  spill=False,
                  telemetry=None,
                  elastic: Optional[bool] = None,
-                 max_knob_shrinks: Optional[int] = None):
+                 max_knob_shrinks: Optional[int] = None,
+                 row_exchange: Optional[bool] = None):
         for rung in ladder:
             if rung not in ("sharded", "device", "host"):
                 raise ValueError(f"unknown ladder rung {rung!r}")
@@ -722,6 +723,12 @@ class SearchSupervisor:
         self.frontier_cap = frontier_cap
         self.visited_cap = visited_cap
         self.ev_budget = ev_budget
+        # Fused in-superstep row exchange (ISSUE 12): None defers to
+        # the engine's DSLABS_SHARDED_EXCHANGE default; every ladder
+        # rung — degraded widths and knob-shrunk re-levels included —
+        # is built with the SAME exchange so a failover never silently
+        # changes what the verdict's dispatch path was.
+        self.row_exchange = row_exchange
         # AOT warm-up of the sharded rung's programs at build time —
         # compile wall-time lands on SearchOutcome.compile_secs instead
         # of inside the first run's measured window (bench.py).
@@ -894,6 +901,7 @@ class SearchSupervisor:
                 visited_cap=self.visited_cap, max_depth=self.max_depth,
                 max_secs=self.max_secs, strict=self.strict,
                 ev_budget=self.ev_budget,
+                row_exchange=self.row_exchange,
                 aot_warmup=self.aot_warmup, **ck)
         return TensorSearch(
             self.protocol, frontier_cap=self.frontier_cap,

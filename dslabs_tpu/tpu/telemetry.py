@@ -156,6 +156,12 @@ DISPATCH_SITES = {
                                   program=False),
     "host.expand":           dict(hot=True, donated=False, multi=False,
                                   program=False),
+    # The visited-table bucket-probe kernel (ISSUE 12): Pallas on TPU
+    # (interpret mode off-TPU), jnp oracle otherwise — inlined into
+    # every expanding dispatch, and audited/profiled standalone
+    # through this site (visited.dispatch_site_program).
+    "visited.insert":        dict(hot=True, donated=True, multi=False,
+                                  program=True),
 }
 
 # Hot-loop sites whose steady-state dispatches are worth a profiler
@@ -1179,8 +1185,8 @@ def read_ledger(path: str) -> List[dict]:
 
 # The bench phases a ledger compare diffs ("headline" is the last-line
 # JSON's top-level value — the number the BENCH_r0N trajectory tracks).
-_LEDGER_PHASES = ("headline", "strict", "beam", "swarm", "spill",
-                  "service", "cpu_fallback")
+_LEDGER_PHASES = ("headline", "mesh", "strict", "beam", "swarm",
+                  "spill", "service", "cpu_fallback")
 
 # Resilience counters the ledger tracks beside the rates (ISSUE 9):
 # a bench run that suddenly needs mesh shrinks / knob re-levels /
@@ -1260,6 +1266,31 @@ def compare_ledger(records: List[dict],
             cmp["regressions"].append(entry)
         elif delta > threshold:
             cmp["improvements"].append(entry)
+    # Headline mesh-width regression (ISSUE 12): the headline number
+    # is only comparable at equal (or wider) mesh width — a run that
+    # silently fell back to a narrower mesh (elastic re-level, wedged
+    # devices, lost XLA_FLAGS) must NOT compare as a headline win even
+    # if its states/min happens to be higher.  Width rides the
+    # last-line JSON as top-level ``mesh_width`` (bench._set_headline).
+    cmp["mesh_width"] = {}
+
+    def _width(rec) -> Optional[int]:
+        try:
+            w = int(rec.get("mesh_width"))
+        except (TypeError, ValueError):
+            return None
+        return w if w > 0 else None
+
+    lw = _width(latest)
+    priors_w = [w for w in (_width(r) for r in prior) if w is not None]
+    if lw is not None and priors_w:
+        best_w = max(priors_w)
+        entry = {"phase": "headline:mesh_width", "latest": lw,
+                 "best_prior": best_w,
+                 "delta_pct": round((lw - best_w) / best_w * 100, 1)}
+        cmp["mesh_width"]["mesh_width"] = entry
+        if lw < best_w:
+            cmp["regressions"].append(entry)
     # Resilience regressions: the latest run needed MORE degradation
     # (mesh shrinks / knob re-levels / failovers) than any prior run —
     # flagged alongside the rate regressions (same rc).
@@ -1341,6 +1372,9 @@ def render_compare(cmp: dict, source: str = "") -> str:
             continue
         out.append(f"{phase:14s} {e['latest']:12.1f} "
                    f"{e['best_prior']:12.1f} {e['delta_pct']:+7.1f}%")
+    for c, e in sorted(cmp.get("mesh_width", {}).items()):
+        out.append(f"headline {c:16s} latest={e['latest']} "
+                   f"prior_widest={e['best_prior']}")
     for c, e in sorted(cmp.get("resilience", {}).items()):
         out.append(f"resilience {c:14s} latest={e['latest']} "
                    f"prior_worst={e['best_prior']}")

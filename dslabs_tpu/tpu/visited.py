@@ -26,11 +26,26 @@ silent drop) while surfacing the count as a visible overflow flag.
 Strict drivers raise :class:`~dslabs_tpu.tpu.engine.CapacityOverflow`
 on a nonzero count (exact unique counts would otherwise drift); beam
 drivers report it via ``SearchOutcome.visited_overflow``.
+
+Pallas kernel (ISSUE 12): the probe/insert — the hot instruction on
+every expanded state — also exists as a Pallas TPU kernel
+(:func:`pallas_insert`) whose body is the SAME traced algorithm as the
+jnp path (:func:`insert_jnp`), so the two are bit-identical by
+construction: same probe order, same reservation tie-breaks, same
+unresolved set.  :func:`insert` dispatches between them by the
+``DSLABS_VISITED_PALLAS`` knob (``auto`` compiles the kernel on TPU
+when the table fits the VMEM budget; ``interpret`` runs the Mosaic
+interpreter — the CPU/test path; ``0`` pins the jnp oracle).  The
+kernel is a canonical dispatch site (``visited.insert`` in
+``telemetry.DISPATCH_SITES``) so the profiler's hot-site selection and
+the jaxpr auditor cover it; :func:`dispatch_site_program` builds the
+audit entry.
 """
 
 from __future__ import annotations
 
-from typing import Tuple
+import os
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -38,7 +53,8 @@ import numpy as np
 
 __all__ = ["BKT", "MAXU32", "empty_table", "sanitize_keys",
            "host_sanitize_key", "host_home_slot", "host_occupied",
-           "insert", "build_table"]
+           "insert", "insert_jnp", "pallas_insert", "pallas_mode",
+           "dispatch_site_program", "build_table"]
 
 # Slots per bucket: the probe loop reads whole buckets (one aligned
 # 128-byte line of 8 x 16-byte keys).
@@ -135,10 +151,12 @@ def _probe_iter(table, keys, bkt_i, ps, unres, idx, V, RT, batch_n):
     return table, bkt_i, newly & unres, winner & unres
 
 
-def insert(table: jnp.ndarray, keys: jnp.ndarray, valid: jnp.ndarray,
-           max_iters: int = 64,
-           ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Membership + insert of a key batch in one bounded probe.
+def insert_jnp(table: jnp.ndarray, keys: jnp.ndarray, valid: jnp.ndarray,
+               max_iters: int = 64,
+               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Membership + insert of a key batch in one bounded probe — the
+    pure-jnp reference implementation (the Pallas kernel's parity
+    oracle AND the CPU/interpret fallback; :func:`insert` dispatches).
 
     ``table`` [V+1, 4] uint32 (V a power of two; last row = scatter
     dump), ``keys`` [N, 4] uint32 (pre-:func:`sanitize_keys`-ed or raw —
@@ -151,7 +169,8 @@ def insert(table: jnp.ndarray, keys: jnp.ndarray, valid: jnp.ndarray,
     resolved — the table-full overflow case.  Callers MUST treat
     unresolved keys as fresh (sound re-exploration, never a silent
     drop) and surface ``sum(unresolved)`` as a visible overflow flag.
-    Pure jnp — usable under jit and inside shard_map bodies.
+    Pure jnp — usable under jit, inside shard_map bodies, and inside
+    the Pallas kernel body.
     """
     V = table.shape[0] - 1
     check_cap(V)
@@ -212,3 +231,141 @@ def insert(table: jnp.ndarray, keys: jnp.ndarray, valid: jnp.ndarray,
     resolved = resolved.at[tclip].max(tval & ~t_unres)
     inserted = inserted.at[tclip].max(t_ins & tval)
     return table, inserted, ~resolved
+
+
+# ------------------------------------------------- Pallas bucket kernel
+#
+# ISSUE 12 leg (c): the probe/insert as a Pallas TPU kernel.  The body
+# runs the SAME traced algorithm as insert_jnp over the table resident
+# in VMEM (one load, the whole bounded probe on-chip, one aliased
+# store), so jnp-vs-Pallas parity is bit-exact by construction and the
+# jnp path stays the oracle.  Compiled Mosaic only makes sense when the
+# table fits the VMEM budget; bigger tables and non-TPU backends keep
+# the jnp path (interpret mode exists for parity tests and debugging).
+
+def pallas_mode() -> str:
+    """Resolved DSLABS_VISITED_PALLAS knob: ``off`` (jnp oracle) |
+    ``on`` (compiled on TPU, interpreter elsewhere) | ``interpret``
+    (force the Mosaic interpreter — the CPU parity/test path) |
+    ``auto`` (default: compiled on TPU when the table fits the VMEM
+    budget, jnp everywhere else)."""
+    v = os.environ.get("DSLABS_VISITED_PALLAS", "auto").strip().lower()
+    if v in ("0", "off", "false", "no", ""):
+        return "off"
+    if v == "interpret":
+        return "interpret"
+    if v in ("1", "on", "true", "yes", "pallas"):
+        return "on"
+    return "auto"
+
+
+def _pallas_vmem_budget() -> int:
+    """Table-bytes ceiling for the compiled kernel (the table must sit
+    in VMEM beside the key batch); ~half a v5e core's 16 MB."""
+    try:
+        return int(os.environ.get("DSLABS_VISITED_PALLAS_VMEM", "")
+                   or (8 << 20))
+    except ValueError:
+        return 8 << 20
+
+
+def _pallas_interpret(table_bytes: int) -> Optional[bool]:
+    """None = use the jnp path; True/False = pallas_call's interpret
+    flag.  Decided at TRACE time (env + backend are trace-stable, so
+    rebuilt programs lower identically — the J5 retrace contract)."""
+    mode = pallas_mode()
+    if mode == "off":
+        return None
+    if mode == "interpret":
+        return True
+    on_tpu = jax.default_backend() == "tpu"
+    fits = table_bytes <= _pallas_vmem_budget()
+    if mode == "on":
+        if not on_tpu:
+            return True          # no Mosaic backend: interpreter
+        return False if fits else None   # over-VMEM tables: jnp path
+    # auto: the compiled kernel only where it is actually the win.
+    if on_tpu and fits:
+        return False
+    return None
+
+
+def pallas_insert(table: jnp.ndarray, keys: jnp.ndarray,
+                  valid: jnp.ndarray, max_iters: int = 64,
+                  interpret: Optional[bool] = None,
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """:func:`insert_jnp` as one Pallas kernel: table + key batch load
+    into VMEM, the bounded probe runs on-chip, and the table writes
+    back through an input/output alias (the in-place update the
+    engines' donated carries rely on).  Same signature and bit-exact
+    results as the jnp path; ``interpret=True`` runs the Mosaic
+    interpreter (the CPU parity path — no TPU hardware needed)."""
+    from jax.experimental import pallas as pl
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n = keys.shape[0]
+
+    def kernel(table_ref, keys_ref, valid_ref, out_table_ref,
+               ins_ref, unres_ref):
+        tbl, ins, unres = insert_jnp(
+            table_ref[...], keys_ref[...], valid_ref[...] != 0,
+            max_iters)
+        out_table_ref[...] = tbl
+        ins_ref[...] = ins.astype(jnp.int32)
+        unres_ref[...] = unres.astype(jnp.int32)
+
+    kwargs = {}
+    if not interpret:
+        # Compiled Mosaic: pin everything to VMEM (the default ANY can
+        # land the table in slow HBM) and let in-batch claim conflicts
+        # serialise exactly as traced.
+        from jax.experimental.pallas import tpu as pltpu
+
+        vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+        kwargs = dict(in_specs=[vmem, vmem, vmem],
+                      out_specs=(vmem, vmem, vmem))
+    table2, ins, unres = pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct(table.shape, table.dtype),
+                   jax.ShapeDtypeStruct((n,), jnp.int32),
+                   jax.ShapeDtypeStruct((n,), jnp.int32)),
+        input_output_aliases={0: 0},
+        interpret=bool(interpret), **kwargs)(
+            table, keys, valid.astype(jnp.int32))
+    return table2, ins != 0, unres != 0
+
+
+def insert(table: jnp.ndarray, keys: jnp.ndarray, valid: jnp.ndarray,
+           max_iters: int = 64,
+           ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """THE probe/insert entry point both engines trace: dispatches to
+    the Pallas kernel per :func:`pallas_mode` (compiled on TPU when the
+    table fits VMEM; interpreter when forced) with :func:`insert_jnp`
+    as the everywhere-else fallback and parity oracle.  Contract and
+    return values are identical across paths (see ``insert_jnp``)."""
+    interp = _pallas_interpret(int(table.shape[0]) * 16)
+    if interp is None:
+        return insert_jnp(table, keys, valid, max_iters)
+    return pallas_insert(table, keys, valid, max_iters,
+                         interpret=interp)
+
+
+def dispatch_site_program(cap: int, batch: int):
+    """The ``visited.insert`` audit-site entry (ISSUE 12): the ACTIVE
+    probe/insert variant as a standalone jitted program over abstract
+    args, shaped like one owner-side dedup call — what the jaxpr
+    auditor lowers (J1/J2/J4: no callbacks, no f64, no collectives in
+    the single-device kernel) and the profiler's hot-site table counts
+    via ``telemetry.DISPATCH_SITES``."""
+    check_cap(cap)
+    args = (jax.ShapeDtypeStruct((cap + 1, 4), jnp.uint32),
+            jax.ShapeDtypeStruct((batch, 4), jnp.uint32),
+            jax.ShapeDtypeStruct((batch,), jnp.bool_))
+
+    def build():
+        return jax.jit(lambda t, k, v: insert(t, k, v),
+                       donate_argnums=0)
+
+    return dict(fn=build(), args=args, donate=(0,), multi=False,
+                builder=build)

@@ -303,6 +303,38 @@ def test_j4_collective_in_single_device_program():
         "Fixture") == []
 
 
+def test_j4_collective_leaking_into_width1_build():
+    """ISSUE 12 red fixture: the REAL width-1 sharded superstep build
+    still lowers its mesh collectives (identity all_to_all/psum become
+    all_reduce over a one-element group) — registered as a
+    single-device program (multi=False) it must be a loud J4, which is
+    exactly the drift J4 exists to catch: a program built against the
+    wrong mesh scope leaking collectives into the single-chip bench
+    path.  Registered honestly (the registry's multi=True for
+    sharded.*), the same build audits clean."""
+    import dataclasses
+
+    from dslabs_tpu.tpu.protocols.pingpong import make_pingpong_protocol
+    from dslabs_tpu.tpu.sharded import ShardedTensorSearch, make_mesh
+
+    pp = make_pingpong_protocol(workload_size=2)
+    proto = dataclasses.replace(
+        pp, goals={}, prunes={"CLIENTS_DONE": pp.goals["CLIENTS_DONE"]})
+    search = ShardedTensorSearch(proto, make_mesh(1),
+                                 chunk_per_device=16,
+                                 frontier_cap=1 << 8,
+                                 visited_cap=1 << 10)
+    sites = search.dispatch_site_programs()
+    entry = dict(sites["sharded.superstep"], multi=False)
+    found = audit_sites({"width1.superstep": entry}, "Fixture")
+    assert "J4" in _codes(found)            # the red shape
+    # The honest registration (registry multi=True) is clean end to
+    # end — the standing zero-findings pin covers it, re-asserted here
+    # for the fused-exchange build specifically.
+    assert [f for f in audit_sites(sites, "ShardedTensorSearch")
+            if f.code == "J4"] == []
+
+
 def test_j5_retrace_hazard_fresh_constants_per_build():
     sds = jax.ShapeDtypeStruct((8,), jnp.float32)
 

@@ -1,0 +1,396 @@
+"""Owner-sharded multi-chip superstep (ISSUE 12): the fused in-superstep
+row exchange, first-class carry placement, and the Pallas bucket-probe
+kernel.
+
+The fused exchange routes successor ROWS through the same owner-hashed
+``all_to_all`` as their fingerprints, so fresh states land on their
+owner's frontier shard as they are produced and the level promote
+shrinks to a local buffer swap (no reverse fresh-flag exchange, no
+boundary rebalance).  This suite is the acceptance matrix:
+
+* exact unique/explored/verdict/depth parity between the fused-exchange
+  superstep and the legacy promote-boundary driver
+  (``DSLABS_SHARDED_SUPERSTEP=0`` / ``superstep=False``) at
+  n_devices in {1, 2, 4, 8} on pingpong + lab1;
+* per-level host dispatches stay within the PR 3 budget (<= 2/level)
+  and the fused promote program carries ZERO collectives;
+* Pallas-vs-jnp visited-table parity — bit-exact tables, insert flags,
+  and the unresolved/overflow contract — standalone and through a full
+  sharded search (``DSLABS_VISITED_PALLAS=interpret``);
+* cross-width checkpoint resume 8 -> 4 -> 2 -> 1 stays exact on the new
+  exchange path (owner re-hashing at each narrower width);
+* the supervisor's transient-retry boundary covers the fused dispatch.
+
+Marked ``mesh`` (``make mesh-smoke`` runs exactly this suite on the
+CPU virtual 8-device mesh); the heavier combinations are additionally
+``slow`` so tier-1 keeps only the cheap ones.
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from dslabs_tpu.tpu import visited as visited_mod  # noqa: E402
+from dslabs_tpu.tpu.protocols.clientserver import \
+    make_clientserver_protocol  # noqa: E402
+from dslabs_tpu.tpu.protocols.pingpong import \
+    make_pingpong_protocol  # noqa: E402
+from dslabs_tpu.tpu.sharded import (CARRY_PARTITION_RULES,  # noqa: E402
+                                    ShardedTensorSearch, make_mesh,
+                                    match_partition_rules)
+
+pytestmark = pytest.mark.mesh
+
+_COLLECTIVES = ("all-to-all", "all_to_all", "all-reduce", "all_reduce",
+                "all-gather", "all_gather", "collective-permute",
+                "collective_permute", "reduce-scatter", "reduce_scatter")
+
+
+def _pruned_pingpong():
+    pp = make_pingpong_protocol(workload_size=2)
+    return dataclasses.replace(
+        pp, goals={}, prunes={"CLIENTS_DONE": pp.goals["CLIENTS_DONE"]})
+
+
+def _pruned_lab1():
+    cs = make_clientserver_protocol(n_clients=1, w=2)
+    return dataclasses.replace(
+        cs, goals={}, prunes={"CLIENTS_DONE": cs.goals["CLIENTS_DONE"]})
+
+
+def _build(proto, n_devices, **kw):
+    kw.setdefault("chunk_per_device", 16)
+    kw.setdefault("frontier_cap", 1 << 8)
+    kw.setdefault("visited_cap", 1 << 10)
+    return ShardedTensorSearch(proto, make_mesh(n_devices), **kw)
+
+
+def _assert_exact(a, b):
+    assert a.end_condition == b.end_condition
+    assert a.unique_states == b.unique_states
+    assert a.states_explored == b.states_explored
+    assert a.depth == b.depth
+    assert a.dropped == b.dropped
+
+
+# --------------------------------------------------- width-parity matrix
+
+@pytest.mark.parametrize("n_devices", [1, 2, 4, 8])
+def test_width_parity_matrix_pingpong(n_devices):
+    """Acceptance: the fused-exchange superstep matches the legacy
+    promote-boundary driver EXACTLY at every mesh width."""
+    proto = _pruned_pingpong()
+    fused = _build(proto, n_devices, superstep=True,
+                   row_exchange=True).run()
+    legacy = _build(proto, n_devices, superstep=False).run()
+    assert fused.end_condition == "SPACE_EXHAUSTED"
+    _assert_exact(fused, legacy)
+
+
+@pytest.mark.parametrize("n_devices", [1, 8])
+def test_width_parity_matrix_lab1(n_devices):
+    proto = _pruned_lab1()
+    fused = _build(proto, n_devices, superstep=True,
+                   row_exchange=True).run()
+    legacy = _build(proto, n_devices, superstep=False).run()
+    assert fused.end_condition == "SPACE_EXHAUSTED"
+    _assert_exact(fused, legacy)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("n_devices", [2, 4])
+def test_width_parity_matrix_lab1_mid_widths(n_devices):
+    proto = _pruned_lab1()
+    fused = _build(proto, n_devices, superstep=True,
+                   row_exchange=True).run()
+    legacy = _build(proto, n_devices, superstep=False).run()
+    _assert_exact(fused, legacy)
+
+
+@pytest.mark.slow
+def test_width_parity_strict_vs_beam():
+    """The exchange is verdict-preserving in BOTH capacity modes."""
+    proto = _pruned_pingpong()
+    for strict in (True, False):
+        fused = _build(proto, 8, superstep=True, row_exchange=True,
+                       strict=strict).run()
+        legacy = _build(proto, 8, superstep=False, strict=strict).run()
+        _assert_exact(fused, legacy)
+
+
+def test_row_exchange_vs_legacy_exchange_superstep():
+    """Both superstep exchanges (fused rows vs promote-boundary) agree
+    — the DSLABS_SHARDED_EXCHANGE=0 escape hatch is a real oracle."""
+    proto = _pruned_pingpong()
+    fused = _build(proto, 8, superstep=True, row_exchange=True).run()
+    boundary = _build(proto, 8, superstep=True,
+                      row_exchange=False).run()
+    _assert_exact(fused, boundary)
+
+
+def test_row_exchange_knob_and_legacy_driver_forcing():
+    """The knob wiring: DSLABS_SHARDED_EXCHANGE gates the default, the
+    legacy per-chunk driver always keeps the promote-boundary
+    exchange (it IS the oracle)."""
+    proto = _pruned_pingpong()
+    assert _build(proto, 2).row_exchange is True        # default ON
+    assert _build(proto, 2, superstep=False).row_exchange is False
+    os.environ["DSLABS_SHARDED_EXCHANGE"] = "0"
+    try:
+        assert _build(proto, 2).row_exchange is False
+    finally:
+        del os.environ["DSLABS_SHARDED_EXCHANGE"]
+    assert _build(proto, 2, row_exchange=True).row_exchange is True
+
+
+# ---------------------------------------------- dispatch budget + promote
+
+def test_fused_exchange_dispatch_budget():
+    """The dispatch-counter pin (PR 3 budget): the fused-exchange level
+    spends <= 2 host dispatches (superstep + thin promote), and the
+    promote program moves ZERO rows over ICI — its lowering contains
+    no collective at width 8."""
+    proto = _pruned_pingpong()
+    search = _build(proto, 8, superstep=True, row_exchange=True)
+    counts = {}
+
+    def hook(tag, fn, *args):
+        counts[tag] = counts.get(tag, 0) + 1
+        return fn(*args)
+
+    search._dispatch_hook = hook
+    out = search.run()
+    assert out.depth >= 3
+    assert counts.get("sharded.step", 0) == 0
+    assert counts.get("sharded.sync", 0) == 0
+    assert (counts["sharded.superstep"] + counts["sharded.promote"]
+            <= 2 * out.depth)
+
+    text = search._finish_level.lower(search._carry_sds()).as_text()
+    assert not any(c in text for c in _COLLECTIVES), (
+        "fused-exchange promote must be a local buffer swap")
+    # ... while the legacy promote at the same width IS the rebalance.
+    legacy = _build(proto, 8, superstep=True, row_exchange=False)
+    text = legacy._finish_level.lower(legacy._carry_sds()).as_text()
+    assert any(c in text for c in _COLLECTIVES)
+
+
+# --------------------------------------------------- carry placement (b)
+
+def test_partition_rules_cover_every_carry_leaf():
+    """Every carry leaf (base + trace + spill variants) resolves
+    through CARRY_PARTITION_RULES; an undeclared leaf is loud."""
+    names = ["cur", "cur_n", "j", "evp", "noapp", "nxt", "nxt_n",
+             "visited", "vis_n", "explored", "overflow", "vis_over",
+             "drops", "flag_cnt", "flag_rows", "tmeta", "flag_meta",
+             "f_full"]
+    specs = match_partition_rules(CARRY_PARTITION_RULES, names,
+                                  "search")
+    assert set(specs) == set(names)
+    from jax.sharding import PartitionSpec as P
+    assert specs["cur"] == P("search")
+    assert specs["visited"] == P("search")
+    with pytest.raises(ValueError, match="no partition rule"):
+        match_partition_rules(CARRY_PARTITION_RULES, ["mystery"],
+                              "search")
+
+
+def test_carry_placement_is_first_class():
+    """The rule-derived NamedShardings feed every placement consumer:
+    shard_map specs, the init program's outputs, and the AOT
+    ShapeDtypeStructs agree leaf for leaf — and survive a width
+    change (the elastic-ladder contract)."""
+    from jax.sharding import NamedSharding
+
+    proto = _pruned_pingpong()
+    for width in (8, 2):
+        search = _build(proto, width)
+        shards = search._carry_shardings()
+        specs = search._carry_specs()
+        sds = search._carry_sds()
+        assert set(shards) == set(specs) == set(sds)
+        for k, s in shards.items():
+            assert isinstance(s, NamedSharding)
+            assert s.spec == specs[k]
+            assert sds[k].sharding == s
+        carry = search._init_carry(search.initial_state())
+        for k, v in carry.items():
+            assert v.sharding.is_equivalent_to(shards[k], v.ndim), k
+
+
+# ------------------------------------------------ Pallas bucket kernel (c)
+
+def _key_batch(n=300, seed=0):
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, 2 ** 32, size=(n, 4), dtype=np.uint32)
+    keys[50:60] = keys[0:10]            # in-batch duplicates
+    keys[99] = np.uint32(0xFFFFFFFF)    # the all-MAX collider
+    valid = rng.random(n) > 0.2
+    return jnp.asarray(keys), jnp.asarray(valid)
+
+
+def test_pallas_vs_jnp_insert_bitexact():
+    """The kernel body is the SAME traced algorithm as the jnp oracle:
+    tables, insert flags, and unresolved flags are bit-identical."""
+    keys, valid = _key_batch()
+    table = visited_mod.empty_table(1 << 9)
+    tj, ij, uj = visited_mod.insert_jnp(table, keys, valid)
+    tp, ip, up = visited_mod.pallas_insert(table, keys, valid,
+                                           interpret=True)
+    assert (np.asarray(tj) == np.asarray(tp)).all()
+    assert (np.asarray(ij) == np.asarray(ip)).all()
+    assert (np.asarray(uj) == np.asarray(up)).all()
+
+
+def test_pallas_overflow_contract_parity():
+    """Table-full overflow (ISSUE 1 contract): the unresolved set — the
+    keys a strict driver raises CapacityOverflow on — is identical
+    between the kernel and the oracle on a saturated table."""
+    keys, valid = _key_batch()
+    tiny = visited_mod.empty_table(visited_mod.BKT * 2)
+    tj, ij, uj = visited_mod.insert_jnp(tiny, keys, valid)
+    tp, ip, up = visited_mod.pallas_insert(tiny, keys, valid,
+                                           interpret=True)
+    assert int(np.asarray(uj).sum()) > 0        # genuinely overflowed
+    assert (np.asarray(uj) == np.asarray(up)).all()
+    assert (np.asarray(ij) == np.asarray(ip)).all()
+    assert (np.asarray(tj) == np.asarray(tp)).all()
+
+
+def test_pallas_mode_knob():
+    os.environ["DSLABS_VISITED_PALLAS"] = "0"
+    try:
+        assert visited_mod.pallas_mode() == "off"
+        assert visited_mod._pallas_interpret(1 << 10) is None
+    finally:
+        os.environ["DSLABS_VISITED_PALLAS"] = "interpret"
+    try:
+        assert visited_mod.pallas_mode() == "interpret"
+        assert visited_mod._pallas_interpret(1 << 30) is True
+    finally:
+        del os.environ["DSLABS_VISITED_PALLAS"]
+    # auto on CPU: the jnp oracle (no Mosaic backend to win on).
+    assert visited_mod.pallas_mode() == "auto"
+    assert visited_mod._pallas_interpret(1 << 10) is None
+
+
+def test_pallas_engine_parity(monkeypatch):
+    """A full fused-exchange search with the table probe forced through
+    the Pallas interpreter matches the jnp-path run exactly — the
+    CapacityOverflow/visited_overflow contract is unchanged."""
+    proto = _pruned_pingpong()
+    base = _build(proto, 2, superstep=True, row_exchange=True).run()
+    monkeypatch.setenv("DSLABS_VISITED_PALLAS", "interpret")
+    out = _build(proto, 2, superstep=True, row_exchange=True).run()
+    _assert_exact(out, base)
+
+
+def test_pallas_site_registered_and_clean():
+    """The bucket kernel is a canonical dispatch site: registered in
+    telemetry.DISPATCH_SITES (hot -> profiler selection), present in
+    both engines' site maps, and its lowering audits clean."""
+    from dslabs_tpu.analysis.jaxpr_audit import audit_sites
+    from dslabs_tpu.tpu.telemetry import (DISPATCH_SITES,
+                                          _PROFILE_SITES)
+
+    assert "visited.insert" in DISPATCH_SITES
+    assert DISPATCH_SITES["visited.insert"]["hot"]
+    assert "insert" in _PROFILE_SITES
+    proto = _pruned_pingpong()
+    search = _build(proto, 2)
+    sites = search.dispatch_site_programs()
+    assert "visited.insert" in sites
+    findings = audit_sites(
+        {"visited.insert": sites["visited.insert"]},
+        "ShardedTensorSearch")
+    assert findings == []
+
+
+# ------------------------------------------------- cross-width resilience
+
+def test_cross_width_resume_8_4_2_1(tmp_path):
+    """Satellite: a fused-exchange checkpoint re-shards exactly onto
+    every narrower width (owner re-hash at the new D) — the elastic
+    ladder's resume contract holds on the new exchange path."""
+    proto = _pruned_pingpong()
+    oracle = _build(proto, 8, row_exchange=True).run()
+    assert oracle.end_condition == "SPACE_EXHAUSTED"
+
+    path = str(tmp_path / "mesh.ckpt")
+    out = _build(proto, 8, row_exchange=True, checkpoint_path=path,
+                 checkpoint_every=1, max_depth=2).run()
+    assert out.end_condition == "DEPTH_EXHAUSTED"
+    for width, depth in ((4, 3), (2, 4), (1, None)):
+        search = _build(proto, width, row_exchange=True,
+                        checkpoint_path=path, checkpoint_every=1,
+                        max_depth=depth)
+        out = search.run(resume=True)
+    assert out.end_condition == oracle.end_condition
+    assert out.unique_states == oracle.unique_states
+    assert out.states_explored == oracle.states_explored
+    assert out.depth == oracle.depth
+
+
+def test_fused_exchange_transient_retry():
+    """The supervisor's retry boundary covers the fused dispatch: a
+    transient raise inside a superstep retries in place with an
+    identical verdict (fault site = sharded.superstep, the fused
+    exchange's dispatch tag in DISPATCH_SITES)."""
+    from dslabs_tpu.tpu.supervisor import (FaultPlan, RetryPolicy,
+                                           SearchSupervisor)
+
+    proto = _pruned_pingpong()
+
+    def sup(**kw):
+        return SearchSupervisor(
+            proto, mesh=make_mesh(8), chunk=16, frontier_cap=1 << 8,
+            visited_cap=1 << 10, row_exchange=True, **kw)
+
+    base = sup().run()
+    assert base.end_condition == "SPACE_EXHAUSTED"
+    out = sup(fault_plan=FaultPlan().raise_at(2, count=2),
+              policy=RetryPolicy(max_retries=3,
+                                 backoff_base=0.001)).run()
+    assert out.end_condition == base.end_condition
+    assert out.unique_states == base.unique_states
+    assert out.states_explored == base.states_explored
+    assert out.retries == 2
+    assert out.failovers == 0
+
+
+# ------------------------------------------------------- bench mesh phase
+
+@pytest.mark.slow
+def test_bench_mesh_phase_schema():
+    """The bench's --mesh phase (the new headline): last-line JSON
+    carries mesh_width, finite skew, per-level per-device lanes, and
+    clean recovery counters on the CPU virtual 8-device mesh."""
+    import json
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DSLABS_BENCH_PROTOCOL", None)
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--mesh", "90"],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    line = proc.stdout.strip().splitlines()[-1]
+    phase = json.loads(line)
+    assert phase["mesh_width"] == 8
+    assert phase["virtual_cpu_mesh"] is True
+    assert phase["value"] > 0
+    assert phase["unique"] > 0
+    sk = phase["skew"]
+    assert np.isfinite(sk["imbalance_max"])
+    assert sk["imbalance_max"] >= 1.0
+    assert phase["mesh_shrinks"] == 0
+    assert phase["knob_retries"] == 0
+    levels = phase["levels"]
+    assert levels and "per_device" in levels[-1]
+    assert len(levels[-1]["per_device"]["explored"]) == 8

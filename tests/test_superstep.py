@@ -187,9 +187,15 @@ def test_single_device_mesh_skips_chunk_grid_widening():
     # max_n % chunk == 0 (the widening added a full invalid chunk).
     assert counts["sharded.step"] == out.depth
     mesh8 = make_mesh(8)
+    # The legacy promote-boundary exchange needs the ceil-split slack
+    # on a wide mesh; the fused row exchange (ISSUE 12 default) has no
+    # rebalance at all, so no slack either.
     assert ShardedTensorSearch(
         proto, mesh8, chunk_per_device=16, frontier_cap=1 << 8,
-        visited_cap=1 << 10)._rebalance_slack() == 7
+        visited_cap=1 << 10, superstep=False)._rebalance_slack() == 7
+    assert ShardedTensorSearch(
+        proto, mesh8, chunk_per_device=16, frontier_cap=1 << 8,
+        visited_cap=1 << 10, row_exchange=True)._rebalance_slack() == 0
 
 
 # ------------------------------------------------------- level records

@@ -513,6 +513,38 @@ def test_ledger_compare_flags_injected_regression_and_parity(
     assert compare_ledger(read_ledger(ledger))["regressions"]
 
 
+def test_ledger_compare_flags_headline_mesh_width_fallback(tmp_path):
+    """ISSUE 12: a run whose headline silently fell back to a narrower
+    mesh (mesh_width 8 -> 1) is a REGRESSION even when its states/min
+    compares as a win — and an equal-width faster run stays a clean
+    improvement."""
+    from dslabs_tpu.tpu.telemetry import (append_ledger, compare_ledger,
+                                          read_ledger)
+
+    ledger = str(tmp_path / "BENCH_HISTORY.jsonl")
+    append_ledger(ledger, {"t": "bench", "value": 4.0e6,
+                           "mesh_width": 8,
+                           "mesh": {"value": 4.0e6}})
+    append_ledger(ledger, {"t": "bench", "value": 6.0e6,
+                           "mesh_width": 1,
+                           "mesh": {"value": 6.0e6}})
+    cmp = compare_ledger(read_ledger(ledger))
+    reg = {e["phase"]: e for e in cmp["regressions"]}
+    assert "headline:mesh_width" in reg
+    assert reg["headline:mesh_width"]["latest"] == 1
+    assert reg["headline:mesh_width"]["best_prior"] == 8
+
+    append_ledger(ledger, {"t": "bench", "value": 7.0e6,
+                           "mesh_width": 8,
+                           "mesh": {"value": 7.0e6}})
+    cmp = compare_ledger(read_ledger(ledger))
+    assert not any(e["phase"] == "headline:mesh_width"
+                   for e in cmp["regressions"])
+    assert cmp["mesh_width"]["mesh_width"]["latest"] == 8
+    # The mesh phase itself is tracked like any rate phase.
+    assert cmp["phases"]["mesh"]["latest"] == 7000000.0
+
+
 # ------------------------------------------------------------ report CLI
 
 def test_report_cli_golden_sections(tmp_path, capsys):
