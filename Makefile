@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast lint analysis-smoke perf-smoke fault-smoke swarm-smoke capacity-smoke obs-smoke chaos-smoke service-smoke mesh-smoke lab0 lab1 lab2 lab3 lab4 bench dryrun handout clean
+.PHONY: test test-fast lint analysis-smoke perf-smoke fault-smoke swarm-smoke capacity-smoke obs-smoke chaos-smoke service-smoke trace-smoke mesh-smoke lab0 lab1 lab2 lab3 lab4 bench dryrun handout clean
 
 test:            ## full acceptance + parity suite
 	$(PY) -m pytest tests/ -q
@@ -122,6 +122,22 @@ chaos-smoke:     ## elastic-mesh resilience suite (degraded ladder / knob shrink
 # docs/service.md is the field guide.
 service-smoke:   ## multi-tenant checking service suite (queue / admission / fairness / isolation soak) on CPU
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m service -p no:cacheprovider
+
+# trace-smoke = the end-to-end causal-tracing + cost-accounting suite
+# (tests/test_tracing.py, ISSUE 13): trace-ID propagation
+# submit -> journal -> scheduler -> warden env -> child flight logs,
+# the SIGKILL acceptance (one pingpong job submitted to a local
+# server, its warden child SIGKILLed mid-level, `telemetry trace`
+# still renders the full causal chain from disk alone and names the
+# in-flight dispatch), per-tenant COSTS.jsonl sums agreeing with the
+# jobs' SearchOutcome counters exactly, torn SERVER_STATUS/COSTS
+# reads, the run-dir retention sweep, and the compile-creep /
+# cost-per-unique ledger-compare guards — then the trace-assembler
+# leg of tools/obs_smoke.py (the CLI end to end).
+# docs/observability.md "Tracing a job end-to-end" is the field guide.
+trace-smoke:     ## causal tracing + cost-ledger suite (assembler / COSTS / retention) on CPU
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m trace -p no:cacheprovider
+	JAX_PLATFORMS=cpu $(PY) tools/obs_smoke.py
 
 # mesh-smoke = the owner-sharded multi-chip superstep suite
 # (tests/test_mesh_exchange.py, ISSUE 12): the width-parity matrix —

@@ -798,6 +798,13 @@ def _run_service(budget_secs: float) -> dict:
         "failed": summary["failed"],
         "rejected": rejected,
         "fairness_index": summary["fairness_index"],
+        # The per-tenant cost ledger (ISSUE 13, tpu/tracing.py):
+        # device-seconds / dispatches / compile split per tenant, plus
+        # the aggregate cost-per-unique-state the ledger compare
+        # tracks for regressions (telemetry.compare_ledger).
+        "cost_per_unique": summary.get("cost_per_unique"),
+        "device_secs": summary.get("device_secs"),
+        "costs": summary.get("costs"),
         "per_tenant": {
             t: {"verdicts": s["verdicts"],
                 "verdicts_per_min": s["verdicts_per_min"],

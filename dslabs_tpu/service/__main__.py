@@ -82,23 +82,26 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.cmd == "status":
         from dslabs_tpu.service.queue import ServiceQueue
+        from dslabs_tpu.tpu import tracing
         import os
 
-        status_path = None
-        try:
-            from dslabs_tpu.service.server import SERVER_STATUS_NAME
+        from dslabs_tpu.service.server import SERVER_STATUS_NAME
 
-            status_path = os.path.join(args.root, SERVER_STATUS_NAME)
-            with open(status_path) as f:
-                server = json.load(f)
-        except (OSError, ValueError):
-            server = None
+        # Both snapshots are read TOLERANTLY (ISSUE 13 satellite): a
+        # mid-write SERVER_STATUS (the tmp+replace race) or a torn
+        # COSTS.jsonl tail (a server killed mid-append) must degrade
+        # to partial output, never a crashed status command.
+        status_path = os.path.join(args.root, SERVER_STATUS_NAME)
+        server = tracing.load_json_tolerant(status_path)
+        cost_recs, _torn = tracing.read_flight_lax(
+            os.path.join(args.root, tracing.COSTS_NAME))
         q = ServiceQueue(args.root)
         try:
             summary = q.summary()
         finally:
             q.close()
         print(json.dumps({"server": server, "queue": summary,
+                          "costs": tracing.aggregate_costs(cost_recs),
                           "status_path": status_path}))
         return 0
 
@@ -112,9 +115,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not args.full:
         summary = dict(summary)
         summary["results"] = [
-            {k: r.get(k) for k in ("job_id", "tenant", "status", "end",
-                                   "unique", "attempts", "degraded",
-                                   "kind")}
+            {k: r.get(k) for k in ("job_id", "tenant", "trace_id",
+                                   "status", "end", "unique",
+                                   "attempts", "degraded", "kind")}
             for r in summary.get("results", [])]
     print(json.dumps(summary))
     return 0 if summary.get("failed", 0) == 0 else 1

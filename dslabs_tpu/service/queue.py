@@ -83,6 +83,11 @@ class Job:
     # environment condition clearing.
     fault: Optional[dict] = None
     submitted_at: float = 0.0
+    # Causal-trace identity (ISSUE 13, tpu/tracing.py): minted at
+    # submit, persisted by the journal, stamped on every journal event
+    # and warden child env — the one key the trace assembler joins the
+    # journal, SERVER_STATUS, and the per-job flight logs on.
+    trace_id: Optional[str] = None
 
     def as_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -183,11 +188,22 @@ class ServiceQueue:
     def _append(self, rec: dict) -> None:
         if self._fh is None:
             return
+        # Every journal event is timestamped (ISSUE 13): the trace
+        # assembler derives queue-wait / attempt / verdict boundaries
+        # from these, so the causal timeline exists on disk alone.
+        rec.setdefault("ts", round(time.time(), 3))
         try:
             self._fh.write(json.dumps(rec) + "\n")
         except (OSError, ValueError) as e:
             self.journal_error = f"{type(e).__name__}: {e}"
             self._fh = None
+
+    def log_event(self, kind: str, **fields) -> None:
+        """Append one free-form operational event to the journal (the
+        admission gate's timing, retention prunes, …) — replay ignores
+        unknown kinds, the trace assembler reads them."""
+        with self._lock:
+            self._append({"t": kind, **fields})
 
     def compact(self) -> None:
         """Rewrite the journal to the live state only (dropping the

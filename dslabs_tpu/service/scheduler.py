@@ -80,6 +80,17 @@ class AttemptPlan:
     knob_shrinks: int = 0
     rung_steps: int = 0
 
+    def span_id(self, job_id: str) -> str:
+        """This attempt's causal-trace span id (ISSUE 13) — the
+        DETERMINISTIC derivation shared with the trace assembler
+        (tpu/tracing.py ``attempt_span_id``): the warden passes it to
+        children as ``DSLABS_PARENT_SPAN`` and the assembler rebuilds
+        it from the journal's ``start`` record alone, so the two link
+        without any extra journal field."""
+        from dslabs_tpu.tpu.tracing import attempt_span_id
+
+        return attempt_span_id(job_id, self.attempt)
+
 
 def degrade(plan: AttemptPlan, kind: str,
             retry: RetrySpec) -> Optional[AttemptPlan]:

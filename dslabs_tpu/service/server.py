@@ -53,6 +53,7 @@ from dslabs_tpu.service.queue import Job, ServiceQueue
 from dslabs_tpu.service.scheduler import (AttemptPlan, DeficitRoundRobin,
                                           RetrySpec, degrade,
                                           fairness_index)
+from dslabs_tpu.tpu import tracing
 
 __all__ = ["CheckServer", "SERVER_STATUS_NAME", "admission_check"]
 
@@ -153,10 +154,28 @@ class CheckServer:
                  warden_kwargs: Optional[dict] = None,
                  env: Optional[dict] = None,
                  extra_sys_path: Optional[List[str]] = None,
-                 elastic: bool = True):
+                 elastic: bool = True,
+                 keep: Optional[int] = None,
+                 telemetry=None):
         self.root = os.path.abspath(root)
         os.makedirs(self.root, exist_ok=True)
         self.queue = ServiceQueue(self.root, cap=queue_cap)
+        # Per-tenant cost ledger (ISSUE 13, tpu/tracing.py): every
+        # finished job appends one COSTS.jsonl record built from its
+        # verdict counters + its run dir's flight log — zero added
+        # device work; a restarted server replays the ledger.
+        self.costs = tracing.CostMeter(
+            os.path.join(self.root, tracing.COSTS_NAME))
+        # Run-dir retention (ISSUE 13 satellite): service roots used to
+        # grow without bound — at scheduler idle the oldest FINISHED
+        # jobs' run dirs are pruned down to `keep` (DSLABS_SERVICE_KEEP,
+        # default 64); running/queued jobs are never touched.
+        self.keep = (keep if keep is not None
+                     else _env_int("DSLABS_SERVICE_KEEP", 64))
+        # Optional parent-side telemetry recorder: retention prunes and
+        # scheduler-level events become flight-log events when one is
+        # attached (the bench's service phase does).
+        self.telemetry = telemetry
         self.workers = (workers if workers is not None
                         else _env_int("DSLABS_SERVICE_WORKERS", 2))
         if admission is None:
@@ -213,52 +232,80 @@ class CheckServer:
         """
         with self._lock:
             st = self.stats.setdefault(tenant, _zero_stats())
+        # One trace id per submission (ISSUE 13): minted HERE — the
+        # journal persists it on the job record, every phase of the
+        # job's life (admission, queue wait, each warden attempt,
+        # every child's flight log) is stamped with it, and
+        # `telemetry trace` reassembles the causal tree from disk.
+        trace_id = tracing.mint_trace_id()
         if self.admission:
-            findings = self._admit(factory, factory_kwargs, transform)
+            t_adm = time.time()
+            findings, cached = self._admit(factory, factory_kwargs,
+                                           transform)
             unwaived = [f for f in findings if not f.get("waived")]
+            self.queue.log_event(
+                "admission", tenant=tenant, factory=factory,
+                trace_id=trace_id, secs=round(time.time() - t_adm, 3),
+                cached=cached, findings=len(unwaived))
             if unwaived:
                 self.queue.mark_rejected(
                     tenant, "unsound_spec",
-                    {"factory": factory, "findings": unwaived[:8]})
+                    {"factory": factory, "trace_id": trace_id,
+                     "findings": unwaived[:8]})
                 with self._lock:
                     st["rejected"] += 1
                 self._write_status()
                 return {"accepted": False, "rejected": True,
                         "reason": "unsound_spec", "factory": factory,
-                        "findings": unwaived}
+                        "trace_id": trace_id, "findings": unwaived}
+        else:
+            # The gate-off path still lands an admission event so the
+            # causal chain submit -> queue -> admission -> … is
+            # unbroken in every configuration.
+            self.queue.log_event("admission", tenant=tenant,
+                                 factory=factory, trace_id=trace_id,
+                                 secs=0.0, skipped=True, findings=0)
         job = Job(job_id=self.queue.next_id(tenant), tenant=tenant,
                   factory=factory, factory_kwargs=factory_kwargs,
                   transform=transform, strict=strict,
                   max_depth=max_depth, max_secs=max_secs,
                   budget_units=budget_units, chunk=chunk,
                   frontier_cap=frontier_cap, visited_cap=visited_cap,
-                  ladder=tuple(ladder), fault=fault)
+                  ladder=tuple(ladder), fault=fault,
+                  trace_id=trace_id)
         res = self.queue.submit(job)
         if res.get("accepted"):
+            res["trace_id"] = trace_id
             with self._lock:
                 self.sched.push(job)
                 st["submitted"] += 1
         else:
-            self.queue.mark_rejected(tenant, "queue_full")
+            self.queue.mark_rejected(tenant, "queue_full",
+                                     {"trace_id": trace_id})
             with self._lock:
                 st["rejected"] += 1
         self._write_status()
         return res
 
-    def _admit(self, factory, factory_kwargs, transform) -> List[dict]:
+    def _admit(self, factory, factory_kwargs,
+               transform) -> Tuple[List[dict], bool]:
+        """The cached admission check; returns ``(findings, cached)``
+        so the journal's admission event can tell a paid subprocess
+        check from a cache hit (their latencies differ by ~1000x and
+        the trace timeline should say which one a tenant waited on)."""
         key = (factory,
                json.dumps(factory_kwargs or {}, sort_keys=True),
                transform or "")
         with self._lock:
             cached = self._admission_cache.get(key)
         if cached is not None:
-            return cached
+            return cached, True
         findings = admission_check(factory, factory_kwargs, transform,
                                    extra_sys_path=self.extra_sys_path,
                                    env=self.env)
         with self._lock:
             self._admission_cache[key] = findings
-        return findings
+        return findings, False
 
     # ------------------------------------------------------------ run job
 
@@ -299,6 +346,13 @@ class CheckServer:
                 env=dict(self.env),
                 extra_sys_path=self.extra_sys_path,
                 elastic=self.elastic,
+                # Trace propagation (ISSUE 13): the warden forwards
+                # both via DSLABS_TRACE_ID/DSLABS_PARENT_SPAN, and the
+                # attempt span id is DERIVED from the journal's start
+                # record, so the child's flight-log meta links back to
+                # this exact attempt with no extra journal field.
+                trace_id=job.trace_id,
+                parent_span=plan.span_id(job.job_id),
                 **self.warden_kwargs)
             try:
                 out = w.run(resume=plan.attempt > 1)
@@ -310,17 +364,20 @@ class CheckServer:
                 if nxt is None:
                     failure = {
                         "job_id": job.job_id, "tenant": job.tenant,
+                        "trace_id": job.trace_id,
                         "status": "failed", "kind": kind,
                         "attempts": plan.attempt,
                         "knob_shrinks": plan.knob_shrinks,
                         "rung_steps": plan.rung_steps,
                         "deaths": deaths,
+                        "budget_units": job.budget_units,
                         "run_dir": rd,
                         "elapsed_secs": round(time.time() - t0, 2),
                     }
                     self.queue.mark_failed(job.job_id, {
                         "kind": kind, "attempts": plan.attempt,
                         "deaths": len(deaths)})
+                    self._charge(failure, rd)
                     return failure
                 time.sleep(self.retry.backoff(plan.attempt - 1))
                 plan = nxt
@@ -328,20 +385,25 @@ class CheckServer:
             except BaseException as e:  # noqa: BLE001 — structured, never silent
                 failure = {
                     "job_id": job.job_id, "tenant": job.tenant,
+                    "trace_id": job.trace_id,
                     "status": "failed", "kind": "error",
                     "error": f"{type(e).__name__}: {e}"[:300],
                     "attempts": plan.attempt, "deaths": deaths,
+                    "budget_units": job.budget_units,
                     "run_dir": rd,
                     "elapsed_secs": round(time.time() - t0, 2),
                 }
                 self.queue.mark_failed(job.job_id, {
                     "kind": "error",
                     "error": failure["error"][:200]})
+                self._charge(failure, rd)
                 return failure
             deaths += [{"rung": d.rung, "kind": d.kind,
                         "detail": d.detail[:200]} for d in w.deaths]
             verdict = {
                 "job_id": job.job_id, "tenant": job.tenant,
+                "trace_id": job.trace_id,
+                "budget_units": job.budget_units,
                 "status": "done",
                 "end": out.end_condition,
                 "unique": out.unique_states,
@@ -365,7 +427,66 @@ class CheckServer:
                 "explored": out.states_explored, "depth": out.depth,
                 "attempts": plan.attempt,
                 "degraded": verdict["degraded"]})
+            self._charge(verdict, rd)
             return verdict
+
+    def _charge(self, verdict: dict, run_dir: str) -> None:
+        """Feed the cost meter (never fatal — accounting must not take
+        a verdict down): the verdict's exact counters + the run dir's
+        flight log become one COSTS.jsonl record."""
+        try:
+            self.costs.charge(
+                verdict, os.path.join(run_dir, "flight.jsonl"))
+        except Exception:  # noqa: BLE001 — accounting is best-effort
+            pass
+
+    # ---------------------------------------------------------- retention
+
+    def retention_sweep(self) -> List[str]:
+        """Prune the oldest FINISHED jobs' run dirs down to
+        ``self.keep`` (DSLABS_SERVICE_KEEP).  Called at scheduler idle
+        (drain start/end) — never while that job could still run:
+        running and queued jobs are excluded by construction, and a
+        pruned job keeps its journal/ledger records (only the run dir
+        — checkpoint, flight log, compile cache — goes).  Each prune
+        is journaled and, when a recorder is attached, a telemetry
+        event."""
+        import shutil
+
+        with self._lock:
+            busy = {j.job_id
+                    for q in self.sched._queues.values() for j in q}
+            running = {t for t, n in self._running.items() if n > 0}
+        def _seq(jid: str) -> int:
+            try:
+                return int(jid.rsplit("-", 1)[-1])
+            except ValueError:
+                return 0
+
+        finished = []
+        for jid, rec in sorted(self.queue.records.items(),
+                               key=lambda kv: _seq(kv[0])):
+            if rec.get("status") not in ("done", "failed"):
+                continue
+            if jid in busy or rec.get("tenant") in running:
+                continue
+            d = self.job_dir(jid)
+            if os.path.isdir(d):
+                finished.append(jid)
+        pruned: List[str] = []
+        if self.keep >= 0 and len(finished) > self.keep:
+            for jid in finished[:len(finished) - self.keep]:
+                try:
+                    shutil.rmtree(self.job_dir(jid))
+                except OSError:
+                    continue
+                pruned.append(jid)
+                self.queue.log_event("prune", job_id=jid,
+                                     keep=self.keep)
+                if self.telemetry is not None:
+                    self.telemetry.event("prune", job_id=jid,
+                                         keep=self.keep)
+        return pruned
 
     # -------------------------------------------------------------- drain
 
@@ -424,6 +545,9 @@ class CheckServer:
             t.start()
         for t in threads:
             t.join()
+        # The scheduler is idle here (workers drained or deadline hit):
+        # the retention sweep runs now, never beside live jobs.
+        self.retention_sweep()
         self._write_status(force=True)
         with self._lock:
             results = list(self.results)
@@ -434,6 +558,7 @@ class CheckServer:
         for stats in per_tenant.values():
             stats["verdicts_per_min"] = round(
                 stats["verdicts"] / wall * 60.0, 2)
+        totals = self.costs.totals()
         return {
             "jobs": len(results),
             "completed": len(done),
@@ -441,6 +566,13 @@ class CheckServer:
             "verdicts_per_min": round(len(done) / wall * 60.0, 2),
             "fairness_index": fairness_index(per_tenant),
             "per_tenant": per_tenant,
+            # The cost ledger's view (tpu/tracing.py CostMeter):
+            # per-tenant device-seconds / dispatches / compile split /
+            # cost-per-unique-state, and the aggregate headline the
+            # ledger compare tracks.
+            "costs": self.costs.tenant_summary(),
+            "cost_per_unique": totals.get("cost_per_unique"),
+            "device_secs": totals.get("device_secs"),
             "queue": self.queue.summary(),
             "wall_secs": round(wall, 2),
             "results": results,
@@ -450,6 +582,7 @@ class CheckServer:
 
     def server_status(self) -> dict:
         qs = self.queue.summary()
+        cost_ledger = self.costs.tenant_summary()
         with self._lock:
             pending = self.sched.pending_by_tenant()
             tenants = {}
@@ -463,6 +596,11 @@ class CheckServer:
                     "failed": s["failed"],
                     "rejected": s["rejected"],
                     "budget_spent": round(s["budget_spent"], 3),
+                    # The auditable per-tenant cost ledger (ISSUE 13):
+                    # what the tenant's budget actually bought, from
+                    # COSTS.jsonl — device seconds, dispatches, the
+                    # compile-vs-search split, cost per unique state.
+                    "costs": cost_ledger.get(t),
                 }
             return {
                 "t": "server_status",
@@ -492,6 +630,7 @@ class CheckServer:
 
     def close(self) -> None:
         self.queue.close()
+        self.costs.close()
 
 
 # ------------------------------------------------------- admission child

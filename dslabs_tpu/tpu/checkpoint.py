@@ -124,6 +124,19 @@ def default_status_path(checkpoint_path) -> "Optional[str]":
         "STATUS.json")
 
 
+def default_costs_path(checkpoint_path) -> "Optional[str]":
+    """The cost-ledger convention (tpu/tracing.py ``CostMeter``): an
+    append-only ``COSTS.jsonl`` beside the dump.  The checking service
+    keeps ONE ledger at its root (every job charged into it); a
+    standalone checkpointed run that wants metering uses this per-run
+    location.  ``None`` when no checkpoint is configured."""
+    if not checkpoint_path:
+        return None
+    return os.path.join(
+        os.path.dirname(os.path.abspath(checkpoint_path)),
+        "COSTS.jsonl")
+
+
 def run_dir_layout(checkpoint_path) -> dict:
     """Everything a checkpointed run keeps in its directory — the one
     place the layout is defined (docs/observability.md):
@@ -132,6 +145,7 @@ def run_dir_layout(checkpoint_path) -> dict:
       compile_cache     persistent XLA compile cache (tpu/compile_cache)
       flight_log        telemetry flight recorder (tpu/telemetry.py)
       status            live-monitor STATUS.json (telemetry watch)
+      costs             append-only cost ledger (tpu/tracing.py)
     """
     return {
         "checkpoint": checkpoint_path,
@@ -139,6 +153,7 @@ def run_dir_layout(checkpoint_path) -> dict:
         "compile_cache": default_compile_cache_dir(checkpoint_path),
         "flight_log": default_flight_log(checkpoint_path),
         "status": default_status_path(checkpoint_path),
+        "costs": default_costs_path(checkpoint_path),
     }
 
 

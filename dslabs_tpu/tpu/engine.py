@@ -281,6 +281,12 @@ class SearchOutcome:
     mesh_width: Optional[int] = None
     mesh_shrinks: int = 0
     knob_retries: int = 0
+    # Causal-trace identity (ISSUE 13, tpu/tracing.py): the trace this
+    # verdict belongs to, stamped from the attached telemetry
+    # recorder's context at span emission — how a service verdict, its
+    # COSTS.jsonl record, and its flight log stay joinable after the
+    # run dir is pruned.  None outside any trace.
+    trace_id: Optional[str] = None
 
     @property
     def dropped_states(self) -> int:
@@ -1560,6 +1566,11 @@ class TensorSearch:
                                    resume=resume)
             eng = "device"
         if tel is not None:
+            # Trace stamp at span emission (ISSUE 13): the verdict
+            # carries the recorder's causal-trace identity — a host
+            # string copy, never a device transfer.
+            if out.trace_id is None:
+                out.trace_id = tel.trace_id
             tel.on_outcome(out, engine=eng)
         return out
 

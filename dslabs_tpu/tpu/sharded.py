@@ -1123,6 +1123,15 @@ class ShardedTensorSearch(TensorSearch):
                   f"{type(e).__name__}: {e}", file=sys.stderr)
         secs = time.time() - t0
         self.compile_secs = getattr(self, "compile_secs", 0.0) + secs
+        tel = getattr(self, "_telemetry", None)
+        if tel is not None:
+            # The explicit AOT warm-up as a first-class trace node
+            # (ISSUE 13): the causal timeline shows compile as its own
+            # phase instead of folding it into the first dispatch.
+            # An event, not a span — span counts stay equal to
+            # dispatch counts (the obs-suite parity pin).
+            tel.event("compile", engine="sharded",
+                      secs=round(secs, 4), aot=True)
         return secs
 
     def _prog(self, name, default):
@@ -1655,6 +1664,10 @@ class ShardedTensorSearch(TensorSearch):
             if self._spill_on:
                 self._spill.attach(out)
             if tel is not None:
+                # Trace stamp at span emission (ISSUE 13): host string
+                # copy off the recorder's context, zero device work.
+                if out.trace_id is None:
+                    out.trace_id = tel.trace_id
                 tel.on_outcome(out, engine="sharded")
             if out.dropped and out.dropped >= _DROPPED_WARN():
                 # The BENCH_r03 shape (5.8M beam drops, one flag to

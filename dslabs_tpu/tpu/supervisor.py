@@ -1032,6 +1032,14 @@ class SearchSupervisor:
             out.mesh_width = eff_width if eff_width is not None else 1
             out.mesh_shrinks = self.mesh_shrinks
             out.knob_retries = self.knob_retries
+            # Causal-trace identity (ISSUE 13): a supervised verdict
+            # carries the recorder's trace even when a failover rung
+            # produced it (each rung's engine stamps from the SAME
+            # attached recorder; this is the belt-and-braces copy for
+            # rungs built without one).
+            if (getattr(out, "trace_id", None) is None
+                    and self.telemetry is not None):
+                out.trace_id = self.telemetry.trace_id
             out.retries = self.boundary.retries
             out.failovers = len(self.failures)
             out.resumed_from_depth = getattr(

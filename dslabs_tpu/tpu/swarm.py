@@ -1037,6 +1037,12 @@ class SwarmSearch(TensorSearch):
         t_c = time.time()
         carry, _ = self._round_call(carry, 0)
         self.compile_secs += time.time() - t_c
+        tel = getattr(self, "_telemetry", None)
+        if tel is not None:
+            # Compile as a first-class trace node (ISSUE 13) — an
+            # event, not a span, so span/dispatch parity holds.
+            tel.event("compile", engine="swarm",
+                      secs=round(time.time() - t_c, 4), aot=True)
         t0 = time.time() - prev_elapsed
         stats = None
         self._pd_prev_explored = [0] * self.n_devices
@@ -1164,6 +1170,9 @@ class SwarmSearch(TensorSearch):
                 RuntimeWarning, stacklevel=3)
         tel = getattr(self, "_telemetry", None)
         if tel is not None:
+            # Trace stamp at span emission (ISSUE 13): host-side only.
+            if out.trace_id is None:
+                out.trace_id = tel.trace_id
             tel.on_outcome(out, engine="swarm")
         return out
 

@@ -97,8 +97,9 @@ __all__ = ["Telemetry", "MetricsRegistry", "Counter", "Gauge",
            "Histogram", "read_flight", "tail_records", "build_report",
            "render_report", "render_sites", "skew_metrics",
            "device_memory_stats", "default_status_path", "load_status",
-           "render_watch", "append_ledger", "read_ledger",
-           "compare_ledger", "render_compare", "DISPATCH_SITES", "main"]
+           "render_watch", "watch_frame", "append_ledger",
+           "read_ledger", "compare_ledger", "render_compare",
+           "DISPATCH_SITES", "main"]
 
 # THE canonical dispatch-site registry (ISSUE 10): every tag the
 # engines route through ``TensorSearch._dispatch``, with the static
@@ -398,7 +399,21 @@ class Telemetry:
     def __init__(self, flight_log: Optional[str] = None,
                  ring: Optional[int] = None,
                  engine_hint: Optional[str] = None,
-                 status_path: Optional[str] = None):
+                 status_path: Optional[str] = None,
+                 trace_id: Optional[str] = None,
+                 parent_span: Optional[str] = None):
+        # Causal-trace context (ISSUE 13, tpu/tracing.py): inherited
+        # from env when not given explicitly — the service sets
+        # DSLABS_TRACE_ID/DSLABS_PARENT_SPAN on every warden launch and
+        # the warden forwards them to its children, so a child's
+        # recorder stamps the whole flight log into the submit's causal
+        # tree without any new plumbing at the engines.
+        from dslabs_tpu.tpu import tracing as tracing_mod
+
+        env_trace, env_parent = tracing_mod.current_trace()
+        self.trace_id = trace_id or env_trace
+        self.parent_span = parent_span or env_parent
+        self.span_id = tracing_mod.new_span_id()
         if ring is None:
             try:
                 ring = int(os.environ.get("DSLABS_TELEMETRY_RING",
@@ -427,6 +442,19 @@ class Telemetry:
         self._status_last = 0.0
         self._status: Dict[str, object] = {}
         self._prev_explored: Dict[str, int] = {}
+        # Rate accounting (ISSUE 13 satellite): the cumulative rate is
+        # explored / summed level wall over the WHOLE run; the sliding
+        # window keeps the last DSLABS_RATE_WINDOW (explored-delta,
+        # wall) pairs so a long run's STATUS shows current speed, not
+        # the average over an hour of history.  Per engine — a
+        # failover rung restarts its own series.
+        try:
+            self._rate_window_n = max(1, int(os.environ.get(
+                "DSLABS_RATE_WINDOW", "8") or 8))
+        except ValueError:
+            self._rate_window_n = 8
+        self._level_wall: Dict[str, float] = {}
+        self._rate_window: Dict[str, deque] = {}
         self._open_dispatch: Optional[dict] = None
         self._warned_skew = False
         if flight_log:
@@ -444,7 +472,10 @@ class Telemetry:
                 self.flight_log = None
                 self.status_path = status_path  # only if explicit
         self._write({"t": "meta", "started": round(self._t0, 3),
-                     "pid": os.getpid(), "hint": engine_hint})
+                     "pid": os.getpid(), "hint": engine_hint,
+                     "trace_id": self.trace_id,
+                     "parent_span": self.parent_span,
+                     "span_id": self.span_id})
 
     @classmethod
     def for_checkpoint(cls, checkpoint_path: str, **kw) -> "Telemetry":
@@ -500,6 +531,13 @@ class Telemetry:
             # shows a degraded mesh the moment it shrinks.  Always
             # present (schema-pinned); None until the first feed.
             "mesh_width": None,
+            # Causal-trace identity (ISSUE 13): STATUS.json carries the
+            # same trace context as the flight log, so a live monitor
+            # frame is linkable to the submit that caused the run.
+            # Always present (schema-pinned); None outside a trace.
+            "trace_id": self.trace_id,
+            "parent_span": self.parent_span,
+            "span_id": self.span_id,
             **self._status,
         }
         tmp = self.status_path + ".tmp"
@@ -546,6 +584,8 @@ class Telemetry:
         scale = float(scales.get(site, 1.0))
         start = {"t": "dispatch", "ts": self._ts(), "tag": tag,
                  "i": idx, "depth": depth}
+        if self.trace_id:
+            start["trace"] = self.trace_id
         with self._lock:
             self._write(start)
             self._open_dispatch = start
@@ -570,6 +610,8 @@ class Telemetry:
                     "depth": depth, "wall": round(wall, 6),
                     "retries": retries, "scale": scale,
                     "outcome": outcome}
+            if self.trace_id:
+                span["trace"] = self.trace_id
             with self._lock:
                 self.ring.append(span)
                 self._write(span)
@@ -610,6 +652,8 @@ class Telemetry:
                     "engine": engine, "site": site, "i": idx,
                     "depth": 0, "wall": round(wall, 6), "retries": 0,
                     "scale": 1.0, "outcome": outcome, **fields}
+            if self.trace_id:
+                span["trace"] = self.trace_id
             with self._lock:
                 self.ring.append(span)
                 self._write(span)
@@ -624,6 +668,8 @@ class Telemetry:
         """Recovery/operational event (supervisor retry/failover/rung,
         warden heartbeat/child_death, spill evict/reinject, …)."""
         rec = {"t": "event", "ts": self._ts(), "kind": kind, **fields}
+        if self.trace_id:
+            rec.setdefault("trace", self.trace_id)
         with self._lock:
             self.events.append(rec)
             self._write(rec)
@@ -693,11 +739,23 @@ class Telemetry:
                 self.registry.histogram(
                     f"skew_imbalance.{engine}").observe(
                     float(work.get("imbalance", 1.0)))
-            # Live monitor: per-level rate from the explored delta.
+            # Live monitor: cumulative rate over the whole run PLUS a
+            # sliding-window rate over the last N level records (the
+            # satellite fix: one number for billing-grade averages,
+            # one for "how fast is it going RIGHT NOW").
             explored = int(record.get("explored", 0) or 0)
             delta = explored - self._prev_explored.get(engine, 0)
             self._prev_explored[engine] = explored
             wall = float(record.get("wall", 0.0) or 0.0)
+            wall_total = self._level_wall.get(engine, 0.0) + wall
+            self._level_wall[engine] = wall_total
+            win = self._rate_window.get(engine)
+            if win is None:
+                win = self._rate_window[engine] = deque(
+                    maxlen=self._rate_window_n)
+            win.append((delta, wall))
+            win_d = sum(d for d, _ in win)
+            win_w = sum(w for _, w in win)
             pd = record.get("per_device") or {}
             if pd.get("explored"):
                 # The per-device lanes ARE the live mesh width — a
@@ -708,8 +766,10 @@ class Telemetry:
                 "depth": record.get("depth", 0),
                 "explored": explored,
                 "unique": record.get("unique", 0),
-                "rate_per_min": round(delta / wall * 60.0, 1)
-                if wall > 0 else None,
+                "rate_per_min": round(explored / wall_total * 60.0, 1)
+                if wall_total > 0 else None,
+                "rate_per_min_window": round(win_d / win_w * 60.0, 1)
+                if win_w > 0 else None,
                 "level_wall": wall,
                 "load_factor": record.get("load_factor"),
                 "skew": skew,
@@ -755,6 +815,9 @@ class Telemetry:
                "end_condition": out.end_condition,
                "elapsed_secs": round(float(out.elapsed_secs), 4),
                "compile_secs": round(float(out.compile_secs), 4)}
+        trace = getattr(out, "trace_id", None) or self.trace_id
+        if trace:
+            rec["trace"] = trace
         with self._lock:
             for f in self._OUTCOME_FIELDS:
                 v = int(getattr(out, f, 0) or 0)
@@ -1081,6 +1144,39 @@ def load_status(path: Optional[str]) -> Optional[dict]:
         return None
 
 
+def watch_frame(path: str, now: Optional[float] = None) -> dict:
+    """One machine-readable live-monitor frame (``watch --json``, the
+    satellite's scripting hook): the STATUS snapshot, the staleness
+    verdict (the same >15 s rule the human view flags), and the
+    in-flight dispatch derived from the flight tail's begin markers.
+    Torn/absent artifacts are never fatal — every field degrades to
+    None."""
+    from dslabs_tpu.tpu import tracing as tracing_mod
+
+    now = time.time() if now is None else now
+    st = load_status(_resolve_status(path))
+    age = (now - float(st.get("updated", now))) if st else None
+    open_d = None
+    try:
+        recs, _ = tracing_mod.read_flight_lax(_resolve_flight(path))
+    except (OSError, ValueError, FileNotFoundError):
+        recs = []
+    segs = tracing_mod.segment_flight(recs)
+    if segs:
+        # Only the LAST segment's open dispatch is live state — an
+        # earlier child's kill point belongs to the trace assembler.
+        open_d = segs[-1]["in_flight"]
+    return {
+        "t": "watch", "source": path,
+        "status": st,
+        "age_secs": round(age, 1) if age is not None else None,
+        "stale": bool(st) and age is not None and age > 15,
+        "finished": bool(st and st.get("end_condition")),
+        "in_flight": open_d,
+        "trace_id": (st or {}).get("trace_id"),
+    }
+
+
 def render_watch(path: str, now: Optional[float] = None) -> str:
     """One frame of the live monitor, from the run dir ALONE: the
     atomic STATUS.json (depth / rate / skew / spill / rung) plus the
@@ -1099,12 +1195,17 @@ def render_watch(path: str, now: Optional[float] = None) -> str:
                    f"hint={st.get('hint')} "
                    f"updated {age:.1f}s ago{stale}")
         rate = st.get("rate_per_min")
+        win = st.get("rate_per_min_window")
         out.append(
             f"engine {st.get('engine', '?')}  "
             f"depth {st.get('depth', 0)}  "
             f"unique {st.get('unique', 0)}  "
             f"explored {st.get('explored', 0)}  "
-            f"rate {rate if rate is not None else '?'} states/min")
+            f"rate {rate if rate is not None else '?'} states/min "
+            f"(window {win if win is not None else '?'})")
+        if st.get("trace_id"):
+            out.append(f"trace: {st['trace_id']} "
+                       f"(parent span {st.get('parent_span') or '-'})")
         if st.get("mesh_width"):
             out.append(f"mesh width: {st['mesh_width']} device(s)")
         if st.get("resilience"):
@@ -1354,6 +1455,69 @@ def compare_ledger(records: List[dict],
         cmp["fairness"]["fairness_index"] = entry
         if lv > best * (1.0 + threshold):
             cmp["regressions"].append(entry)
+    # Per-phase compile-time creep (ISSUE 13 satellite): each phase's
+    # measured compile_secs vs the BEST (fastest) prior — compile
+    # regressions are invisible in states/min (the measured window
+    # excludes them by design), so they get their own guard with the
+    # same threshold / rc-1 discipline.  Sub-second bests are skipped:
+    # a warm-cache 0.2s -> 0.5s jitter is noise, not creep.
+    cmp["compile"] = {}
+
+    def _compile_value(rec, phase) -> Optional[float]:
+        p = rec.get(phase)
+        if not isinstance(p, dict):
+            return None
+        try:
+            v = float(p.get("compile_secs"))
+        except (TypeError, ValueError):
+            return None
+        return v if v >= 0 else None
+
+    floor = _env_float("DSLABS_COMPILE_REGRESS_FLOOR", 1.0)
+    for phase in _LEDGER_PHASES:
+        lv = _compile_value(latest, phase)
+        if lv is None:
+            continue
+        priors_c = [v for v in (_compile_value(r, phase)
+                                for r in prior) if v is not None]
+        if not priors_c:
+            continue
+        best = min(priors_c)
+        entry = {"phase": f"compile:{phase}", "latest": round(lv, 1),
+                 "best_prior": round(best, 1),
+                 "delta_pct": round((lv - best) / best * 100, 1)
+                 if best > 0 else 0.0}
+        cmp["compile"][phase] = entry
+        if (lv > max(best, floor) * (1.0 + threshold)
+                and lv - best > floor):
+            cmp["regressions"].append(entry)
+    # Cost-per-unique-state creep (ISSUE 13): the service phase's
+    # aggregate device-seconds per unique state vs the BEST (cheapest)
+    # prior — a tenant's billed budget buying fewer states is a
+    # regression even when verdicts/min holds (e.g. retries burning
+    # device time the verdict count hides).
+    cmp["cost"] = {}
+
+    def _cost(rec):
+        s = rec.get("service")
+        if not isinstance(s, dict):
+            return None
+        try:
+            v = float(s.get("cost_per_unique"))
+        except (TypeError, ValueError):
+            return None
+        return v if v > 0 else None
+
+    lv = _cost(latest)
+    priors_k = [v for v in (_cost(r) for r in prior) if v is not None]
+    if lv is not None and priors_k:
+        best = min(priors_k)
+        entry = {"phase": "service:cost_per_unique",
+                 "latest": lv, "best_prior": best,
+                 "delta_pct": round((lv - best) / best * 100, 1)}
+        cmp["cost"]["cost_per_unique"] = entry
+        if lv > best * (1.0 + threshold):
+            cmp["regressions"].append(entry)
     return cmp
 
 
@@ -1385,6 +1549,14 @@ def render_compare(cmp: dict, source: str = "") -> str:
         out.append(f"fairness {c:16s} latest={e['latest']} "
                    f"prior_best={e['best_prior']} "
                    f"({e['delta_pct']:+.1f}%)")
+    for c, e in sorted(cmp.get("compile", {}).items()):
+        out.append(f"compile {c:17s} latest={e['latest']}s "
+                   f"prior_best={e['best_prior']}s "
+                   f"({e['delta_pct']:+.1f}%)")
+    for c, e in sorted(cmp.get("cost", {}).items()):
+        out.append(f"cost {c:20s} latest={e['latest']} "
+                   f"prior_best={e['best_prior']} "
+                   f"({e['delta_pct']:+.1f}%)")
     for e in cmp["regressions"]:
         out.append(f"REGRESSION: phase={e['phase']} "
                    f"latest={e['latest']} vs best={e['best_prior']} "
@@ -1402,7 +1574,10 @@ def render_compare(cmp: dict, source: str = "") -> str:
 _USAGE = """usage: python -m dslabs_tpu.tpu.telemetry <command> ...
 
   report  <run-dir-or-flight-log> [--json]   render a run report
-  watch   <run-dir> [--interval S] [--once]  live monitor of any run
+  watch   <run-dir> [--interval S] [--once] [--json]
+                                             live monitor of any run
+  trace   <run-dir|server-dir> [--job ID] [--json] [--perfetto F]
+                                             assemble the causal trace
   compare <ledger.jsonl> [--threshold F]     diff latest vs best prior
 """
 
@@ -1411,11 +1586,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     import sys
 
     argv = list(sys.argv[1:] if argv is None else argv)
-    if len(argv) < 2 or argv[0] not in ("report", "watch", "compare"):
+    if len(argv) < 2 or argv[0] not in ("report", "watch", "compare",
+                                        "trace"):
         print(_USAGE, file=sys.stderr)
         return 2
     cmd, path = argv[0], argv[1]
     flags = argv[2:]
+
+    if cmd == "trace":
+        # The causal-trace assembler (ISSUE 13) lives in tpu/tracing.py
+        # — journal + SERVER_STATUS + per-job flight logs, from disk
+        # alone, rendered or exported as Perfetto trace-event JSON.
+        from dslabs_tpu.tpu import tracing as tracing_mod
+
+        return tracing_mod.main([path] + flags)
 
     if cmd == "report":
         flight = _resolve_flight(path)
@@ -1438,8 +1622,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 1 if cmp["regressions"] else 0
 
     # watch: redraw until interrupted (--once = one frame, for smoke
-    # tests and scripts).  Reads only the run dir — the run itself can
-    # be any process, a warden child or a bench phase included.
+    # tests and scripts; --json = one machine-readable frame with the
+    # staleness verdict, the satellite's scripting hook).  Reads only
+    # the run dir — the run itself can be any process, a warden child
+    # or a bench phase included.
+    if "--json" in flags:
+        print(json.dumps(watch_frame(path)))
+        return 0
     interval = 2.0
     if "--interval" in flags:
         interval = float(flags[flags.index("--interval") + 1])

@@ -117,7 +117,7 @@ _SCALAR_FIELDS = (
     "dropped", "samples", "visited_overflow", "retries", "failovers",
     "resumed_from_depth", "engine", "levels", "compile_secs",
     "child_restarts", "killed_dispatches", "abandoned_threads",
-    "mesh_width", "mesh_shrinks", "knob_retries")
+    "mesh_width", "mesh_shrinks", "knob_retries", "trace_id")
 
 
 def outcome_to_dict(out) -> dict:
@@ -271,7 +271,9 @@ class Warden:
                  env: Optional[dict] = None,
                  extra_sys_path: Optional[List[str]] = None,
                  telemetry=None,
-                 elastic: bool = False):
+                 elastic: bool = False,
+                 trace_id: Optional[str] = None,
+                 parent_span: Optional[str] = None):
         # Unified telemetry (tpu/telemetry.py): child heartbeats from
         # the pipe protocol are re-emitted as parent-side telemetry
         # events, so the flight log shows the child's dispatch-level
@@ -313,6 +315,17 @@ class Warden:
         # map (tpu/supervisor.py expand_ladder — one expansion rule for
         # both modes).
         self.elastic = bool(elastic)
+        # Causal-trace propagation (ISSUE 13, tpu/tracing.py): every
+        # child gets DSLABS_TRACE_ID/DSLABS_PARENT_SPAN in its env, so
+        # its run-dir telemetry recorder stamps the whole flight log
+        # into the submitting trace's causal tree.  Defaults inherit
+        # this process's own trace context — a warden inside a traced
+        # service forwards the trace with no extra plumbing.
+        from dslabs_tpu.tpu import tracing as tracing_mod
+
+        env_trace, env_parent = tracing_mod.current_trace()
+        self.trace_id = trace_id or env_trace
+        self.parent_span = parent_span or env_parent
         self.mesh_shrinks = 0
         self.failures: List[EngineFailure] = []
         self.deaths: List[ChildDeath] = []
@@ -369,6 +382,12 @@ class Warden:
         if spec["force_cpu"]:
             env["JAX_PLATFORMS"] = "cpu"
         env.update(self.env)
+        # Trace propagation AFTER self.env so explicit warden-level
+        # trace identity wins over whatever a caller's env carried.
+        from dslabs_tpu.tpu import tracing as tracing_mod
+
+        env.update(tracing_mod.child_trace_env(self.trace_id,
+                                               self.parent_span))
         return env
 
     def _run_child(self, rung: str, resume: bool,

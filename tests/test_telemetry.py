@@ -145,6 +145,25 @@ def test_overhead_guard_no_added_dispatches_or_transfers(
         (o1.unique_states, o1.end_condition)
     assert (tmp_path / "dev" / "STATUS.json").exists()
 
+    # ISSUE 13 extension: causal tracing ENABLED (trace context in the
+    # env, trace fields on every span) is bit-identical too — the
+    # trace discipline is record fields only, never device work.
+    monkeypatch.setenv("DSLABS_TRACE_ID", "cafe0123cafe0123")
+    monkeypatch.setenv("DSLABS_PARENT_SPAN", "job-x:a1")
+    tel_tr = full_tel("dev-traced")
+    assert tel_tr.trace_id == "cafe0123cafe0123"
+    ct, gt, ot = run_device(tel_tr)
+    assert ct == c0, "tracing changed the dispatch schedule"
+    assert gt == g0, "tracing added device->host transfers"
+    assert (ot.unique_states, ot.end_condition) == \
+        (o0.unique_states, o0.end_condition)
+    assert ot.trace_id == "cafe0123cafe0123"
+    spans_tr = [r for r in tel_tr.ring if r["t"] == "span"]
+    assert spans_tr and all(s.get("trace") == "cafe0123cafe0123"
+                            for s in spans_tr)
+    monkeypatch.delenv("DSLABS_TRACE_ID")
+    monkeypatch.delenv("DSLABS_PARENT_SPAN")
+
     # ISSUE 10: DSLABS_SANITIZE=0 is bit-identical to unset — same
     # dispatch schedule, same transfer count, and no sanitizer events
     # in the recorder.
@@ -173,6 +192,13 @@ def test_overhead_guard_no_added_dispatches_or_transfers(
     assert cs0 == cs1, "telemetry changed the sharded dispatch schedule"
     assert gs0 == gs1, "telemetry added sharded device->host transfers"
     assert (tmp_path / "sharded" / "STATUS.json").exists()
+
+    # ISSUE 13: tracing enabled, sharded engine — still bit-identical.
+    monkeypatch.setenv("DSLABS_TRACE_ID", "cafe0123cafe0123")
+    cst, gst = run_sharded(full_tel("sharded-traced"))
+    assert cst == cs0, "tracing changed the sharded dispatch schedule"
+    assert gst == gs0, "tracing added sharded device->host transfers"
+    monkeypatch.delenv("DSLABS_TRACE_ID")
 
 
 # ------------------------------------------------------- flight log IO
@@ -366,9 +392,15 @@ def test_status_json_schema_and_watch_finished_run(tmp_path, capsys):
     st = json.loads((tmp_path / "STATUS.json").read_text())
     for key in ("t", "pid", "updated", "uptime", "spans", "levels",
                 "last_span", "in_flight", "flight_log", "engine",
-                "depth", "explored", "unique", "rate_per_min", "skew",
-                "per_device", "end_condition", "mesh_width"):
+                "depth", "explored", "unique", "rate_per_min",
+                "rate_per_min_window", "skew", "per_device",
+                "end_condition", "mesh_width", "trace_id",
+                "parent_span", "span_id"):
         assert key in st, f"STATUS.json missing {key!r}"
+    # ISSUE 13 satellite: BOTH rates are real numbers — cumulative
+    # over the whole run, sliding-window over the last N levels.
+    assert st["rate_per_min"] is not None
+    assert st["rate_per_min_window"] is not None
     assert st["t"] == "status"
     assert st["pid"] == os.getpid()
     assert st["engine"] == "sharded"

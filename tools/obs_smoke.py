@@ -6,7 +6,11 @@ the end-to-end CLI paths the pytest tier exercises through the API —
    ``telemetry report`` (the watch-on-a-finished-run step);
 2. build a parity ledger (no flag expected, rc 0) and an
    injected-slow-run ledger (regression flagged, rc 1) and diff both
-   with ``telemetry compare`` (the ledger-compare step).
+   with ``telemetry compare`` (the ledger-compare step);
+3. (ISSUE 13) run the same search INSIDE a trace context and assemble
+   it with ``telemetry trace`` — the causal tree, the trace id on
+   every span, ``watch --json``, and the Perfetto export
+   (the trace-assembler step).
 
 Exits nonzero on any mismatch; prints one OK line per step."""
 
@@ -76,7 +80,34 @@ def main() -> int:
     cmp = tel_mod.compare_ledger(tel_mod.read_ledger(slow))
     assert any(e["phase"] == "strict" for e in cmp["regressions"]), cmp
     print("obs-smoke: ledger compare (parity + injected regression) OK")
-    print(json.dumps({"obs_smoke": "ok", "run_dir": run_dir}))
+
+    # -- trace assembler (ISSUE 13): the same run inside a trace
+    # context assembles into a causal tree from the run dir alone.
+    from dslabs_tpu.tpu import tracing
+
+    trace_dir = tempfile.mkdtemp(prefix="dslabs_obs_smoke_trace_")
+    trace_id = tracing.mint_trace_id()
+    os.environ[tracing.TRACE_ENV] = trace_id
+    try:
+        run_search(trace_dir)
+    finally:
+        os.environ.pop(tracing.TRACE_ENV, None)
+    rc = tel_mod.main(["trace", trace_dir])
+    assert rc == 0, rc
+    tr = tracing.assemble(trace_dir)
+    (j,) = tr["jobs"]
+    assert j["trace_id"] == trace_id, j
+    ids = {n["span_id"] for n in j["nodes"]}
+    assert all(n["parent"] is None or n["parent"] in ids
+               for n in j["nodes"]), "broken parent chain"
+    assert j["phases"]["search_secs"] > 0, j["phases"]
+    frame = tel_mod.watch_frame(trace_dir)
+    assert frame["trace_id"] == trace_id and frame["finished"], frame
+    pf = tracing.to_perfetto(tr)
+    assert pf["traceEvents"], "perfetto export empty"
+    print("obs-smoke: trace assembler (causal tree + perfetto) OK")
+    print(json.dumps({"obs_smoke": "ok", "run_dir": run_dir,
+                      "trace_dir": trace_dir, "trace_id": trace_id}))
     return 0
 
 
