@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast lint analysis-smoke perf-smoke fault-smoke swarm-smoke capacity-smoke obs-smoke chaos-smoke service-smoke trace-smoke mesh-smoke lab0 lab1 lab2 lab3 lab4 bench dryrun handout clean
+.PHONY: test test-fast lint analysis-smoke perf-smoke fault-smoke swarm-smoke capacity-smoke obs-smoke chaos-smoke service-smoke trace-smoke mesh-smoke lanes-smoke lab0 lab1 lab2 lab3 lab4 bench dryrun handout clean
 
 test:            ## full acceptance + parity suite
 	$(PY) -m pytest tests/ -q
@@ -154,6 +154,24 @@ trace-smoke:     ## causal tracing + cost-ledger suite (assembler / COSTS / rete
 # field guide.
 mesh-smoke:      ## owner-sharded superstep width-parity matrix + Pallas kernel suite on CPU
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m mesh -p no:cacheprovider
+
+# lanes-smoke = the batched-job-lanes suite (tests/test_lanes.py,
+# ISSUE 14): lane-vs-solo EXACT parity (unique/explored/verdict
+# bit-identical at L in {1, 2, 4}, pingpong + lab1, strict + beam +
+# mixed per-lane depth limits), continuous-batching swap-in parity
+# with zero recompiles, the dispatches-per-job amortisation pin
+# (4-lane batch <= 0.5x the 4-solo dispatch count), SIGKILL-mid-batch
+# per-lane checkpoint resume through the LaneBatchWarden child,
+# poisoned-lane eviction leaving neighbors bit-exact, per-tenant
+# COSTS sums across a batched drain == the solo drain's, the lane
+# compare guards, and the solo-path overhead guard (lanes off = solo
+# dispatch/device_get counts untouched) — all CPU, no TPU needed.
+# PLUS the lanes leg of tools/obs_smoke.py (bench phase schema +
+# compare guards end-to-end).  docs/service.md "Batched job lanes"
+# is the field guide.
+lanes-smoke:     ## batched job lanes: parity matrix + continuous batching + resume + cost split on CPU
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m lanes -p no:cacheprovider
+	JAX_PLATFORMS=cpu $(PY) tools/obs_smoke.py
 
 dryrun:          ## multi-chip sharding dry run on a virtual CPU mesh
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

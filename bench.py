@@ -88,6 +88,7 @@ SWARM_CAP_SECS = 150.0       # swarm-explorer phase (ISSUE 5)
 SPILL_CAP_SECS = 120.0       # capacity-ladder phase (ISSUE 6)
 SERVICE_CAP_SECS = 120.0     # multi-tenant service phase (ISSUE 11)
 MESH_CAP_SECS = 150.0        # 8-device mesh headline phase (ISSUE 12)
+LANES_CAP_SECS = 150.0       # batched-job-lanes phase (ISSUE 14)
 # Parent backstop beyond the child's budget.  Generous on purpose: the
 # child's time checks are level-granular (a slow level can overrun
 # max_secs by ~30 s, sharded.py round-3 note), the strict child floors
@@ -815,6 +816,85 @@ def _run_service(budget_secs: float) -> dict:
     }
 
 
+def _run_lanes(budget_secs: float) -> dict:
+    """Batched job lanes phase (ISSUE 14, tpu/lanes.py): FOUR tenants
+    each submit one identical small exhaustive job, drained twice —
+    solo (lanes off, the 4-solo baseline) and as one 4-lane batch —
+    and the phase reports aggregate states/min plus
+    **dispatches-per-job** for both, the amortisation headline the
+    ledger's ``service:dispatches_per_job`` / ``lanes:occupancy``
+    compare guards track (regression => rc 1).  Verdicts are asserted
+    bit-identical between the two drains (lane parity is a bench
+    invariant, not just a test).  Same always-reports guarantees as
+    every phase."""
+    import tempfile
+
+    _persistent_cache()
+
+    from dslabs_tpu.service import CheckServer
+
+    t_phase = time.time()
+    tenants = ("alice", "bob", "carol", "dave")
+    cache_dir = os.environ.get("DSLABS_COMPILE_CACHE") or (
+        "/tmp/jaxcache-cpu" if os.environ.get("DSLABS_FORCE_CPU")
+        else "/tmp/jaxcache")
+
+    def _drain(lanes: int) -> dict:
+        root = tempfile.mkdtemp(prefix=f"lanes{lanes}-",
+                                dir=_rundir())
+        srv = CheckServer(
+            root, workers=1, queue_cap=len(tenants) + 4,
+            elastic=False, admission=False, lanes=lanes,
+            env={"DSLABS_COMPILE_CACHE": cache_dir})
+        for t in tenants:
+            srv.submit(
+                factory="dslabs_tpu.tpu.protocols.pingpong:"
+                        "make_exhaustive_pingpong",
+                factory_kwargs={"workload_size": 2}, tenant=t,
+                chunk=64, frontier_cap=1 << 8, visited_cap=1 << 12,
+                max_secs=30.0)
+        left = budget_secs - (time.time() - t_phase) - 10
+        summary = srv.drain(max_secs=max(20.0, left / 2))
+        srv.close()
+        return summary
+
+    _hb("lanes: 4-solo baseline drain")
+    solo = _drain(0)
+    _hb(f"lanes: solo dpj={solo.get('dispatches_per_job')}; "
+        "4-lane batched drain")
+    lane = _drain(4)
+    wall = max(lane.get("wall_secs", 0.0), 1e-9)
+    explored = sum(int(r.get("explored", 0) or 0)
+                   for r in lane.get("results", ()))
+    key = ("tenant", "end", "unique", "explored", "depth")
+    sv = sorted(tuple(r.get(k) for k in key)
+                for r in solo.get("results", ()))
+    lv = sorted(tuple(r.get(k) for k in key)
+                for r in lane.get("results", ()))
+    dpj = lane.get("dispatches_per_job")
+    solo_dpj = solo.get("dispatches_per_job")
+    return {
+        # aggregate throughput of the batched drain — the phase value
+        # the ledger tracks alongside the amortisation guards.
+        "value": round(explored / wall * 60.0, 1),
+        "jobs": lane.get("jobs"),
+        "completed": lane.get("completed"),
+        "failed": lane.get("failed"),
+        "lanes": 4,
+        "dispatches_per_job": dpj,
+        "solo_dispatches_per_job": solo_dpj,
+        "dpj_ratio": (round(dpj / solo_dpj, 3)
+                      if dpj and solo_dpj else None),
+        "occupancy": (lane.get("lanes") or {}).get("mean_occupancy"),
+        "swaps": (lane.get("lanes") or {}).get("swaps"),
+        "evicted": (lane.get("lanes") or {}).get("evicted"),
+        "verdict_parity": sv == lv,
+        "fairness_index": lane.get("fairness_index"),
+        "cost_per_unique": lane.get("cost_per_unique"),
+        "total_secs": round(time.time() - t_phase, 1),
+    }
+
+
 # ----------------------------------------------------------------- parent
 
 _CURRENT_CHILD = None     # live phase Popen, killed by the signal handler
@@ -1166,6 +1246,13 @@ def main() -> None:
                 silence=PHASE_SILENCE_SECS)
             if svc is not None:
                 result["service"] = svc
+        if _remaining() > 75:
+            lanes_res, _lanes_err = _sub(
+                ["--lanes", str(min(120.0, _remaining() - 15))],
+                min(120.0, _remaining() - 10), "lanes-cpu",
+                silence=PHASE_SILENCE_SECS)
+            if lanes_res is not None:
+                result["lanes"] = lanes_res
         _emit(result)
         return
 
@@ -1292,6 +1379,22 @@ def main() -> None:
     else:
         result["service_error"] = "skipped: deadline nearly exhausted"
 
+    # ---- phase 5.6: batched job lanes (ISSUE 14) — aggregate
+    # states/min and dispatches-per-job for a 4-lane batch vs the
+    # 4-solo baseline; the ledger compare guards amortisation
+    # (service:dispatches_per_job rise / lanes:occupancy drop = rc 1).
+    # Never the headline; skipped rather than raced near the deadline.
+    budget = min(LANES_CAP_SECS, _remaining() - KILL_SLACK_SECS - 10)
+    if budget > 45:
+        lanes_res, lanes_err = _sub(["--lanes", str(budget)], budget,
+                                    "lanes", silence=PHASE_SILENCE_SECS)
+        if lanes_res is not None:
+            result["lanes"] = lanes_res
+        else:
+            result["lanes_error"] = lanes_err
+    else:
+        result["lanes_error"] = "skipped: deadline nearly exhausted"
+
     # ---- phase 6: the soundness sanitizer (ISSUE 10) — findings per
     # leg + waived count off `python -m dslabs_tpu.analysis all` in a
     # CPU-pinned child (static: lowers, never compiles or dispatches).
@@ -1344,6 +1447,11 @@ if __name__ == "__main__":
         budget = (float(sys.argv[2]) if len(sys.argv) > 2
                   else SERVICE_CAP_SECS)
         print(json.dumps(_run_service(budget)))
+        sys.exit(0)
+    if len(sys.argv) >= 2 and sys.argv[1] == "--lanes":
+        budget = (float(sys.argv[2]) if len(sys.argv) > 2
+                  else LANES_CAP_SECS)
+        print(json.dumps(_run_lanes(budget)))
         sys.exit(0)
     if len(sys.argv) >= 2 and sys.argv[1] == "--mesh":
         # The 8-wide mesh needs 8 devices SOMEWHERE: force the host

@@ -245,6 +245,8 @@ def build_audit_engines(mesh_devices: int = 2,
     from dslabs_tpu.tpu.protocols.pingpong import make_pingpong_protocol
     from dslabs_tpu.tpu.sharded import ShardedTensorSearch, make_mesh
 
+    from dslabs_tpu.tpu.lanes import LaneSearch
+
     proto = make_pingpong_protocol(workload_size=2)
     engines = [
         TensorSearch(proto, max_depth=8, frontier_cap=1 << 8,
@@ -252,6 +254,11 @@ def build_audit_engines(mesh_devices: int = 2,
         ShardedTensorSearch(proto, make_mesh(mesh_devices),
                             chunk_per_device=16, frontier_cap=1 << 8,
                             visited_cap=1 << 10, max_depth=8),
+        # Batched job lanes (ISSUE 14): the lane superstep is the
+        # multi-tenant hot path — audited like every other engine so
+        # `analysis all` cannot silently skip it.
+        LaneSearch(proto, n_lanes=2, frontier_cap=1 << 8,
+                   visited_cap=1 << 10),
     ]
     if with_spill:
         from dslabs_tpu.tpu.spill import spill_manager_for_audit

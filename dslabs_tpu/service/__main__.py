@@ -55,6 +55,10 @@ def _parser() -> argparse.ArgumentParser:
     d.add_argument("--workers", type=int, default=None)
     d.add_argument("--max-secs", type=float, default=None)
     d.add_argument("--no-admission", action="store_true")
+    d.add_argument("--lanes", type=int, default=None,
+                   help="batched job lanes: pack up to N compatible "
+                        "jobs into one compiled program "
+                        "(DSLABS_LANES; 0/1 = off)")
     d.add_argument("--full", action="store_true",
                    help="include per-job results in the JSON line")
     return ap
@@ -107,7 +111,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     # drain
     srv = CheckServer(args.root, workers=args.workers,
-                      admission=not args.no_admission)
+                      admission=not args.no_admission,
+                      lanes=args.lanes)
     try:
         summary = srv.drain(max_secs=args.max_secs)
     finally:

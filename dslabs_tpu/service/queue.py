@@ -82,6 +82,10 @@ class Job:
     # applied on the FIRST scheduler attempt, so a retry models the
     # environment condition clearing.
     fault: Optional[dict] = None
+    # Batched-lane opt-out (ISSUE 14, tpu/lanes.py): set when a
+    # poisoned lane evicts the job to a solo retry — the lane packer
+    # (lanes.job_signature) reads it as "never batch this again".
+    solo: bool = False
     submitted_at: float = 0.0
     # Causal-trace identity (ISSUE 13, tpu/tracing.py): minted at
     # submit, persisted by the journal, stamped on every journal event

@@ -182,6 +182,47 @@ class DeficitRoundRobin:
                 self._deficit[t] += self.quantum
         return None
 
+    def pick_batch(self, running: Dict[str, int], signature_of,
+                   max_jobs: int) -> List[Job]:
+        """One LANE-BATCH pick (ISSUE 14, tpu/lanes.py): the normal
+        DRR pick seeds the batch, then further picks join only when
+        ``signature_of`` matches the seed's lane signature — quota and
+        deficit semantics are EXACTLY the solo pick's (each joining
+        job is a real DRR pick against the tentative running counts,
+        so a tenant's lane count obeys its quota and its deficit is
+        charged per job).  Non-matching picks are restored to the
+        FRONT of their tenant queues with their deficit refunded —
+        the batch fill never reorders or starves a neighbor."""
+        job = self.pick(running)
+        if job is None:
+            return []
+        batch = [job]
+        sig = signature_of(job)
+        if sig is None or max_jobs <= 1:
+            return batch
+        run2 = dict(running)
+        run2[job.tenant] = run2.get(job.tenant, 0) + 1
+        skipped: List[Job] = []
+        while len(batch) < max_jobs and len(skipped) < 2 * max_jobs:
+            nxt = self.pick(run2)
+            if nxt is None:
+                break
+            if signature_of(nxt) == sig:
+                batch.append(nxt)
+                run2[nxt.tenant] = run2.get(nxt.tenant, 0) + 1
+            else:
+                skipped.append(nxt)
+        for j in reversed(skipped):
+            q = self._queues.get(j.tenant)
+            if q is None:
+                q = self._queues[j.tenant] = deque()
+                self._deficit.setdefault(j.tenant, 0.0)
+                self._order.append(j.tenant)
+            q.appendleft(j)
+            self._deficit[j.tenant] = (self._deficit.get(j.tenant, 0.0)
+                                       + j.budget_units)
+        return batch
+
 
 def fairness_index(per_tenant: Dict[str, dict]) -> float:
     """max/mean of per-tenant verdicts-per-budget — the metric the

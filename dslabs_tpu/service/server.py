@@ -41,6 +41,7 @@ admission child half, mirroring tpu/warden.py's parent/child split.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import subprocess
@@ -156,6 +157,8 @@ class CheckServer:
                  extra_sys_path: Optional[List[str]] = None,
                  elastic: bool = True,
                  keep: Optional[int] = None,
+                 lanes: Optional[int] = None,
+                 lane_swap: Optional[bool] = None,
                  telemetry=None):
         self.root = os.path.abspath(root)
         os.makedirs(self.root, exist_ok=True)
@@ -192,6 +195,22 @@ class CheckServer:
             quota=(quota if quota is not None
                    else _env_int("DSLABS_SERVICE_QUOTA", 1)),
             quotas=quotas)
+        # Batched job lanes (ISSUE 14, tpu/lanes.py): with lanes >= 2
+        # the scheduler packs compatible queued jobs (same lane
+        # signature, quotas preserved) into ONE lane-batch child — N
+        # searches advanced by one compiled program, dispatch cost
+        # amortised across tenants.  Default OFF (DSLABS_LANES=0): the
+        # solo path stays byte-identical for existing callers.
+        from dslabs_tpu.tpu import lanes as lanes_mod
+
+        self.lanes = (int(lanes) if lanes is not None
+                      else lanes_mod.lanes_enabled())
+        self.lane_swap = (bool(lane_swap) if lane_swap is not None
+                          else lanes_mod.lane_swap_enabled())
+        self.lane_stats = {
+            "batches": 0, "jobs": 0, "swaps": 0, "evicted": 0,
+            "occupancy_sum": 0.0, "by_signature": {}}
+        self._lane_seq = 0
         self.status_path = os.path.join(self.root, SERVER_STATUS_NAME)
         self._lock = threading.Lock()
         self._running: Dict[str, int] = {}
@@ -430,6 +449,130 @@ class CheckServer:
             self._charge(verdict, rd)
             return verdict
 
+    def run_job_batch(self, jobs: List["Job"]) -> List[dict]:
+        """Run a lane-compatible job group as ONE lane-batch warden
+        child (ISSUE 14, tpu/lanes.py): every job keeps its own run
+        dir + checkpoint (SIGKILL mid-batch resumes each lane from its
+        own dump), continuous batching refills drained lanes from the
+        group, and a poisoned lane is EVICTED to a solo retry
+        (re-queued with ``solo=True``) — it never burns a lane-mate's
+        verdict.  Returns the verdicts/failures that LANDED; evicted
+        jobs return to the scheduler instead."""
+        from dslabs_tpu.tpu.lanes import LaneBatchWarden, job_signature
+
+        with self._lock:
+            self._lane_seq += 1
+            batch_id = f"batch-{self._lane_seq:05d}"
+        bdir = os.path.join(self.root, "lanes", batch_id)
+        os.makedirs(bdir, exist_ok=True)
+        first = jobs[0]
+        lane_jobs = []
+        for job in jobs:
+            rd = self.job_dir(job.job_id)
+            os.makedirs(rd, exist_ok=True)
+            lane_jobs.append({
+                "job_id": job.job_id,
+                "max_depth": job.max_depth,
+                "max_secs": job.max_secs,
+                "checkpoint_path": os.path.join(rd, "ckpt.npz"),
+                "checkpoint_every": 1,
+                "trace_id": job.trace_id})
+            self.queue.mark_started(job.job_id, 1)
+        n_lanes = min(self.lanes, len(jobs))
+        # The journal join the trace assembler + packing stats read:
+        # which jobs shared which batch, and where its flight log is.
+        self.queue.log_event(
+            "lane_batch", batch=batch_id,
+            jobs=[j.job_id for j in jobs], lanes=n_lanes,
+            run_dir=bdir)
+        t0 = time.time()
+        w = LaneBatchWarden(
+            factory=first.factory,
+            factory_kwargs=first.factory_kwargs,
+            transform=first.transform,
+            jobs=lane_jobs, n_lanes=n_lanes,
+            strict=first.strict, chunk=first.chunk,
+            frontier_cap=first.frontier_cap,
+            visited_cap=first.visited_cap,
+            run_dir=bdir, swap=self.lane_swap,
+            env=dict(self.env),
+            extra_sys_path=self.extra_sys_path,
+            telemetry=self.telemetry)
+        try:
+            res = w.run()
+        except BaseException as e:  # noqa: BLE001 — structured, never silent
+            from dslabs_tpu.tpu.lanes import LaneBatchResult
+
+            res = LaneBatchResult(
+                {}, {j.job_id: f"batch:error: {type(e).__name__}: "
+                     f"{e}"[:300] for j in jobs})
+        by_id = {j.job_id: j for j in jobs}
+        elapsed = round(time.time() - t0, 2)
+        bflight = os.path.join(bdir, "flight.jsonl")
+        results: List[dict] = []
+        for jid, out in res.outcomes.items():
+            job = by_id[jid]
+            verdict = {
+                "job_id": jid, "tenant": job.tenant,
+                "trace_id": job.trace_id,
+                "budget_units": job.budget_units,
+                "status": "done",
+                "end": out.end_condition,
+                "unique": out.unique_states,
+                "explored": out.states_explored,
+                "depth": out.depth,
+                "engine": "lanes",
+                "attempts": 1,
+                "failovers": 0,
+                "child_restarts": out.child_restarts,
+                "knob_shrinks": 0, "rung_steps": 0,
+                "resumed_from_depth": out.resumed_from_depth,
+                "degraded": out.child_restarts > 0,
+                "deaths": [{"rung": "lanes", "kind": d["kind"],
+                            "detail": d["detail"][:200]}
+                           for d in w.deaths],
+                "run_dir": self.job_dir(jid),
+                "lane_batch": batch_id,
+                "lane": out.lane,
+                "lanes": out.lane_width,
+                "lane_share": out.lane_share,
+                "elapsed_secs": elapsed,
+            }
+            self.queue.mark_done(jid, {
+                "end": out.end_condition, "unique": out.unique_states,
+                "explored": out.states_explored, "depth": out.depth,
+                "attempts": 1, "degraded": verdict["degraded"],
+                "lane_batch": batch_id})
+            # The COSTS charge reads the BATCH flight log scaled by
+            # the lane's share — shares sum to 1.0, so the shared
+            # dispatch stream is billed exactly once.
+            try:
+                self.costs.charge(verdict, bflight)
+            except Exception:  # noqa: BLE001 — accounting is best-effort
+                pass
+            results.append(verdict)
+        requeued = []
+        for jid, err in res.errors.items():
+            job = by_id[jid]
+            self.queue.log_event("lane_evicted", job_id=jid,
+                                 batch=batch_id, error=err[:200])
+            requeued.append(dataclasses.replace(job, solo=True))
+        with self._lock:
+            for j in requeued:
+                self.sched.push(j)
+            ls = self.lane_stats
+            ls["batches"] += 1
+            ls["jobs"] += len(jobs)
+            ls["swaps"] += res.swaps
+            ls["evicted"] += len(res.errors)
+            ls["occupancy_sum"] += res.occupancy
+            sig = job_signature(first) or "?"
+            per = ls["by_signature"].setdefault(
+                sig, {"batches": 0, "jobs": 0})
+            per["batches"] += 1
+            per["jobs"] += len(jobs)
+        return results
+
     def _charge(self, verdict: dict, run_dir: str) -> None:
         """Feed the cost meter (never fatal — accounting must not take
         a verdict down): the verdict's exact counters + the run dir's
@@ -486,6 +629,22 @@ class CheckServer:
                 if self.telemetry is not None:
                     self.telemetry.event("prune", job_id=jid,
                                          keep=self.keep)
+        # Lane-batch run dirs (ISSUE 14) age out under the same knob:
+        # the sweep runs at scheduler idle, so no batch child is live;
+        # the journal's lane_batch events (the trace join) survive.
+        lanes_root = os.path.join(self.root, "lanes")
+        if self.keep >= 0 and os.path.isdir(lanes_root):
+            try:
+                batches = sorted(os.listdir(lanes_root))
+            except OSError:
+                batches = []
+            for b in batches[:max(0, len(batches) - self.keep)]:
+                try:
+                    shutil.rmtree(os.path.join(lanes_root, b))
+                except OSError:
+                    continue
+                pruned.append(b)
+                self.queue.log_event("prune", batch=b, keep=self.keep)
         return pruned
 
     # -------------------------------------------------------------- drain
@@ -501,41 +660,60 @@ class CheckServer:
         deadline = (time.time() + max_secs) if max_secs else None
         t0 = time.time()
 
+        from dslabs_tpu.tpu.lanes import job_signature
+
         def worker():
             while True:
                 if deadline is not None and time.time() > deadline:
                     return
-                job = None
+                picked: List = []
                 with self._lock:
-                    job = self.sched.pick(self._running)
-                    if job is None:
+                    if self.lanes > 1:
+                        # Lane packer (ISSUE 14): group lane-compatible
+                        # queued jobs under the same DRR quota/deficit
+                        # semantics; over-picking up to 2L feeds
+                        # continuous batching's swap-ins.
+                        picked = self.sched.pick_batch(
+                            self._running, job_signature,
+                            self.lanes * (2 if self.lane_swap else 1))
+                    else:
+                        job = self.sched.pick(self._running)
+                        picked = [job] if job is not None else []
+                    if not picked:
                         if self.sched.pending() == 0 and self._active == 0:
                             return
                     else:
-                        self.queue.pop(job.job_id)
-                        self._running[job.tenant] = \
-                            self._running.get(job.tenant, 0) + 1
-                        self._active += 1
-                        st = self.stats.setdefault(job.tenant,
-                                                   _zero_stats())
-                        st["budget_spent"] += job.budget_units
-                if job is None:
+                        for job in picked:
+                            self.queue.pop(job.job_id)
+                            self._running[job.tenant] = \
+                                self._running.get(job.tenant, 0) + 1
+                            self._active += 1
+                            st = self.stats.setdefault(job.tenant,
+                                                       _zero_stats())
+                            st["budget_spent"] += job.budget_units
+                if not picked:
                     time.sleep(0.05)
                     continue
                 try:
-                    res = self.run_job(job)
+                    if len(picked) == 1:
+                        res_list = [self.run_job(picked[0])]
+                    else:
+                        res_list = self.run_job_batch(picked)
                 finally:
                     with self._lock:
-                        self._running[job.tenant] -= 1
-                        self._active -= 1
+                        for job in picked:
+                            self._running[job.tenant] -= 1
+                            self._active -= 1
                 with self._lock:
-                    st = self.stats.setdefault(job.tenant, _zero_stats())
-                    if res.get("status") == "done":
-                        st["completed"] += 1
-                        st["verdicts"] += 1
-                    else:
-                        st["failed"] += 1
-                    self.results.append(res)
+                    for res in res_list:
+                        st = self.stats.setdefault(res["tenant"],
+                                                   _zero_stats())
+                        if res.get("status") == "done":
+                            st["completed"] += 1
+                            st["verdicts"] += 1
+                        else:
+                            st["failed"] += 1
+                        self.results.append(res)
                 self._write_status()
 
         threads = [threading.Thread(target=worker, daemon=True,
@@ -565,6 +743,11 @@ class CheckServer:
             "failed": len(failed),
             "verdicts_per_min": round(len(done) / wall * 60.0, 2),
             "fairness_index": fairness_index(per_tenant),
+            # Lane amortisation (ISSUE 14): packing decisions + the
+            # mean dispatches billed per job (share-scaled across lane
+            # batches), the number the ledger compare guards.
+            "lanes": self._lane_block(),
+            "dispatches_per_job": totals.get("dispatches_per_job"),
             "per_tenant": per_tenant,
             # The cost ledger's view (tpu/tracing.py CostMeter):
             # per-tenant device-seconds / dispatches / compile split /
@@ -580,9 +763,31 @@ class CheckServer:
 
     # ------------------------------------------------------------- status
 
+    def _lane_block(self) -> dict:
+        """The ``lanes`` observability block (SERVER_STATUS.json +
+        drain summary + ``service status``): batch width/swap config,
+        packing decisions, occupancy, evictions, per-signature batch
+        sizes."""
+        with self._lock:
+            ls = self.lane_stats
+            return {
+                "width": self.lanes,
+                "swap": self.lane_swap,
+                "batches": ls["batches"],
+                "jobs_in_lanes": ls["jobs"],
+                "swaps": ls["swaps"],
+                "evicted": ls["evicted"],
+                "mean_occupancy": (
+                    round(ls["occupancy_sum"] / ls["batches"], 3)
+                    if ls["batches"] else None),
+                "by_signature": {s: dict(v) for s, v
+                                 in ls["by_signature"].items()},
+            }
+
     def server_status(self) -> dict:
         qs = self.queue.summary()
         cost_ledger = self.costs.tenant_summary()
+        lane_block = self._lane_block()
         with self._lock:
             pending = self.sched.pending_by_tenant()
             tenants = {}
@@ -613,6 +818,9 @@ class CheckServer:
                 "journal_error": qs["journal_error"],
                 "tenants": tenants,
                 "fairness_index": fairness_index(self.stats),
+                # Batched-lane observability (ISSUE 14): occupancy,
+                # packing decisions, per-signature batch sizes.
+                "lanes": lane_block,
             }
 
     def _write_status(self, force: bool = False) -> None:

@@ -287,6 +287,15 @@ class SearchOutcome:
     # COSTS.jsonl record, and its flight log stay joinable after the
     # run dir is pruned.  None outside any trace.
     trace_id: Optional[str] = None
+    # Batched job lanes (ISSUE 14, tpu/lanes.py): the lane index this
+    # verdict ran in, the batch width (L), and this lane's fraction of
+    # the batch's shared device-seconds (every dispatch's wall split
+    # evenly across the lanes resident at that level — the shares of a
+    # batch sum to 1.0, so lane billing never double-charges a
+    # dispatch).  None/unset outside a lane batch.
+    lane: Optional[int] = None
+    lane_width: Optional[int] = None
+    lane_share: Optional[float] = None
 
     @property
     def dropped_states(self) -> int:
@@ -949,6 +958,23 @@ class TensorSearch:
         if hook is None:
             return fn(*args)
         return hook(tag, fn, *args)
+
+    def lane_signature(self) -> Optional[str]:
+        """The batched-lane packing key (ISSUE 14, tpu/lanes.py): two
+        searches may share a lane-stacked program iff this string
+        matches — the checkpoint config fingerprint (protocol lane
+        widths + strict) plus every knob that shapes the compiled
+        step/promote programs.  ``None`` means the engine is not
+        lane-packable (the sharded subclass opts out — its superstep
+        is already a whole-mesh program)."""
+        from dslabs_tpu.tpu import checkpoint as ckpt_mod
+
+        return "|".join([
+            ckpt_mod.config_fingerprint(self.p, self.strict,
+                                        self.record_trace),
+            f"chunk={self.chunk}", f"fcap={self.frontier_cap}",
+            f"vcap={self.visited_cap}",
+            f"ev={self._ev_msg},{self._ev_tmr}"])
 
     def _cancelled(self) -> bool:
         """Portfolio-lane cancellation (tpu/supervisor.py portfolio

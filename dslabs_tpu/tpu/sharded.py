@@ -1139,6 +1139,16 @@ class ShardedTensorSearch(TensorSearch):
         built one (invoked directly — zero retrace), else the lazy jit."""
         return getattr(self, "_aot_exes", {}).get(name) or default
 
+    def lane_signature(self):
+        """Sharded searches are NOT lane-packable (ISSUE 14,
+        tpu/lanes.py): the superstep is already one whole-mesh program
+        whose dispatch cost is amortised across devices, and stacking
+        a lane axis on top of shard_map would multiply the carry's HBM
+        footprint by L on every chip.  The service's lane packer reads
+        ``None`` as "run solo" — a mesh-sized job keeps its own
+        dispatch stream."""
+        return None
+
     def dispatch_site_programs(self):
         """Sanitizer site registry (ISSUE 10; see the base-class
         docstring): the ACTIVE driver's programs — the fused superstep

@@ -163,6 +163,26 @@ DISPATCH_SITES = {
     # through this site (visited.dispatch_site_program).
     "visited.insert":        dict(hot=True, donated=True, multi=False,
                                   program=True),
+    # Batched job lanes (ISSUE 14, tpu/lanes.py): the lane superstep
+    # is THE multi-tenant hot path — one dispatch per level advances
+    # every resident lane — with the masked promote, the one-hot
+    # swap-in/restore splices, and the vmapped root initializer
+    # around it.  All single-device programs (J4 applies); the
+    # superstep/promote/inject carries are donated (J3 applies).
+    "lanes.init":            dict(hot=False, donated=False, multi=False,
+                                  program=True),
+    "lanes.superstep":       dict(hot=True, donated=True, multi=False,
+                                  program=True),
+    "lanes.promote":         dict(hot=False, donated=True, multi=False,
+                                  program=True),
+    "lanes.inject":          dict(hot=False, donated=True, multi=False,
+                                  program=True),
+    "lanes.restore":         dict(hot=False, donated=True, multi=False,
+                                  program=True),
+    "lanes.sync":            dict(hot=False, donated=False, multi=False,
+                                  program=False),
+    "lanes.flags":           dict(hot=False, donated=False, multi=False,
+                                  program=False),
 }
 
 # Hot-loop sites whose steady-state dispatches are worth a profiler
@@ -761,6 +781,12 @@ class Telemetry:
                 # The per-device lanes ARE the live mesh width — a
                 # degraded rung's level records carry fewer lanes.
                 self._status["mesh_width"] = len(pd["explored"])
+            if record.get("lanes") is not None:
+                # Batched-child monitor block (ISSUE 14, tpu/lanes.py):
+                # per-lane job/depth/explored, schema-pinned so
+                # `telemetry watch` renders every resident lane of one
+                # lane-batch process.
+                self._status["lanes"] = record["lanes"]
             self._status.update({
                 "engine": engine,
                 "depth": record.get("depth", 0),
@@ -1232,6 +1258,16 @@ def render_watch(path: str, now: Optional[float] = None) -> str:
         if st.get("lane"):
             out.append("lane: " + " ".join(
                 f"{k}={v}" for k, v in sorted(st["lane"].items())))
+        if st.get("lanes"):
+            # A lane-batch child (tpu/lanes.py): one line per resident
+            # lane — the batched equivalent of the per-device lanes.
+            for lrec in st["lanes"]:
+                out.append(
+                    f"job lane {lrec.get('lane')}: "
+                    f"{lrec.get('job_id')} depth {lrec.get('depth')} "
+                    f"unique {lrec.get('unique')} "
+                    f"explored {lrec.get('explored')} "
+                    f"frontier {lrec.get('frontier')}")
         ls = st.get("last_span")
         if ls:
             out.append(f"last span: {ls.get('tag')} i={ls.get('i')} "
@@ -1287,7 +1323,7 @@ def read_ledger(path: str) -> List[dict]:
 # The bench phases a ledger compare diffs ("headline" is the last-line
 # JSON's top-level value — the number the BENCH_r0N trajectory tracks).
 _LEDGER_PHASES = ("headline", "mesh", "strict", "beam", "swarm",
-                  "spill", "service", "cpu_fallback")
+                  "spill", "service", "lanes", "cpu_fallback")
 
 # Resilience counters the ledger tracks beside the rates (ISSUE 9):
 # a bench run that suddenly needs mesh shrinks / knob re-levels /
@@ -1518,6 +1554,59 @@ def compare_ledger(records: List[dict],
         cmp["cost"]["cost_per_unique"] = entry
         if lv > best * (1.0 + threshold):
             cmp["regressions"].append(entry)
+    # Batched-lane amortisation guards (ISSUE 14, tpu/lanes.py).
+    # dispatches-per-job is THE number continuous batching exists to
+    # shrink: a rise past the threshold over the best (fewest) prior
+    # means jobs stopped sharing dispatch streams — a regression even
+    # at equal verdicts/min.  Lane occupancy (mean resident lanes per
+    # level of the lanes phase) dropping past the threshold means the
+    # packer stopped filling lanes — same severity.
+    cmp["lanes"] = {}
+
+    def _dpj(rec):
+        for block in ("lanes", "service"):
+            s = rec.get(block)
+            if isinstance(s, dict):
+                try:
+                    v = float(s.get("dispatches_per_job"))
+                except (TypeError, ValueError):
+                    continue
+                if v > 0:
+                    return v
+        return None
+
+    lv = _dpj(latest)
+    priors_d = [v for v in (_dpj(r) for r in prior) if v is not None]
+    if lv is not None and priors_d:
+        best = min(priors_d)
+        entry = {"phase": "service:dispatches_per_job",
+                 "latest": round(lv, 2), "best_prior": round(best, 2),
+                 "delta_pct": round((lv - best) / best * 100, 1)
+                 if best > 0 else 0.0}
+        cmp["lanes"]["dispatches_per_job"] = entry
+        if lv > best * (1.0 + threshold):
+            cmp["regressions"].append(entry)
+
+    def _occ(rec):
+        s = rec.get("lanes")
+        if not isinstance(s, dict):
+            return None
+        try:
+            v = float(s.get("occupancy"))
+        except (TypeError, ValueError):
+            return None
+        return v if v > 0 else None
+
+    lv = _occ(latest)
+    priors_o = [v for v in (_occ(r) for r in prior) if v is not None]
+    if lv is not None and priors_o:
+        best = max(priors_o)
+        entry = {"phase": "lanes:occupancy",
+                 "latest": round(lv, 3), "best_prior": round(best, 3),
+                 "delta_pct": round((lv - best) / best * 100, 1)}
+        cmp["lanes"]["occupancy"] = entry
+        if lv < best * (1.0 - threshold):
+            cmp["regressions"].append(entry)
     return cmp
 
 
@@ -1555,6 +1644,10 @@ def render_compare(cmp: dict, source: str = "") -> str:
                    f"({e['delta_pct']:+.1f}%)")
     for c, e in sorted(cmp.get("cost", {}).items()):
         out.append(f"cost {c:20s} latest={e['latest']} "
+                   f"prior_best={e['best_prior']} "
+                   f"({e['delta_pct']:+.1f}%)")
+    for c, e in sorted(cmp.get("lanes", {}).items()):
+        out.append(f"lanes {c:19s} latest={e['latest']} "
                    f"prior_best={e['best_prior']} "
                    f"({e['delta_pct']:+.1f}%)")
     for e in cmp["regressions"]:

@@ -193,7 +193,7 @@ def _blank_tenant() -> dict:
             "unique": 0, "device_secs": 0.0, "dispatches": 0,
             "compile_secs": 0.0, "search_secs": 0.0, "retries": 0,
             "failovers": 0, "budget_spent": 0.0,
-            "cost_per_unique": None}
+            "cost_per_unique": None, "dispatches_per_job": None}
 
 
 class CostMeter:
@@ -290,8 +290,25 @@ class CostMeter:
         result ``CheckServer.run_job`` returns (done OR failed); the
         explored/unique/depth counters are copied EXACTLY from it, so
         per-tenant ledger sums always agree with the jobs'
-        SearchOutcome counters (pinned by test)."""
+        SearchOutcome counters (pinned by test).
+
+        A lane-batch job (ISSUE 14, tpu/lanes.py) carries
+        ``lane_share`` — its fraction of the batch's SHARED dispatch
+        stream (shares of a batch sum to 1.0) — and ``flight_log`` is
+        the batch's: the device-time numbers are scaled by the share
+        so a shared dispatch is billed exactly once across the batch,
+        and per-tenant bills DROP as batching improves."""
         fc = self.flight_costs(flight_log)
+        share = verdict.get("lane_share")
+        if share is not None:
+            share = max(0.0, min(1.0, float(share)))
+            for k in ("device_secs", "compile_secs", "search_secs",
+                      "first_dispatch_secs", "aot_compile_secs"):
+                fc[k] = round(fc[k] * share, 6)
+            fc["dispatches"] = round(fc["dispatches"] * share, 3)
+            fc["device_secs_by_site"] = {
+                t: round(v * share, 6)
+                for t, v in fc["device_secs_by_site"].items()}
         rec = {
             "t": "cost", "ts": round(time.time(), 3),
             "job_id": verdict.get("job_id"),
@@ -312,6 +329,9 @@ class CostMeter:
                 "device_secs", "device_secs_by_site", "dispatches",
                 "retries", "compile_secs", "search_secs", "levels")},
         }
+        if share is not None:
+            rec["lane_share"] = share
+            rec["lanes"] = verdict.get("lanes")
         rec["cost_per_unique"] = (
             round(rec["device_secs"] / rec["unique"], 9)
             if rec["unique"] > 0 else None)
@@ -343,12 +363,15 @@ class CostMeter:
         out = _blank_tenant()
         for s in per.values():
             for k in out:
-                if k == "cost_per_unique":
+                if k in ("cost_per_unique", "dispatches_per_job"):
                     continue
                 out[k] = out[k] + s[k]
         out["cost_per_unique"] = (
             round(out["device_secs"] / out["unique"], 9)
             if out["unique"] > 0 else None)
+        out["dispatches_per_job"] = (
+            round(out["dispatches"] / out["jobs"], 3)
+            if out["jobs"] > 0 else None)
         for k in ("device_secs", "compile_secs", "search_secs",
                   "budget_spent"):
             out[k] = round(out[k], 6)
@@ -380,7 +403,11 @@ def aggregate_costs(records: List[dict]) -> Dict[str, dict]:
         s["device_secs"] = round(
             s["device_secs"] + float(r.get("device_secs", 0.0) or 0.0),
             6)
-        s["dispatches"] += int(r.get("dispatches", 0) or 0)
+        # Lane-batch records carry share-scaled FRACTIONAL dispatch
+        # counts (tpu/lanes.py) — keep the float, the per-job mean is
+        # the amortisation headline.
+        s["dispatches"] = round(
+            s["dispatches"] + float(r.get("dispatches", 0) or 0), 3)
         s["compile_secs"] = round(
             s["compile_secs"] + float(r.get("compile_secs", 0.0)
                                       or 0.0), 6)
@@ -396,6 +423,12 @@ def aggregate_costs(records: List[dict]) -> Dict[str, dict]:
         s["cost_per_unique"] = (
             round(s["device_secs"] / s["unique"], 9)
             if s["unique"] > 0 else None)
+        # The lane-amortisation headline (ISSUE 14): mean dispatches
+        # billed per job — batching drives this DOWN (`telemetry
+        # compare` flags a rise as a regression).
+        s["dispatches_per_job"] = (
+            round(s["dispatches"] / s["jobs"], 3)
+            if s["jobs"] > 0 else None)
     return out
 
 
@@ -587,6 +620,39 @@ def _assemble_job(root: str, rec: dict, journal: List[dict]) -> dict:
             in_flight = dict(seg["in_flight"],
                              segment=si,
                              hint=seg["meta"].get("hint"))
+    # Lane-batch attribution (ISSUE 14, tpu/lanes.py): a job that ran
+    # in a batched lane has no flight log of its own — the journal's
+    # ``lane_batch`` events name the resident jobs and the batch run
+    # dir, and the batch's SHARED flight log is attributed to every
+    # resident job's causal tree (marked shared, so a reader knows the
+    # spans were amortised across lanes, not exclusive).
+    for ev in journal:
+        if ev.get("t") != "lane_batch" or not ev.get("run_dir"):
+            continue
+        if job_id not in (ev.get("jobs") or []):
+            continue
+        bid = ev.get("batch") or os.path.basename(ev["run_dir"])
+        brecords, btorn = read_flight_lax(
+            os.path.join(ev["run_dir"], "flight.jsonl"))
+        torn += btorn
+        parent = next(iter(attempt_ids), root_id)
+        lane_root = f"{job_id}:lane:{bid}"
+        nodes.append({"span_id": lane_root, "parent": parent,
+                      "kind": "lane_batch",
+                      "name": f"lane batch {bid} (shared)",
+                      "shared": True,
+                      "lanes": len(ev.get("jobs") or ()),
+                      "t0": ev.get("ts"), "t1": None})
+        known.add(lane_root)
+        for si, seg in enumerate(segment_flight(brecords)):
+            ph = _segment_nodes(seg, lane_root, f"{job_id}:lb{si}",
+                                nodes, known=known)
+            compile_secs += ph["compile_secs"]
+            search_secs += ph["search_secs"]
+            if seg["in_flight"] is not None and in_flight is None:
+                in_flight = dict(seg["in_flight"], segment=si,
+                                 hint=seg["meta"].get("hint"),
+                                 shared=True)
     status = rec.get("status")
     verdict = rec.get("verdict") or rec.get("failure")
     total = None
@@ -786,7 +852,7 @@ def render_trace(tr: dict) -> str:
             out.append(
                 f"{t:12s} {s['jobs']:5d} {s['unique']:9d} "
                 f"{s['explored']:9d} {s['device_secs']:8.3f} "
-                f"{s['dispatches']:6d} {s['compile_secs']:9.3f} "
+                f"{s['dispatches']:6.1f} {s['compile_secs']:9.3f} "
                 f"{s['retries']:7d} "
                 f"{cpu if cpu is not None else '-':>12}")
     return "\n".join(out)

@@ -44,6 +44,7 @@ audit entry.
 
 from __future__ import annotations
 
+import contextlib
 import os
 from typing import Optional, Tuple
 
@@ -54,7 +55,28 @@ import numpy as np
 __all__ = ["BKT", "MAXU32", "empty_table", "sanitize_keys",
            "host_sanitize_key", "host_home_slot", "host_occupied",
            "insert", "insert_jnp", "pallas_insert", "pallas_mode",
-           "dispatch_site_program", "build_table"]
+           "force_jnp", "dispatch_site_program", "build_table"]
+
+# Trace-time override depth for :func:`force_jnp` — engines that trace
+# the probe loop under a batching transform (the lane engine vmaps the
+# whole step body over stacked jobs, tpu/lanes.py) pin the jnp oracle
+# here: ``pallas_call`` has no batching rule for this kernel, and the
+# two variants are bit-identical by construction, so the override is a
+# lowering choice, never a semantic one.
+_FORCE_JNP = 0
+
+
+@contextlib.contextmanager
+def force_jnp():
+    """Pin :func:`insert` to the jnp oracle for programs traced inside
+    this context (nested use is fine; trace-time only — already-compiled
+    programs are unaffected)."""
+    global _FORCE_JNP
+    _FORCE_JNP += 1
+    try:
+        yield
+    finally:
+        _FORCE_JNP -= 1
 
 # Slots per bucket: the probe loop reads whole buckets (one aligned
 # 128-byte line of 8 x 16-byte keys).
@@ -344,6 +366,8 @@ def insert(table: jnp.ndarray, keys: jnp.ndarray, valid: jnp.ndarray,
     table fits VMEM; interpreter when forced) with :func:`insert_jnp`
     as the everywhere-else fallback and parity oracle.  Contract and
     return values are identical across paths (see ``insert_jnp``)."""
+    if _FORCE_JNP:
+        return insert_jnp(table, keys, valid, max_iters)
     interp = _pallas_interpret(int(table.shape[0]) * 16)
     if interp is None:
         return insert_jnp(table, keys, valid, max_iters)

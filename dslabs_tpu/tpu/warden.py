@@ -117,7 +117,8 @@ _SCALAR_FIELDS = (
     "dropped", "samples", "visited_overflow", "retries", "failovers",
     "resumed_from_depth", "engine", "levels", "compile_secs",
     "child_restarts", "killed_dispatches", "abandoned_threads",
-    "mesh_width", "mesh_shrinks", "knob_retries", "trace_id")
+    "mesh_width", "mesh_shrinks", "knob_retries", "trace_id",
+    "lane", "lane_width", "lane_share")
 
 
 def outcome_to_dict(out) -> dict:

@@ -10,7 +10,13 @@ the end-to-end CLI paths the pytest tier exercises through the API —
 3. (ISSUE 13) run the same search INSIDE a trace context and assemble
    it with ``telemetry trace`` — the causal tree, the trace id on
    every span, ``watch --json``, and the Perfetto export
-   (the trace-assembler step).
+   (the trace-assembler step);
+4. (ISSUE 14) parse a lanes bench-phase record end to end: the
+   ledger's ``service:dispatches_per_job`` and ``lanes:occupancy``
+   compare guards flag an injected amortisation regression (rc 1)
+   and stay quiet on parity, and a lane-batch run dir's STATUS.json
+   renders its per-lane block through ``telemetry watch``
+   (the lanes leg).
 
 Exits nonzero on any mismatch; prints one OK line per step."""
 
@@ -46,6 +52,27 @@ def run_search(run_dir: str):
     out = search.run()
     tel.close()
     return out
+
+
+def run_lane_batch(run_dir: str):
+    """A tiny 2-lane batch with a run-dir recorder — the lanes watch
+    fixture (ISSUE 14)."""
+    import dataclasses
+
+    from dslabs_tpu.tpu.lanes import LaneJob, LaneSearch
+    from dslabs_tpu.tpu.protocols.pingpong import make_pingpong_protocol
+
+    pp = make_pingpong_protocol(workload_size=2)
+    pp = dataclasses.replace(
+        pp, goals={}, prunes={"CLIENTS_DONE": pp.goals["CLIENTS_DONE"]})
+    tel = tel_mod.Telemetry.for_checkpoint(
+        os.path.join(run_dir, "search.ckpt"), engine_hint="lane-batch")
+    search = LaneSearch(pp, n_lanes=2, frontier_cap=1 << 10,
+                        visited_cap=1 << 12, telemetry=tel)
+    res = search.run_lanes([LaneJob("smoke-a"), LaneJob("smoke-b")])
+    tel.close()
+    assert not res.errors, res.errors
+    return res
 
 
 def main() -> int:
@@ -106,6 +133,40 @@ def main() -> int:
     pf = tracing.to_perfetto(tr)
     assert pf["traceEvents"], "perfetto export empty"
     print("obs-smoke: trace assembler (causal tree + perfetto) OK")
+
+    # -- lanes leg (ISSUE 14): the amortisation compare guards parse
+    # a lanes bench-phase record end to end.  Parity ledger: equal
+    # dispatches-per-job + occupancy -> rc 0; regression ledger: dpj
+    # doubled AND occupancy halved -> both guards flag, rc 1.
+    lanes_ok = os.path.join(run_dir, "lanes_parity.jsonl")
+    base = {"t": "bench", "value": 100.0,
+            "lanes": {"value": 500.0, "dispatches_per_job": 8.0,
+                      "occupancy": 4.0}}
+    for _ in range(2):
+        tel_mod.append_ledger(lanes_ok, base)
+    rc = tel_mod.main(["compare", lanes_ok])
+    assert rc == 0, "lane parity ledger must not flag"
+    lanes_bad = os.path.join(run_dir, "lanes_regress.jsonl")
+    tel_mod.append_ledger(lanes_bad, base)
+    tel_mod.append_ledger(lanes_bad, {
+        "t": "bench", "value": 100.0,
+        "lanes": {"value": 500.0, "dispatches_per_job": 16.0,
+                  "occupancy": 2.0}})
+    rc = tel_mod.main(["compare", lanes_bad])
+    assert rc == 1, "lane amortisation regression must flag"
+    cmp = tel_mod.compare_ledger(tel_mod.read_ledger(lanes_bad))
+    flagged = {e["phase"] for e in cmp["regressions"]}
+    assert "service:dispatches_per_job" in flagged, cmp
+    assert "lanes:occupancy" in flagged, cmp
+    # A lane-batch STATUS.json (the child's monitor file) renders the
+    # per-lane block through the same watch CLI.
+    lane_dir = tempfile.mkdtemp(prefix="dslabs_obs_smoke_lanes_")
+    run_lane_batch(lane_dir)
+    frame = tel_mod.render_watch(lane_dir)
+    assert "job lane" in frame, frame
+    rc = tel_mod.main(["watch", lane_dir, "--once"])
+    assert rc == 0, rc
+    print("obs-smoke: lanes compare guards + batched watch OK")
     print(json.dumps({"obs_smoke": "ok", "run_dir": run_dir,
                       "trace_dir": trace_dir, "trace_id": trace_id}))
     return 0
