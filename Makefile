@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast lint analysis-smoke perf-smoke fault-smoke swarm-smoke capacity-smoke obs-smoke chaos-smoke service-smoke trace-smoke mesh-smoke lanes-smoke lab0 lab1 lab2 lab3 lab4 bench dryrun handout clean
+.PHONY: test test-fast lint analysis-smoke perf-smoke fault-smoke swarm-smoke capacity-smoke capacity2-smoke obs-smoke chaos-smoke service-smoke trace-smoke mesh-smoke lanes-smoke lab0 lab1 lab2 lab3 lab4 bench dryrun handout clean
 
 test:            ## full acceptance + parity suite
 	$(PY) -m pytest tests/ -q
@@ -77,6 +77,22 @@ swarm-smoke:     ## swarm explorer suite incl. slow deep-narrow scenarios, on CP
 # `python bench.py --spill` if you want the number itself.
 capacity-smoke:  ## host-RAM spill tier + capacity-ladder suite on CPU
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m capacity -p no:cacheprovider
+
+# capacity2-smoke = capacity round 2 (ISSUE 15, tpu/packing.py +
+# tpu/symmetry.py + the async spill gear): packed-vs-unpacked EXACT
+# parity on pingpong + lab1 (strict and beam, device + host loops +
+# sharded), the >= 2x bytes_per_state pins on the lab1/paxos specs and
+# the packed-capacity depth test (a frontier sized in packed bytes
+# completes a depth the unpacked layout provably cannot fit),
+# SIGKILL-mid-run packed-checkpoint resume + the loud packed<->raw
+# cross-resume conversion/refusal, the symmetry-reduced paxos quotient
+# (pinned canonical counts, verdict parity, replay-verified witness),
+# and the async drain's exactness + overlap accounting — PLUS the
+# packed end-to-end leg of tools/obs_smoke.py (STATUS capacity block +
+# the ledger's capacity:bytes_per_state guard rc 0/1 both ways).
+capacity2-smoke: ## capacity round 2: packed encoding + symmetry reduction + async spill, on CPU
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m capacity2 -p no:cacheprovider
+	JAX_PLATFORMS=cpu $(PY) tools/obs_smoke.py
 
 # obs-smoke = the unified telemetry suite (tests/test_telemetry.py):
 # span-count == dispatch-count on both engines, the zero-added-

@@ -86,6 +86,7 @@ STRICT_CAP_SECS = 420.0      # child budget cap; parent adds kill slack
 BEAM_CAP_SECS = 300.0
 SWARM_CAP_SECS = 150.0       # swarm-explorer phase (ISSUE 5)
 SPILL_CAP_SECS = 120.0       # capacity-ladder phase (ISSUE 6)
+CAPACITY2_CAP_SECS = 120.0   # packed/symmetry/async-drain phase (ISSUE 15)
 SERVICE_CAP_SECS = 120.0     # multi-tenant service phase (ISSUE 11)
 MESH_CAP_SECS = 150.0        # 8-device mesh headline phase (ISSUE 12)
 LANES_CAP_SECS = 150.0       # batched-job-lanes phase (ISSUE 14)
@@ -745,6 +746,110 @@ def _run_spill(budget_secs: float) -> dict:
     }
 
 
+def _run_capacity2(budget_secs: float) -> dict:
+    """Capacity round 2 phase (ISSUE 15, tpu/packing.py /
+    tpu/symmetry.py / tpu/spill.py async gear): on the GENERATED lab1
+    spec (domain-declared, so the packed frontier encoding engages) —
+    bytes_per_state packed vs unpacked, exact-parity flag, and
+    packed-path states/min; a packed 1/8-table spill run for the async
+    drain's overlap ratio (host drain wall hidden behind device
+    compute); and the symmetry quotient on the generated paxos spec
+    (canonical vs raw unique counts, verdict parity).  The ledger's
+    ``capacity:bytes_per_state`` guard compares this phase across
+    rounds (a rise past threshold = rc 1).  Same always-reports
+    guarantees as every phase."""
+    import dataclasses
+    import math
+
+    _persistent_cache()
+
+    from dslabs_tpu.tpu.engine import TensorSearch
+    from dslabs_tpu.tpu.specs import clientserver_spec, paxos_spec
+
+    t_phase = time.time()
+    tel = _phase_telemetry("capacity2")
+    cs = clientserver_spec(3, 4).compile()
+    proto = dataclasses.replace(
+        cs, goals={}, prunes={"DONE": cs.goals["CLIENTS_DONE"]})
+    depth = int(os.environ.get("DSLABS_CAPACITY2_DEPTH", "9"))
+
+    def run_one(packed, spill=False, visited_cap=1 << 20, chunk=2048):
+        # NOTE: engine reuse (warm-up then measure) is safe in spill
+        # mode since SpillManager.reset_run — the tier no longer leaks
+        # across runs.
+        search = TensorSearch(proto, chunk=chunk, frontier_cap=1 << 15,
+                              max_depth=2, visited_cap=visited_cap,
+                              packed=packed, spill=spill,
+                              telemetry=tel)
+        t_c = time.time()
+        search.run()          # warm-up: compile outside the window
+        compile_secs = time.time() - t_c
+        search.max_depth = depth
+        search.max_secs = max(
+            15.0, (budget_secs - (time.time() - t_phase)) / 3)
+        t0 = time.time()
+        out = search.run()
+        return out, max(time.time() - t0, 1e-9), compile_secs
+
+    _hb("capacity2: unpacked reference run")
+    un, dt_u, cs_u = run_one(False)
+    _hb("capacity2: packed run")
+    pkd, dt_p, cs_p = run_one(True)
+    parity = (un.end_condition == pkd.end_condition
+              and un.unique_states == pkd.unique_states
+              and un.states_explored == pkd.states_explored)
+    # Floor 256: one chunk's unique successors must fit an EMPTY
+    # table (the spill contract's hard minimum) — tiny smoke depths
+    # would otherwise derive a cap below chunk * mean-events.
+    cap = 1 << max(8, int(math.floor(
+        math.log2(max(pkd.unique_states // 8, 8)))))
+    _hb(f"capacity2: packed async-spill run (visited_cap {cap})")
+    sp, _dt_s, cs_s = run_one(True, spill=True, visited_cap=cap,
+                              chunk=16)
+    drain_ms = sp.spill_drain_ms
+    overlap_ratio = (round(max(0, drain_ms - sp.spill_wait_ms)
+                           / drain_ms, 4) if drain_ms > 0 else 0.0)
+    # Symmetry quotient: canonical vs raw unique counts on the
+    # generated single-decree paxos spec (reduction is opt-in — this
+    # is the measured win, not a default behavior change).
+    px = paxos_spec(3).compile()
+    pxp = dataclasses.replace(px, goals={},
+                              prunes={"D": px.goals["DECIDED"]})
+    _hb("capacity2: symmetry quotient (paxos raw vs canonical)")
+    raw = TensorSearch(pxp, chunk=256, visited_cap=1 << 14,
+                       telemetry=tel).run()
+    sym = TensorSearch(pxp, chunk=256, visited_cap=1 << 14,
+                       symmetry=True, telemetry=tel).run()
+    return {
+        "value": round(pkd.unique_states / dt_p * 60.0, 1),
+        "unpacked_per_min": round(un.unique_states / dt_u * 60.0, 1),
+        "bytes_per_state": pkd.bytes_per_state,
+        "bytes_per_state_unpacked": un.bytes_per_state,
+        "pack_ratio": pkd.pack_ratio,
+        "exact_parity": parity,
+        "end": pkd.end_condition, "depth": pkd.depth,
+        "unique": pkd.unique_states, "explored": pkd.states_explored,
+        "spill_visited_cap": cap,
+        "spill_exact_parity": (sp.unique_states == pkd.unique_states
+                               and sp.states_explored
+                               == pkd.states_explored),
+        "spill_drain_ms": drain_ms,
+        "spill_wait_ms": sp.spill_wait_ms,
+        "spill_overlap_ratio": overlap_ratio,
+        "dropped_states": sp.dropped_states,
+        "symmetry": {
+            "raw_unique": raw.unique_states,
+            "canonical_unique": sym.unique_states,
+            "quotient": round(raw.unique_states
+                              / max(sym.unique_states, 1), 3),
+            "verdict_parity": raw.end_condition == sym.end_condition,
+            "perms": sym.symmetry_perms},
+        "compile_secs": round(cs_u + cs_p + cs_s, 1),
+        "total_secs": round(time.time() - t_phase, 1),
+        "telemetry": tel.summary(),
+    }
+
+
 def _run_service(budget_secs: float) -> dict:
     """Checking-as-a-service phase (ISSUE 11, dslabs_tpu/service/): a
     multi-tenant drain — three tenants submit small exhaustive
@@ -1240,6 +1345,14 @@ def main() -> None:
                 result["spill"] = spill_res
                 _note_phase_telemetry(result, "spill", spill_res)
         if _remaining() > 75:
+            cap2, _cap2_err = _sub(
+                ["--capacity2", str(min(90.0, _remaining() - 15))],
+                min(90.0, _remaining() - 10), "capacity2-cpu",
+                silence=PHASE_SILENCE_SECS)
+            if cap2 is not None:
+                result["capacity2"] = cap2
+                _note_phase_telemetry(result, "capacity2", cap2)
+        if _remaining() > 75:
             svc, _svc_err = _sub(
                 ["--service", str(min(90.0, _remaining() - 15))],
                 min(90.0, _remaining() - 10), "service-cpu",
@@ -1364,6 +1477,23 @@ def main() -> None:
     else:
         result["spill_error"] = "skipped: deadline nearly exhausted"
 
+    # ---- phase 5.2: capacity round 2 (ISSUE 15) — packed vs unpacked
+    # bytes_per_state + packed states/min, async spill overlap ratio,
+    # symmetry quotient.  The ledger's capacity:bytes_per_state guard
+    # compares it across rounds.  Never the headline; skipped rather
+    # than raced when the deadline is nearly spent.
+    budget = min(CAPACITY2_CAP_SECS, _remaining() - KILL_SLACK_SECS - 10)
+    if budget > 45:
+        cap2, cap2_err = _sub(["--capacity2", str(budget)], budget,
+                              "capacity2", silence=PHASE_SILENCE_SECS)
+        if cap2 is not None:
+            result["capacity2"] = cap2
+            _note_phase_telemetry(result, "capacity2", cap2)
+        else:
+            result["capacity2_error"] = cap2_err
+    else:
+        result["capacity2_error"] = "skipped: deadline nearly exhausted"
+
     # ---- phase 5.5: the multi-tenant service drain (ISSUE 11) —
     # per-tenant throughput + the fairness index the ledger compare
     # tracks.  Never the headline; skipped rather than raced when the
@@ -1442,6 +1572,11 @@ if __name__ == "__main__":
         budget = (float(sys.argv[2]) if len(sys.argv) > 2
                   else SPILL_CAP_SECS)
         print(json.dumps(_run_spill(budget)))
+        sys.exit(0)
+    if len(sys.argv) >= 2 and sys.argv[1] == "--capacity2":
+        budget = (float(sys.argv[2]) if len(sys.argv) > 2
+                  else CAPACITY2_CAP_SECS)
+        print(json.dumps(_run_capacity2(budget)))
         sys.exit(0)
     if len(sys.argv) >= 2 and sys.argv[1] == "--service":
         budget = (float(sys.argv[2]) if len(sys.argv) > 2

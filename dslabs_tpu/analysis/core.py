@@ -45,6 +45,11 @@ RULES = {
     "C4": "spec hygiene: declared message/timer with no handler, "
           "put/get of undeclared fields, handler for unknown "
           "kind/message",
+    "C5": "symmetry hygiene: a handler on a kind inside a declared "
+          "symmetry group branches on the raw node id (node_index() "
+          "compared against a constant) — breaks member "
+          "interchangeability, so the canonicalize pass would merge "
+          "states with DIFFERENT behavior",
     "J0": "site-registry coverage: dispatch site missing from "
           "telemetry.DISPATCH_SITES, or its program failed to lower",
     "J1": "host callback inside a lowered device program",

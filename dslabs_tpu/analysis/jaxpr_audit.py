@@ -248,9 +248,19 @@ def build_audit_engines(mesh_devices: int = 2,
     from dslabs_tpu.tpu.lanes import LaneSearch
 
     proto = make_pingpong_protocol(workload_size=2)
+    # Capacity round 2 (ISSUE 15): a spec-compiled protocol with
+    # declared domains + symmetry groups, so the packing.pack/unpack
+    # codec programs and the symmetry.canonicalize pass register as
+    # audit sites (the hand twins derive the identity descriptor and
+    # register neither).
+    from dslabs_tpu.tpu.specs import paxos_spec
+
+    packed_proto = paxos_spec(3).compile()
     engines = [
         TensorSearch(proto, max_depth=8, frontier_cap=1 << 8,
                      visited_cap=1 << 10),
+        TensorSearch(packed_proto, max_depth=8, frontier_cap=1 << 8,
+                     visited_cap=1 << 10, symmetry=True),
         ShardedTensorSearch(proto, make_mesh(mesh_devices),
                             chunk_per_device=16, frontier_cap=1 << 8,
                             visited_cap=1 << 10, max_depth=8),

@@ -194,15 +194,25 @@ class SearchCheckpoint:
 
 
 def config_fingerprint(protocol, strict: bool,
-                       record_trace: bool = False) -> str:
+                       record_trace: bool = False,
+                       symmetry: int = 0) -> str:
     """The semantic identity a dump must share with the search resuming
     it: packed-lane layout + verdict-affecting flags.  Engine-local
     throughput knobs (chunk, caps, mesh size, ev budget) are excluded
-    by design — see the module docstring."""
-    return repr((FORMAT_VERSION, protocol.name, protocol.n_nodes,
-                 protocol.node_width, protocol.msg_width,
-                 protocol.timer_width, protocol.net_cap,
-                 protocol.timer_cap, bool(strict), bool(record_trace)))
+    by design — see the module docstring.  ``symmetry`` (the active
+    canonicalize pass's permutation count, 0 = off — ISSUE 15) DOES
+    participate: a symmetry-reduced dump's visited keys and unique
+    counts describe the quotient space, which an unreduced search must
+    refuse loudly rather than resume into.  The bit-packed frontier
+    ENCODING deliberately does not (it is a storage codec, converted
+    loudly on resume via the dump's ``frontier_encoding`` marker)."""
+    base = (FORMAT_VERSION, protocol.name, protocol.n_nodes,
+            protocol.node_width, protocol.msg_width,
+            protocol.timer_width, protocol.net_cap,
+            protocol.timer_cap, bool(strict), bool(record_trace))
+    if symmetry:
+        return repr(base + (f"sym{symmetry}",))
+    return repr(base)
 
 
 def _content_checksum(host: dict) -> np.uint32:

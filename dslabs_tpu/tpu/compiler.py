@@ -80,17 +80,41 @@ class SpecError(Exception):
 class Field:
     """A bounded int field of a node: scalar (size 1) or a small int
     array (size > 1).  ``init`` is an int or a per-instance callable
-    ``(instance_index) -> int | list``."""
+    ``(instance_index) -> int | list``.
+
+    ``lo``/``hi`` declare the field's value DOMAIN — the input to the
+    bit-packed frontier encoding (ISSUE 15, tpu/packing.py): a field
+    with ``hi`` set is stored in ``ceil(log2(hi - lo + 1))`` bits on
+    the packed frontier; ``hi=None`` (the default) keeps the full
+    int32 lane.  Domains are enforced loudly: an out-of-domain live
+    value is a CapacityOverflow, never silent corruption, and init
+    values are range-checked at compile time.
+
+    ``index_group`` names a node KIND whose instances index this array
+    field (size must equal that kind's count): when the kind is
+    declared in the spec's ``symmetry`` groups, the canonicalize pass
+    permutes this array's elements together with the node ids
+    (tpu/symmetry.py) — per-member bitmaps/counters stay coherent
+    under relabeling."""
 
     name: str
     size: int = 1
     init: object = 0
+    lo: int = 0
+    hi: Optional[int] = None
+    index_group: Optional[str] = None
 
 
 @dataclasses.dataclass(frozen=True)
 class MessageType:
+    """``bounds`` maps payload field name -> (lo, hi) domain for the
+    packed encoding (tpu/packing.py); undeclared fields keep full
+    int32 lanes.  Tag/from/to lanes derive their domains from the
+    spec itself (tag cardinality, node count)."""
+
     name: str
     fields: Tuple[str, ...] = ()
+    bounds: Optional[Dict[str, Tuple[int, int]]] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -99,6 +123,7 @@ class TimerType:
     fields: Tuple[str, ...] = ()
     min_ms: int = 10
     max_ms: int = 10
+    bounds: Optional[Dict[str, Tuple[int, int]]] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -238,13 +263,21 @@ class ProtocolSpec:
                  messages: Sequence[MessageType],
                  timers: Sequence[TimerType],
                  net_cap: int = 16,
-                 timer_cap: int = 4):
+                 timer_cap: int = 4,
+                 symmetry: Sequence[str] = ()):
         self.name = name
         self.nodes = list(nodes)
         self.messages = list(messages)
         self.timers = list(timers)
         self.net_cap = net_cap
         self.timer_cap = timer_cap
+        # Symmetry groups (ISSUE 15, tpu/symmetry.py): names of node
+        # KINDS whose instances are interchangeable — handlers must
+        # treat every member identically (the C5 conformance rule).
+        # compile() emits the canonical-relabeling permutation tables;
+        # the engines' opt-in canonicalize pass (default OFF) dedups
+        # symmetric twins to one representative.
+        self.symmetry = tuple(symmetry)
         # (kind, message/timer name) -> handler(ctx, payload dict)
         self.handlers: Dict[Tuple[str, str], Callable] = {}
         self.timer_handlers: Dict[Tuple[str, str], Callable] = {}
@@ -387,6 +420,182 @@ class ProtocolSpec:
                 raise SpecError(
                     f"initial timer of undeclared type {name!r}",
                     spec=self.name, field=name)
+        kind_counts = {k.name: k.count for k in self.nodes}
+        for g in self.symmetry:
+            if g not in kinds:
+                raise SpecError(
+                    f"symmetry group names unknown node kind {g!r} "
+                    f"(declared: {sorted(kinds)})",
+                    spec=self.name, kind=g, code="C5")
+        for kind in self.nodes:
+            for f in kind.fields:
+                if f.hi is not None and f.hi < f.lo:
+                    raise SpecError(
+                        f"field {f.name!r} on kind {kind.name!r} has "
+                        f"empty domain [{f.lo}, {f.hi}]",
+                        spec=self.name, kind=kind.name, field=f.name)
+                if f.index_group is not None:
+                    if f.index_group not in kind_counts:
+                        raise SpecError(
+                            f"field {f.name!r} on kind {kind.name!r} "
+                            f"declares index_group for unknown kind "
+                            f"{f.index_group!r}",
+                            spec=self.name, kind=kind.name,
+                            field=f.name, code="C5")
+                    if f.size != kind_counts[f.index_group]:
+                        raise SpecError(
+                            f"field {f.name!r} on kind {kind.name!r} "
+                            f"has size {f.size} but index_group "
+                            f"{f.index_group!r} has "
+                            f"{kind_counts[f.index_group]} instances",
+                            spec=self.name, kind=kind.name,
+                            field=f.name, code="C5")
+                # Init values must sit inside the declared domain —
+                # the packed encoding would otherwise corrupt the root
+                # state silently (tpu/packing.py).
+                if f.hi is not None:
+                    for i in range(kind.count):
+                        v = f.init(i) if callable(f.init) else f.init
+                        vals = np.atleast_1d(np.asarray(v)).tolist()
+                        for x in vals:
+                            if not (f.lo <= int(x) <= f.hi):
+                                raise SpecError(
+                                    f"init value {x} of field "
+                                    f"{f.name!r} on kind {kind.name!r} "
+                                    f"outside declared domain "
+                                    f"[{f.lo}, {f.hi}]",
+                                    spec=self.name, kind=kind.name,
+                                    field=f.name)
+
+    # -------------------------------------------- packing / symmetry
+
+    def _lane_domains(self) -> dict:
+        """Per-lane value domains for the bit-packed frontier encoding
+        (tpu/packing.py): the structural lanes (message/timer tags,
+        from/to node indices, timer min/max) derive from the spec
+        itself; field/payload lanes from the declared ``lo``/``hi``
+        bounds, ``None`` (full int32) where undeclared."""
+        n_nodes = sum(k.count for k in self.nodes)
+        nodes = []
+        for kind, _i in self._instances():
+            for f in kind.fields:
+                dom = (f.lo, f.hi) if f.hi is not None else None
+                nodes += [dom] * f.size
+        node_dom = (0, max(n_nodes - 1, 0))
+
+        def _merge(entries):
+            """Union of (lo, hi) domains; None poisons."""
+            lo = hi = None
+            for e in entries:
+                if e is None:
+                    return None
+                lo = e[0] if lo is None else min(lo, e[0])
+                hi = e[1] if hi is None else max(hi, e[1])
+            return (0, 0) if lo is None else (lo, hi)
+
+        msg = [(0, max(len(self.messages) - 1, 0)), node_dom, node_dom]
+        for j in range(self._mw - 3):
+            entries = []
+            for m in self.messages:
+                if j < len(m.fields):
+                    entries.append((m.bounds or {}).get(m.fields[j]))
+                else:
+                    entries.append((0, 0))      # zero-padded lane
+            msg.append(_merge(entries))
+        tmr = [(0, len(self.timers)),
+               _merge([(t.min_ms, t.min_ms) for t in self.timers]),
+               _merge([(t.max_ms, t.max_ms) for t in self.timers])]
+        for j in range(self._tw - 3):
+            entries = []
+            for t in self.timers:
+                if j < len(t.fields):
+                    entries.append((t.bounds or {}).get(t.fields[j]))
+                else:
+                    entries.append((0, 0))
+            tmr.append(_merge(entries))
+        # Compiled handlers never set an exception code
+        # (_normalize_step pads exc=0), so the lane is a constant.
+        return {"nodes": nodes, "msg": msg, "timer": tmr,
+                "exc": (0, 0)}
+
+    def _symmetry_spec(self, table):
+        """Build the canonical-relabeling permutation tables for the
+        declared symmetry groups (tpu/symmetry.py SymmetrySpec), or
+        None when no groups are declared."""
+        if not self.symmetry:
+            return None
+        import itertools
+
+        from dslabs_tpu.tpu.symmetry import SymmetrySpec
+
+        n_nodes = sum(k.count for k in self.nodes)
+        _, nw = self._layout()
+        bases = {}
+        off = 0
+        for kind in self.nodes:
+            bases[kind.name] = off
+            off += kind.count
+        groups = []
+        total = 1
+        for g in self.symmetry:
+            count = next(k.count for k in self.nodes if k.name == g)
+            groups.append((g, bases[g], count))
+            for i in range(2, count + 1):
+                total *= i
+        if total > 720:
+            raise SpecError(
+                f"symmetry groups expand to {total} permutations "
+                "(> 720) — the fused canonicalize pass enumerates "
+                "them; shrink the groups", spec=self.name, code="C5")
+        per_group = [list(itertools.permutations(range(c)))
+                     for _g, _b, c in groups]
+        relabs, lane_srcs = [], []
+        for combo in itertools.product(*per_group):
+            relab = np.arange(n_nodes, dtype=np.int64)
+            lane_src = np.arange(nw, dtype=np.int64)
+            for (g, base, count), sigma in zip(groups, combo):
+                # new position j holds old member sigma[j]
+                for j in range(count):
+                    relab[base + sigma[j]] = base + j
+                kind = next(k for k in self.nodes if k.name == g)
+                for j in range(count):
+                    for f in kind.fields:
+                        dst, size = table[(g, j, f.name)]
+                        src, _ = table[(g, sigma[j], f.name)]
+                        lane_src[dst:dst + size] = np.arange(
+                            src, src + size)
+                # Group-indexed array fields permute their ELEMENTS
+                # with the group (per-member bitmaps stay coherent).
+                # Restricted to fields on kinds OUTSIDE the group
+                # itself (validated below), so every assignment reads
+                # original (identity) positions — no composition.
+                for kind2, i2 in self._instances():
+                    for f in kind2.fields:
+                        if f.index_group != g:
+                            continue
+                        if kind2.name == g:
+                            raise SpecError(
+                                f"field {f.name!r}: index_group on a "
+                                f"kind inside its own symmetry group "
+                                f"{g!r} is unsupported",
+                                spec=self.name, kind=kind2.name,
+                                field=f.name, code="C5")
+                        o2, _ = table[(kind2.name, i2, f.name)]
+                        for j in range(count):
+                            lane_src[o2 + j] = o2 + sigma[j]
+            relabs.append(relab)
+            lane_srcs.append(lane_src)
+        # Identity permutation first (the canonicalizer's cheap first
+        # candidate); itertools.product with sorted permutations
+        # yields it first already, but pin it explicitly.
+        order = sorted(range(len(relabs)),
+                       key=lambda i: 0 if (relabs[i]
+                                           == np.arange(n_nodes)).all()
+                       else 1)
+        return SymmetrySpec(
+            relab=np.stack([relabs[i] for i in order]),
+            lane_src=np.stack([lane_srcs[i] for i in order]),
+            groups=tuple((g, b, c) for g, b, c in groups))
 
     # ------------------------------------------------------------ compile
 
@@ -512,6 +721,8 @@ class ProtocolSpec:
             name=self.name,
             n_nodes=n_nodes,
             node_width=nw,
+            lane_domains=self._lane_domains(),
+            symmetry=self._symmetry_spec(table),
             msg_width=self._mw,
             timer_width=self._tw,
             net_cap=self.net_cap,

@@ -185,6 +185,16 @@ class TensorProtocol:
     # min_ms, max_ms).  Addresses follow the twin's parity-test naming.
     decode_message: Optional[Callable] = None
     decode_timer: Optional[Callable] = None
+    # Declared per-lane value domains (ISSUE 15, tpu/packing.py):
+    # {"nodes": [...], "msg": [...], "timer": [...], "exc": (lo, hi)}
+    # with (lo, hi) or None per lane — the input to the bit-packed
+    # frontier encoding.  None (hand twins) derives the identity
+    # descriptor: the packed path is a traced no-op.
+    lane_domains: Optional[dict] = None
+    # Symmetry groups (ISSUE 15, tpu/symmetry.py SymmetrySpec):
+    # permutation tables over node ids/lanes for the opt-in
+    # canonicalize-before-fingerprint pass.  None = no groups.
+    symmetry: Optional[object] = None
 
 
 @dataclasses.dataclass
@@ -296,6 +306,22 @@ class SearchOutcome:
     lane: Optional[int] = None
     lane_width: Optional[int] = None
     lane_share: Optional[float] = None
+    # Capacity round 2 (ISSUE 15, tpu/packing.py / tpu/symmetry.py):
+    # HBM bytes per stored frontier row under the engine's encoding
+    # (packed when the spec declares domains), the unpacked reference,
+    # their ratio (the capacity multiplier at fixed HBM), and the
+    # symmetry-quotient accounting — the canonicalize pass's
+    # permutation count (0 = reduction off; unique_states is then the
+    # CANONICAL orbit count, strictly <= the raw count).
+    bytes_per_state: Optional[int] = None
+    bytes_per_state_unpacked: Optional[int] = None
+    pack_ratio: Optional[float] = None
+    symmetry_perms: int = 0
+    # Async spill drain (ISSUE 15c, tpu/spill.py): host ms inside
+    # drain jobs vs ms the driver actually blocked waiting for them —
+    # the gap is drain work overlapped with device compute.
+    spill_drain_ms: int = 0
+    spill_wait_ms: int = 0
 
     @property
     def dropped_states(self) -> int:
@@ -752,7 +778,9 @@ class TensorSearch:
                  checkpoint_path: Optional[str] = None,
                  checkpoint_every: int = 0,
                  spill=None,
-                 telemetry=None):
+                 telemetry=None,
+                 packed: Optional[bool] = None,
+                 symmetry: Optional[bool] = None):
         self.p = protocol
         # Unified telemetry (tpu/telemetry.py): when attached — here or
         # via ``Telemetry.attach(search)`` — every ``_dispatch`` call
@@ -858,6 +886,47 @@ class TensorSearch:
                      p.node_width + p.net_cap * p.msg_width
                      + p.n_nodes * p.timer_cap * p.timer_width)
         self.lanes = self._off[2] + 1
+        # Bit-packed frontier encoding (ISSUE 15a, tpu/packing.py): ON
+        # by default — protocols with no declared domains derive the
+        # IDENTITY descriptor (self._pk stays None, traced programs
+        # unchanged), so only spec-compiled twins with bounds actually
+        # pack.  The device wave loop stores cur/nxt (and the spill
+        # spool + checkpoints) at ``self.plane`` words/row; handlers,
+        # predicates, and fingerprints always see the unpacked int32
+        # view, decoded in-register at expand time — bit-identical
+        # unique/explored/verdict to the unpacked path by construction.
+        from dslabs_tpu.tpu import packing as packing_mod
+
+        if packed is None:
+            packed = os.environ.get(
+                "DSLABS_PACKED", "1").strip().lower() not in (
+                "0", "off", "false", "no")
+        pk = (packing_mod.derive_packing(protocol, self.lanes)
+              if packed else None)
+        self._pk = None if (pk is None or pk.identity) else pk
+        self.plane = (self._pk.words if self._pk is not None
+                      else self.lanes)
+        # Symmetry reduction (ISSUE 15b, tpu/symmetry.py): OPT-IN and
+        # default OFF — canonical unique counts differ from raw counts
+        # by design, so the pinned lab counts stay untouched unless a
+        # caller asks.  When on, every fingerprint site (expand, root,
+        # spill keys — and through _expand_chunk, the sharded
+        # owner-hash) hashes the canonical orbit representative.
+        if symmetry is None:
+            symmetry = os.environ.get(
+                "DSLABS_SYMMETRY", "").strip().lower() in (
+                "1", "on", "true", "yes")
+        if symmetry:
+            if protocol.symmetry is None:
+                raise ValueError(
+                    f"{protocol.name}: symmetry=True but the protocol "
+                    "declares no symmetry groups (ProtocolSpec("
+                    "symmetry=...))")
+            from dslabs_tpu.tpu.symmetry import build_canonicalizer
+
+            self._canon = build_canonicalizer(protocol, self._off)
+        else:
+            self._canon = None
         # Per-level (parent row, event id) spill for trace reconstruction
         # (SURVEY §8.1; SearchState.java:361-474). Populated by run() when
         # record_trace is set; consumed by tpu/trace.py.
@@ -938,6 +1007,28 @@ class TensorSearch:
         # and profiler cover the kernel itself.
         sites["visited.insert"] = visited_mod.dispatch_site_program(
             self.visited_cap, C * self._num_events())
+        # Capacity round 2 (ISSUE 15): the pack/unpack codecs and the
+        # symmetry canonicalize pass are fused INTO the step programs
+        # above, but register standalone too (like visited.insert) so
+        # the jaxpr auditor (J0-J5) and the profiler's hot-site table
+        # cover the codec lowerings themselves.
+        if self._pk is not None:
+            pk = self._pk
+            rows_sds = jax.ShapeDtypeStruct((C, self.lanes), jnp.int32)
+            packed_sds = jax.ShapeDtypeStruct((C, self.plane),
+                                              jnp.int32)
+            sites["packing.pack"] = dict(
+                fn=jax.jit(pk.pack_jnp), args=(rows_sds,), donate=(),
+                multi=False, builder=lambda: jax.jit(pk.pack_jnp))
+            sites["packing.unpack"] = dict(
+                fn=jax.jit(pk.unpack_jnp), args=(packed_sds,),
+                donate=(), multi=False,
+                builder=lambda: jax.jit(pk.unpack_jnp))
+        if self._canon is not None:
+            rows_sds = jax.ShapeDtypeStruct((C, self.lanes), jnp.int32)
+            sites["symmetry.canonicalize"] = dict(
+                fn=jax.jit(self._canon), args=(rows_sds,), donate=(),
+                multi=False, builder=lambda: jax.jit(self._canon))
         return sites
 
     def _dispatch(self, tag: str, fn, *args):
@@ -974,7 +1065,9 @@ class TensorSearch:
                                         self.record_trace),
             f"chunk={self.chunk}", f"fcap={self.frontier_cap}",
             f"vcap={self.visited_cap}",
-            f"ev={self._ev_msg},{self._ev_tmr}"])
+            f"ev={self._ev_msg},{self._ev_tmr}",
+            f"enc={self._frontier_encoding()}",
+            f"sym={self.p.symmetry.n_perms if self._canon is not None else 0}"])
 
     def _cancelled(self) -> bool:
         """Portfolio-lane cancellation (tpu/supervisor.py portfolio
@@ -989,11 +1082,16 @@ class TensorSearch:
 
     def _ckpt_fingerprint(self) -> str:
         """The config identity a dump must share to be resumable here
-        (engine-agnostic by design — see tpu/checkpoint.py)."""
+        (engine-agnostic by design — see tpu/checkpoint.py).  The
+        symmetry-reduction flag participates: canonical unique counts
+        describe the QUOTIENT space, so a reduced dump must never
+        silently resume an unreduced search (or vice versa)."""
         from dslabs_tpu.tpu import checkpoint as ckpt_mod
 
-        return ckpt_mod.config_fingerprint(self.p, self.strict,
-                                           self.record_trace)
+        return ckpt_mod.config_fingerprint(
+            self.p, self.strict, self.record_trace,
+            symmetry=(self.p.symmetry.n_perms
+                      if self._canon is not None else 0))
 
     def has_resumable_checkpoint(self) -> bool:
         """Existence + fingerprint check WITHOUT loading the arrays."""
@@ -1006,7 +1104,12 @@ class TensorSearch:
 
     def _load_ckpt(self):
         """Load + verify the dump; ``None`` when no file exists, a loud
-        CheckpointMismatch when it belongs to a different config."""
+        CheckpointMismatch when it belongs to a different config.  The
+        returned checkpoint's frontier is ALWAYS normalized to raw
+        (unpacked) rows — packed dumps decode here (loudly when the
+        live engine itself is unpacked), so every consumer (device
+        carry, host loop, spill spool, lanes) converts from one
+        canonical form."""
         from dslabs_tpu.tpu import checkpoint as ckpt_mod
 
         if not self.checkpoint_path:
@@ -1014,7 +1117,53 @@ class TensorSearch:
         ck = ckpt_mod.load(self.checkpoint_path, self._ckpt_fingerprint())
         if ck is not None:
             self._resumed_from_depth = ck.depth
+            self._normalize_ckpt_frontier(ck)
         return ck
+
+    def _normalize_ckpt_frontier(self, ck) -> None:
+        """Decode a dump's frontier rows to raw int32 lanes per its
+        ``frontier_encoding`` marker (ISSUE 15a).  Cross-encoding
+        resume is a LOUD conversion; an encoding this protocol cannot
+        derive (foreign domain declarations) is a loud refusal —
+        never a silent reinterpretation of packed bytes as lanes."""
+        from dslabs_tpu.tpu import checkpoint as ckpt_mod
+        from dslabs_tpu.tpu import packing as packing_mod
+
+        enc = "raw"
+        if ck.extra and "frontier_encoding" in ck.extra:
+            enc = np.asarray(ck.extra["frontier_encoding"]
+                             ).item()
+            if isinstance(enc, bytes):
+                enc = enc.decode()
+            ck.extra = {k: v for k, v in ck.extra.items()
+                        if k != "frontier_encoding"} or None
+        if enc == "raw":
+            if len(ck.frontier) and ck.frontier.shape[1] != self.lanes:
+                raise ckpt_mod.CheckpointMismatch(
+                    f"checkpoint frontier rows are "
+                    f"{ck.frontier.shape[1]} lanes wide, this "
+                    f"protocol's are {self.lanes} — foreign dump")
+            return
+        pk = self._pk or packing_mod.derive_packing(self.p, self.lanes)
+        if pk.identity or pk.signature() != enc:
+            raise ckpt_mod.CheckpointMismatch(
+                f"refusing to resume packed checkpoint: frontier "
+                f"encoding {enc!r} does not match this protocol's "
+                f"derived descriptor "
+                f"{pk.signature() if not pk.identity else 'raw'!r} "
+                "(domain declarations changed, or the dump belongs to "
+                "a different spec) — delete the file or restore the "
+                "declarations")
+        if self._pk is None:
+            import warnings
+
+            warnings.warn(
+                f"{self.p.name}: resuming a PACKED checkpoint "
+                f"({enc}) on an unpacked engine — converting the "
+                f"frontier rows (loud by contract, never silent)",
+                RuntimeWarning, stacklevel=3)
+        ck.frontier = pk.unpack_np(ck.frontier) if len(ck.frontier) \
+            else np.zeros((0, self.lanes), np.int32)
 
     @property
     def _ckpt_writer(self):
@@ -1029,13 +1178,20 @@ class TensorSearch:
                    depth: int, explored: int, elapsed: float,
                    vis_over: int = 0) -> None:
         """Queue one async atomic dump (skip-if-busy, never a queue);
-        arrays must already be host copies."""
+        arrays must already be host copies.  Frontier rows are in the
+        engine's NATIVE encoding (packed when the spec declares
+        domains) — the marker rides the dump so any engine can
+        convert on resume (loud, never silent)."""
         from dslabs_tpu.tpu import checkpoint as ckpt_mod
 
+        extra = None
+        if self._pk is not None:
+            extra = {"frontier_encoding": np.bytes_(
+                self._frontier_encoding().encode())}
         ck = ckpt_mod.SearchCheckpoint(
             fingerprint=self._ckpt_fingerprint(), depth=depth,
             explored=explored, elapsed=elapsed, frontier=frontier,
-            visited_keys=visited_keys, vis_over=vis_over)
+            visited_keys=visited_keys, vis_over=vis_over, extra=extra)
         self._ckpt_writer.kick(
             lambda: ckpt_mod.save(self.checkpoint_path, ck))
 
@@ -1097,6 +1253,48 @@ class TensorSearch:
         stride): the compacted budget when ev_budget is set, else the full
         event grid."""
         return self._ev_slots
+
+    # ------------------------------------------ packing / symmetry
+
+    def _canon_rows(self, rows):
+        """Symmetry hash-step hook: the canonical orbit representative
+        of each row when the reduction is on, the rows themselves
+        otherwise.  ONLY fingerprints flow through here — stored
+        states stay the real reachable states."""
+        return rows if self._canon is None else self._canon(rows)
+
+    def _canonical_root_fp(self, state):
+        """[1, 4] fingerprints of a batch-1 state pytree through the
+        SAME canonicalize-then-hash step the expand programs use."""
+        from dslabs_tpu.tpu.kernels import fingerprint_rows
+
+        return fingerprint_rows(self._canon_rows(flatten_state(state)))
+
+    def _pack_rows(self, rows):
+        """[N, lanes] -> [N, plane] native frontier-storage encoding."""
+        return rows if self._pk is None else self._pk.pack_jnp(rows)
+
+    def _unpack_rows(self, rows):
+        return rows if self._pk is None else self._pk.unpack_jnp(rows)
+
+    def _frontier_encoding(self) -> str:
+        """The marker dumped with every checkpoint's frontier rows."""
+        return "raw" if self._pk is None else self._pk.signature()
+
+    def _stamp_capacity(self, out: "SearchOutcome") -> "SearchOutcome":
+        """Attach the capacity-round-2 accounting every verdict
+        carries (bench/STATUS render it; telemetry compare guards
+        bytes_per_state)."""
+        out.bytes_per_state = (self._pk.bytes_per_state
+                               if self._pk is not None
+                               else self.lanes * 4)
+        out.bytes_per_state_unpacked = self.lanes * 4
+        out.pack_ratio = round(
+            out.bytes_per_state_unpacked / max(out.bytes_per_state, 1),
+            3)
+        out.symmetry_perms = (self.p.symmetry.n_perms
+                              if self._canon is not None else 0)
+        return out
 
     def _msg_step_raw(self, row: jnp.ndarray, net_slot: jnp.ndarray):
         """Handler half of a message step (no network merge): ONE state
@@ -1398,7 +1596,10 @@ class TensorSearch:
             [msg_ids, jnp.where(tmr_ids >= 0, p.net_cap + tmr_ids, -1)],
             axis=1)                                        # [C, Bm+Bt]
         overflow = jnp.sum(overs * valids.astype(jnp.int32))
-        fp = row_fingerprints(rows)
+        # Symmetry hash step (ISSUE 15b): fingerprints — and through
+        # them the sharded owner-hash — key on the canonical orbit
+        # representative; the stored rows stay the real states.
+        fp = row_fingerprints(self._canon_rows(rows))
         if stop == "fp":
             return _cut(fp, valids)
 
@@ -1591,6 +1792,7 @@ class TensorSearch:
             out = self._run_device(check_initial, initial,
                                    resume=resume)
             eng = "device"
+        self._stamp_capacity(out)
         if tel is not None:
             # Trace stamp at span emission (ISSUE 13): the verdict
             # carries the recorder's causal-trace identity — a host
@@ -1639,7 +1841,7 @@ class TensorSearch:
             frontier_n = len(ck.frontier)
             parent_rows = np.full(max(frontier_n, 1), -1, dtype=np.int64)
         else:
-            fp0 = np.asarray(state_fingerprints(state))
+            fp0 = np.asarray(self._canonical_root_fp(state))
             visited = host_keys(fp0)
             # Diagnostic stash: the parity tests compare this loop's
             # exact visited SET against the device table's keys.
@@ -1833,6 +2035,8 @@ class TensorSearch:
         p = self.p
         C = self.chunk
         lanes = self.lanes
+        plane = self.plane
+        pk = self._pk
         # Spill mode (tpu/spill.py): a chunk that would overflow the
         # frontier buffer or leave table keys unresolved ABORTS — every
         # carry entry (the visited table included) reverts to its
@@ -1847,7 +2051,12 @@ class TensorSearch:
             cur, cur_n = carry["cur"], carry["cur_n"][0]
             j = carry["j"][0]
             start = j * C
-            rows_chunk = jax.lax.dynamic_slice(cur, (start, 0), (C, lanes))
+            # The frontier buffers hold PACKED rows (ISSUE 15a): the
+            # chunk decodes in-register right here — handlers, flags,
+            # and fingerprints all operate on the int32 view.
+            rows_chunk = jax.lax.dynamic_slice(cur, (start, 0),
+                                               (C, plane))
+            rows_chunk = self._unpack_rows(rows_chunk)
             valid = (start + jnp.arange(C)) < cur_n
             ev_pass = carry["evp"][0]
             # dedup=False: the visited table below is the dedup
@@ -1905,7 +2114,18 @@ class TensorSearch:
             spos = jnp.cumsum(sel) - 1
             nxt_n = carry["nxt_n"][0]
             sdst = jnp.where(sel & (nxt_n + spos < cap), nxt_n + spos, cap)
-            nxt = carry["nxt"].at[sdst].set(rows)
+            # Successors re-encode to the packed storage form before
+            # the frontier append.  A live value OUTSIDE its declared
+            # domain is counted into the overflow scalar — a wrong
+            # spec bound is a loud CapacityOverflow, never silent
+            # state corruption.
+            if pk is not None:
+                rows_store, pack_bad = pk.pack_jnp(rows, count_bad=True)
+                overflow = overflow + jnp.sum(
+                    pack_bad * sel.astype(jnp.int32))
+            else:
+                rows_store = rows
+            nxt = carry["nxt"].at[sdst].set(rows_store)
             n_sel = jnp.sum(sel).astype(jnp.int32)
             f_drop = jnp.maximum(nxt_n + n_sel - cap, 0)
             n_sel = n_sel - f_drop
@@ -1958,13 +2178,13 @@ class TensorSearch:
     def _build_dev_promote(self, cap: int):
         """Between-wave frontier promotion (nxt -> cur), donated like the
         step so the buffers swap in place."""
-        lanes = self.lanes
+        plane = self.plane
 
         def promote(carry):
             out = dict(carry)
             out["cur"] = carry["nxt"][:cap]
             out["cur_n"] = carry["nxt_n"]
-            out["nxt"] = jnp.zeros((cap + 1, lanes), jnp.int32)
+            out["nxt"] = jnp.zeros((cap + 1, plane), jnp.int32)
             out["nxt_n"] = jnp.zeros((1,), jnp.int32)
             out["j"] = jnp.zeros((1,), jnp.int32)
             out["evp"] = jnp.zeros((1,), jnp.int32)
@@ -1974,25 +2194,28 @@ class TensorSearch:
 
     def _build_dev_init(self, cap: int):
         """Carry built ON DEVICE inside one jitted program: only the root
-        row crosses the host boundary; the root key is inserted through
-        the same shared table code the waves use."""
+        row crosses the host boundary (UNPACKED — the build packs it
+        for storage); the root key is inserted through the same shared
+        table code the waves use."""
         lanes = self.lanes
+        plane = self.plane
         V = self.visited_cap
         nf = len(self._flag_names)
 
         def build(row0):
             from dslabs_tpu.tpu.kernels import fingerprint_rows
 
-            fp0 = fingerprint_rows(row0)                 # [1, 4]
+            fp0 = fingerprint_rows(self._canon_rows(row0))   # [1, 4]
+            row0s = self._pack_rows(row0)
             table, _, _ = visited_mod.insert(
                 visited_mod.empty_table(V), fp0, jnp.ones((1,), bool))
             return {
-                "cur": jnp.zeros((cap, lanes), jnp.int32).at[0].set(
-                    row0[0]),
+                "cur": jnp.zeros((cap, plane), jnp.int32).at[0].set(
+                    row0s[0]),
                 "cur_n": jnp.ones((1,), jnp.int32),
                 "j": jnp.zeros((1,), jnp.int32),
                 "evp": jnp.zeros((1,), jnp.int32),
-                "nxt": jnp.zeros((cap + 1, lanes), jnp.int32),
+                "nxt": jnp.zeros((cap + 1, plane), jnp.int32),
                 "nxt_n": jnp.zeros((1,), jnp.int32),
                 "visited": table,
                 "vis_n": jnp.ones((1,), jnp.int32),
@@ -2118,12 +2341,16 @@ class TensorSearch:
         never-dumped accumulators come back empty — exactly their state
         at a wave boundary."""
         lanes = self.lanes
+        plane = self.plane
         V = self.visited_cap
         nf = len(self._flag_names)
         n = len(ck.frontier)
-        cur = np.zeros((cap, lanes), np.int32)
+        cur = np.zeros((cap, plane), np.int32)
         if n:
-            cur[:n] = ck.frontier
+            # ck.frontier is normalized-raw (_load_ckpt); re-encode to
+            # the engine's native packed storage.
+            cur[:n] = (self._pk.pack_np(ck.frontier)
+                       if self._pk is not None else ck.frontier)
         table, n_ins, n_unres = visited_mod.build_table(
             V, ck.visited_keys)
         if n_unres:
@@ -2137,7 +2364,7 @@ class TensorSearch:
             "cur_n": jnp.asarray([n], jnp.int32),
             "j": jnp.zeros((1,), jnp.int32),
             "evp": jnp.zeros((1,), jnp.int32),
-            "nxt": jnp.zeros((cap + 1, lanes), jnp.int32),
+            "nxt": jnp.zeros((cap + 1, plane), jnp.int32),
             "nxt_n": jnp.zeros((1,), jnp.int32),
             "visited": table,
             "vis_n": jnp.asarray([n_ins], jnp.int32),
@@ -2158,7 +2385,7 @@ class TensorSearch:
         if nxt_n:
             frontier = np.asarray(carry["cur"][:nxt_n])
         else:
-            frontier = np.zeros((0, self.lanes), np.int32)
+            frontier = np.zeros((0, self.plane), np.int32)
         table = np.asarray(carry["visited"])[:-1]
         occ = ~(table == visited_mod.MAXU32).all(axis=1)
         self._kick_ckpt(frontier, table[occ], depth, explored, elapsed,
@@ -2373,7 +2600,7 @@ class TensorSearch:
 
         def reset(carry):
             out = dict(carry)
-            out["nxt"] = jnp.zeros((cap + 1, lanes), jnp.int32)
+            out["nxt"] = jnp.zeros((cap + 1, self.plane), jnp.int32)
             out["nxt_n"] = jnp.zeros((1,), jnp.int32)
             out["f_drop"] = jnp.zeros((1,), jnp.int32)
             return out
@@ -2399,9 +2626,10 @@ class TensorSearch:
         return min(m, cap)
 
     def _spill_keys_of(self, rows: np.ndarray, cap: int) -> np.ndarray:
-        """Fingerprints of host rows via the SAME device fp program the
-        engines hash with (bit-identical keys; jitted per pow2 row
-        bucket so compiles stay O(log cap))."""
+        """Fingerprints of host rows (UNPACKED lanes) via the SAME
+        device fp program the engines hash with — canonicalize pass
+        included, so spill-tier keys match the expand keys bit-exactly
+        (jitted per pow2 row bucket so compiles stay O(log cap))."""
         from dslabs_tpu.tpu.kernels import fingerprint_rows
 
         n = len(rows)
@@ -2411,7 +2639,8 @@ class TensorSearch:
         progs = self._spill_progs(cap)
         fn = progs["fp"].get(m)
         if fn is None:
-            fn = progs["fp"][m] = jax.jit(fingerprint_rows)
+            fn = progs["fp"][m] = jax.jit(
+                lambda r: fingerprint_rows(self._canon_rows(r)))
         pad = np.zeros((m, rows.shape[1]), np.int32)
         pad[:n] = rows
         return np.asarray(fn(jnp.asarray(pad)))[:n]
@@ -2445,27 +2674,44 @@ class TensorSearch:
 
     def _spill_drain(self, carry, nxt_n: int, cap: int):
         """Mid-level or boundary drain: read nxt's occupied prefix back
-        (ONE batched readback), refilter against the host tier (the
-        corrected promote mask), drop exception/pruned rows, spool the
-        keepers for deferred re-expansion, and reset nxt on device."""
+        (ONE batched readback of PACKED rows + their canonical keys),
+        reset nxt on device, and hand the host half — refilter against
+        the tier, drop exception/pruned rows, spool the keepers — to
+        the spill manager's drain queue.  With the async gear (ISSUE
+        15c, default on) the device re-dispatches the aborted chunk
+        IMMEDIATELY after the reset while the host answers the drain
+        in the background; ordering through the single worker keeps
+        the refilter-before-next-eviction invariant, so counts stay
+        exact."""
         sp = self._spill
+        pk = self._pk
 
         def fetch():
             rows = np.asarray(carry["nxt"])[:nxt_n]
-            return rows, self._spill_keys_of(rows, cap)
+            rows_u = pk.unpack_np(rows) if pk is not None else rows
+            return rows, self._spill_keys_of(rows_u, cap)
 
         if nxt_n:
             rows, keys = self._dispatch("device.spill_drain", fetch)
-            kept = sp.refilter(rows, keys)
-            if len(kept):
-                kept = kept[self._spill_keep_mask(kept, cap)]
-            sp.spool(kept)
+
+            def host_half():
+                kept = sp.refilter(rows, keys)
+                if len(kept):
+                    ku = (pk.unpack_np(kept) if pk is not None
+                          else kept)
+                    kept = kept[self._spill_keep_mask(ku, cap)]
+                sp.spool(kept)
+
+            sp.submit_drain(host_half)
         return self._dispatch("device.spill_drain",
                               self._spill_progs(cap)["reset"], carry)
 
     def _spill_evict_dev(self, carry, cap: int):
         """Bulk eviction: occupied table lines -> host tier, table and
-        vis_n restart empty (a fresh epoch)."""
+        vis_n restart empty (a fresh epoch).  The tier absorb rides
+        the same ordered drain queue as the refilters — every drained
+        batch is refiltered against the PRE-eviction tier (the
+        exactness invariant, docs/capacity.md)."""
         sp = self._spill
 
         def fetch():
@@ -2473,22 +2719,23 @@ class TensorSearch:
                 np.asarray(carry["visited"]))
 
         occ = self._dispatch("device.spill_evict", fetch)
-        sp.evict(occ)
+        sp.submit_drain(lambda: sp.evict(occ), evict=True)
         return self._dispatch("device.spill_evict",
                               self._spill_progs(cap)["evict"], carry)
 
     def _spill_inject(self, carry, rows: np.ndarray, cap: int):
-        """(Re-)inject a host frontier segment as the live cur — the
-        deferred re-expansion wave, at unchanged BFS depth."""
+        """(Re-)inject a host frontier segment (native packed rows) as
+        the live cur — the deferred re-expansion wave, at unchanged
+        BFS depth."""
         n = len(rows)
         m = self._pow2_bucket(n, cap)
-        lanes = self.lanes
+        plane = self.plane
         progs = self._spill_progs(cap)
         fn = progs["inject"].get(m)
         if fn is None:
             def inject(c, seg, nn):
                 out = dict(c)
-                out["cur"] = jnp.zeros((cap, lanes),
+                out["cur"] = jnp.zeros((cap, plane),
                                        jnp.int32).at[:m].set(seg)
                 out["cur_n"] = nn
                 out["j"] = jnp.zeros((1,), jnp.int32)
@@ -2496,7 +2743,7 @@ class TensorSearch:
                 return out
 
             fn = progs["inject"][m] = jax.jit(inject, donate_argnums=0)
-        pad = np.zeros((m, lanes), np.int32)
+        pad = np.zeros((m, plane), np.int32)
         pad[:n] = rows
         carry = self._dispatch("device.spill_reinject", fn, carry,
                                jnp.asarray(pad),
@@ -2556,12 +2803,18 @@ class TensorSearch:
 
         sp = self._spill
         occ = visited_mod.host_occupied(np.asarray(carry["visited"]))
+        extra = sp.checkpoint_extra()
+        if self._pk is not None:
+            # Spool segments are stored in the native packed encoding;
+            # the marker rides the dump for loud cross-resume.
+            extra["frontier_encoding"] = np.bytes_(
+                self._frontier_encoding().encode())
         ckpt_mod.save(self.checkpoint_path, ckpt_mod.SearchCheckpoint(
             fingerprint=self._ckpt_fingerprint(), depth=depth,
             explored=explored, elapsed=elapsed,
-            frontier=sp.spool_cur.concat(self.lanes),
+            frontier=sp.spool_cur.concat(self.plane),
             visited_keys=sp.checkpoint_keys(occ),
-            extra=sp.checkpoint_extra()))
+            extra=extra))
 
     def _spill_carry_from_ckpt(self, ck, cap: int):
         """Spill-mode resume: ALL dumped keys load into the host tier,
@@ -2571,16 +2824,22 @@ class TensorSearch:
         sp = self._spill
         sp.restore(ck.visited_keys, ck.extra)
         rows = np.asarray(ck.frontier, np.int32)
+        if self._pk is not None:
+            # The loader normalized the dump to raw lanes; the spool
+            # holds the engine's native packed rows.
+            rows = self._pk.pack_np(rows) if len(rows) else \
+                np.zeros((0, self.plane), np.int32)
         for i in range(0, len(rows), cap):
             sp.spool_cur.push(rows[i:i + cap])
         lanes = self.lanes
+        plane = self.plane
         nf = len(self._flag_names)
         carry = {
-            "cur": jnp.zeros((cap, lanes), jnp.int32),
+            "cur": jnp.zeros((cap, plane), jnp.int32),
             "cur_n": jnp.zeros((1,), jnp.int32),
             "j": jnp.zeros((1,), jnp.int32),
             "evp": jnp.zeros((1,), jnp.int32),
-            "nxt": jnp.zeros((cap + 1, lanes), jnp.int32),
+            "nxt": jnp.zeros((cap + 1, plane), jnp.int32),
             "nxt_n": jnp.zeros((1,), jnp.int32),
             "visited": visited_mod.empty_table(self.visited_cap),
             "vis_n": jnp.zeros((1,), jnp.int32),
@@ -2620,6 +2879,10 @@ class TensorSearch:
             explored = ck.explored
             unique = sp.unique(0)
         else:
+            # Fresh start: run N must not see run N-1's tier/spool
+            # (the warm-up-then-measure reuse pattern) — restore()
+            # handles the resume case above.
+            sp.reset_run()
             carry = self._dispatch("device.init", init,
                                    flatten_state(state))
             depth = 0
@@ -2641,6 +2904,7 @@ class TensorSearch:
                 return out
             depth += 1
             self._current_depth = depth
+            t_lvl = time.time()
             # ---- expand the level: cur, then every spooled segment of
             # the same level as deferred re-expansion waves.
             while True:
@@ -2682,6 +2946,32 @@ class TensorSearch:
                 if seg is None:
                     break
                 carry, n_cur = self._spill_inject(carry, seg, cap)
+            tel = getattr(self, "_telemetry", None)
+            if tel is not None:
+                from dslabs_tpu.tpu import telemetry as tel_mod
+
+                # Per-level record WITH the spill-overlap wall split
+                # (ISSUE 15c satellite): drain_wall = host seconds in
+                # drain jobs this level, drain_wait = seconds the
+                # driver actually blocked — their gap is host work
+                # hidden behind device compute, so the host drain wall
+                # is no longer additive with the chunk wall.
+                delta = [explored - getattr(self, "_spill_prev_explored",
+                                            0)]
+                self._spill_prev_explored = explored
+                tel.on_level("device", {
+                    "depth": depth,
+                    "wall": round(time.time() - t_lvl, 4),
+                    "explored": explored, "unique": unique,
+                    "next_frontier": int(nxt_n),
+                    "load_factor": round(vis_n / self.visited_cap, 4),
+                    "spill": sp.level_walls(),
+                    "per_device": {
+                        "explored": delta, "frontier": [int(nxt_n)],
+                        "load_factor": [round(vis_n / self.visited_cap,
+                                              4)],
+                        "drops": [0]},
+                    "skew": {"explored": tel_mod.skew_metrics(delta)}})
             # ---- level boundary.  Fast path until the tier/spool is
             # live: the plain on-device promote.
             if not (sp.active

@@ -376,6 +376,10 @@ class LaneSearch(TensorSearch):
                 ck = ckpt_mod.load(job.checkpoint_path,
                                    self._ckpt_fingerprint())
                 if ck is not None:
+                    # Normalize the dump's frontier encoding to raw
+                    # (loud cross-encoding conversion, ISSUE 15a) —
+                    # _carry_from_ckpt then re-packs to native.
+                    self._normalize_ckpt_frontier(ck)
                     if not len(ck.frontier):
                         out = SearchOutcome(
                             "SPACE_EXHAUSTED", ck.explored,
@@ -432,12 +436,20 @@ class LaneSearch(TensorSearch):
         if nxt_n:
             frontier = np.asarray(carry["cur"][i][:nxt_n])
         else:
-            frontier = np.zeros((0, self.lanes), np.int32)
+            frontier = np.zeros((0, self.plane), np.int32)
         occ = visited_mod.host_occupied(np.asarray(carry["visited"][i]))
+        extra = None
+        if self._pk is not None:
+            # Lane carries share the solo step body, so cur holds the
+            # PACKED encoding (ISSUE 15a) — mark the dump for loud
+            # cross-resume conversion like every other writer.
+            extra = {"frontier_encoding": np.bytes_(
+                self._frontier_encoding().encode())}
         ckpt_mod.save(ln.job.checkpoint_path, ckpt_mod.SearchCheckpoint(
             fingerprint=self._ckpt_fingerprint(), depth=ln.depth,
             explored=ln.last[0], elapsed=time.time() - ln.t0,
-            frontier=frontier, visited_keys=occ, vis_over=ln.last[2]))
+            frontier=frontier, visited_keys=occ, vis_over=ln.last[2],
+            extra=extra))
 
     # ----------------------------------------------------------------- run
 

@@ -188,7 +188,8 @@ class ShardedTensorSearch(TensorSearch):
                  row_exchange: Optional[bool] = None,
                  aot_warmup: Optional[bool] = None,
                  spill=None,
-                 telemetry=None):
+                 telemetry=None,
+                 symmetry: Optional[bool] = None):
         # Frontier checkpointing (SURVEY §5 "dump SoA tensors"): every
         # ``checkpoint_every`` levels the live carry — the OCCUPIED
         # frontier prefix, the occupied visited-table lines, and the
@@ -242,6 +243,14 @@ class ShardedTensorSearch(TensorSearch):
         # overflow and even strict runs skip the prefilter (it measured
         # ~60% of a loaded chunk step).  Multi-device strict keeps it:
         # per-owner buckets have only 2x-mean headroom.
+        # packed=False: the sharded carry (shards, routing buckets, the
+        # fused row exchange) stays raw int32 this round — packed
+        # checkpoints from the single-device engine still resume here
+        # through the loader's loud encoding conversion (engine.py
+        # _normalize_ckpt_frontier).  Symmetry DOES ride along: the
+        # canonicalize pass lives in the shared _expand_chunk hash
+        # step, so the owner-hash keys on canonical fingerprints and
+        # symmetric twins dedup on one owner.
         super().__init__(protocol, frontier_cap=frontier_cap,
                          chunk=chunk_per_device, max_depth=max_depth,
                          max_secs=max_secs,
@@ -250,7 +259,8 @@ class ShardedTensorSearch(TensorSearch):
                          visited_cap=visited_cap, strict=strict,
                          checkpoint_path=checkpoint_path,
                          checkpoint_every=checkpoint_every,
-                         spill=spill, telemetry=telemetry)
+                         spill=spill, telemetry=telemetry,
+                         packed=False, symmetry=symmetry)
         # Host-RAM spill tier (tpu/spill.py, docs/capacity.md): the
         # carry gains an ``f_full`` abort-code lane, the chunk step
         # aborts-and-reverts GLOBALLY (a psum'd decision — owner-side
@@ -971,7 +981,10 @@ class ShardedTensorSearch(TensorSearch):
         """Root row + sanitized key + its owner device and home slot —
         shared by _init_carry and the AOT warm-up."""
         rows0 = flatten_state(state)                     # [1, lanes] device
-        fp0 = np.asarray(state_fingerprints(state), np.uint32)  # [1, 4]
+        # Root key through the same canonicalize-then-hash step the
+        # expand programs use (symmetry reduction, ISSUE 15b).
+        fp0 = np.asarray(self._canonical_root_fp(state),
+                         np.uint32)                      # [1, 4]
         owner = int(fp0[0, 0]) % self.n_devices
         key0 = visited_mod.host_sanitize_key(fp0[0])
         # The root key sits in slot 0 of its home BUCKET — addressing
@@ -1540,10 +1553,16 @@ class ShardedTensorSearch(TensorSearch):
 
         rows, keys = self._dispatch("sharded.spill_drain", fetch)
         if len(rows):
-            kept = sp.refilter(rows, keys)
-            if len(kept):
-                kept = kept[self._spill_keep_mask(kept, F)]
-            sp.spool(kept)
+            # Async drain (ISSUE 15c): the host half rides the ordered
+            # worker while the mesh re-dispatches — see engine.py
+            # _spill_drain for the exactness argument.
+            def host_half():
+                kept = sp.refilter(rows, keys)
+                if len(kept):
+                    kept = kept[self._spill_keep_mask(kept, F)]
+                sp.spool(kept)
+
+            sp.submit_drain(host_half)
         return self._dispatch("sharded.spill_drain",
                               self._sh_spill_progs()["reset"], carry)
 
@@ -1559,7 +1578,7 @@ class ShardedTensorSearch(TensorSearch):
                 [visited_mod.host_occupied(vis[d]) for d in range(D)])
 
         occ = self._dispatch("sharded.spill_evict", fetch)
-        sp.evict(occ)
+        sp.submit_drain(lambda: sp.evict(occ), evict=True)
         self._last_vis_max = 0
         return self._dispatch("sharded.spill_evict",
                               self._sh_spill_progs()["evict"], carry)
@@ -1658,7 +1677,7 @@ class ShardedTensorSearch(TensorSearch):
         self._level_records: List[dict] = []
         self._pd_prev_explored = [0] * self.n_devices
         self._root_fp = tuple(np.asarray(
-            state_fingerprints(state), np.uint32)[0].tolist())
+            self._canonical_root_fp(state), np.uint32)[0].tolist())
         if check_initial:
             out = self._check_initial(state, t0)
             if out is not None:
@@ -1671,6 +1690,7 @@ class ShardedTensorSearch(TensorSearch):
             out = self._run_levels(t0, state, resume)
             out.levels = self._level_records or None
             out.compile_secs = round(getattr(self, "compile_secs", 0.0), 3)
+            self._stamp_capacity(out)
             if self._spill_on:
                 self._spill.attach(out)
             if tel is not None:
@@ -1715,6 +1735,11 @@ class ShardedTensorSearch(TensorSearch):
                     vis_total = self._spill.unique(vis_total)
                 drops = int(np.asarray(carry["drops"]).sum())
             else:
+                if self._spill_on:
+                    # Fresh start: run N must not refilter against run
+                    # N-1's tier (engine-reuse pattern; the resumed
+                    # branch restores the tier from the dump instead).
+                    self._spill.reset_run()
                 carry = self._init_carry(state)
                 depth = 0
                 max_n = 1

@@ -25,7 +25,8 @@ from __future__ import annotations
 from dslabs_tpu.tpu.compiler import (Field, MessageType, NodeKind,
                                      ProtocolSpec, TimerType)
 
-__all__ = ["pingpong_spec", "clientserver_spec", "pb_spec"]
+__all__ = ["pingpong_spec", "clientserver_spec", "pb_spec",
+           "paxos_spec"]
 
 
 def pingpong_spec(workload_size: int = 2,
@@ -36,13 +37,17 @@ def pingpong_spec(workload_size: int = 2,
     ``never_done`` adds the NONE_DECIDED invariant (the violation-probe
     configuration)."""
     w = workload_size
+    # Declared domains (ISSUE 15, tpu/packing.py): k walks 1..w+1, the
+    # command index i walks 1..w — the packed frontier stores each in
+    # a few bits instead of a full int32 lane.
     spec = ProtocolSpec(
         "pingpong-gen",
         nodes=[NodeKind("server", 1, ()),
-               NodeKind("client", 1, (Field("k", init=1),))],
-        messages=[MessageType("REQ", ("i",)),
-                  MessageType("REPLY", ("i",))],
-        timers=[TimerType("PING", ("i",), 10, 10)],
+               NodeKind("client", 1, (Field("k", init=1, hi=w + 1),))],
+        messages=[MessageType("REQ", ("i",), bounds={"i": (0, w)}),
+                  MessageType("REPLY", ("i",), bounds={"i": (0, w)})],
+        timers=[TimerType("PING", ("i",), 10, 10,
+                          bounds={"i": (0, w)})],
         net_cap=8, timer_cap=4)
 
     @spec.on("server", "REQ")
@@ -86,13 +91,20 @@ def clientserver_spec(n_clients: int = 1, w: int = 1) -> ProtocolSpec:
     (tpu/protocols/clientserver.py): server state = per-client
     last-executed seq, client state = seq in flight."""
     nc = n_clients
+    # Declared domains (ISSUE 15): per-client last-executed seq a and
+    # in-flight seq k are bounded by the workload, client ids by NC —
+    # the packed frontier encoding derives its lane widths from these.
+    cb, sb = (0, max(nc - 1, 0)), (0, w)
     spec = ProtocolSpec(
         "clientserver-gen",
-        nodes=[NodeKind("server", 1, (Field("a", size=nc),)),
-               NodeKind("client", nc, (Field("k", init=1),))],
-        messages=[MessageType("REQ", ("c", "s")),
-                  MessageType("REPLY", ("c", "s"))],
-        timers=[TimerType("RETRY", ("s",), 100, 100)],
+        nodes=[NodeKind("server", 1, (Field("a", size=nc, hi=w),)),
+               NodeKind("client", nc, (Field("k", init=1, hi=w + 1),))],
+        messages=[MessageType("REQ", ("c", "s"),
+                              bounds={"c": cb, "s": sb}),
+                  MessageType("REPLY", ("c", "s"),
+                              bounds={"c": cb, "s": sb})],
+        timers=[TimerType("RETRY", ("s",), 100, 100,
+                          bounds={"s": sb})],
         net_cap=16, timer_cap=4)
 
     @spec.on("server", "REQ")
@@ -151,32 +163,51 @@ def pb_spec(ns: int = 2, n_clients: int = 1, w: int = 1) -> ProtocolSpec:
     NS, NC = ns, n_clients
     DEAD = 2
     amo_fields = tuple(f"a{c}" for c in range(NC))
+    # Declared domains (ISSUE 15): server/client ids, sync/acked bits,
+    # amo seqs, and rank are all tiny; view numbers and liveness ticks
+    # genuinely grow with depth and stay full int32 lanes (the packed
+    # encoding is per-lane — partial declarations still pay off).
+    sid, cid, seq = (0, NS), (0, max(NC - 1, 0)), (0, w)
+    amo_b = {f: seq for f in amo_fields}
     spec = ProtocolSpec(
         "pb-gen",
         nodes=[NodeKind("vs", 1, (
-                   Field("vn"), Field("prim"), Field("back"),
-                   Field("acked"), Field("nextrank"),
-                   Field("rank", size=NS), Field("ticks", size=NS))),
+                   Field("vn"), Field("prim", hi=NS),
+                   Field("back", hi=NS),
+                   Field("acked", hi=1), Field("nextrank", hi=NS),
+                   Field("rank", size=NS, hi=NS),
+                   Field("ticks", size=NS))),
                NodeKind("server", NS, (
-                   Field("svn", init=-1), Field("sp"), Field("sb"),
-                   Field("sync", init=1), Field("pc"), Field("ps"),
-                   Field("amo", size=NC))),
+                   Field("svn", init=-1), Field("sp", hi=NS),
+                   Field("sb", hi=NS),
+                   Field("sync", init=1, hi=1), Field("pc", hi=NC),
+                   Field("ps", hi=w),
+                   Field("amo", size=NC, hi=w))),
                NodeKind("client", NC, (
-                   Field("k", init=1), Field("cvn", init=-1),
-                   Field("cp"), Field("cb")))],
+                   Field("k", init=1, hi=w + 1),
+                   Field("cvn", init=-1),
+                   Field("cp", hi=NS), Field("cb", hi=NS)))],
         messages=[MessageType("PING", ("vn",)),
                   MessageType("GETVIEW", ()),
-                  MessageType("VIEWREPLY", ("vn", "prim", "back")),
-                  MessageType("REQ", ("c", "s")),
-                  MessageType("REPLY", ("c", "s")),
-                  MessageType("FWD", ("vn", "c", "s")),
-                  MessageType("FWDACK", ("vn", "c", "s")),
+                  MessageType("VIEWREPLY", ("vn", "prim", "back"),
+                              bounds={"prim": sid, "back": sid}),
+                  MessageType("REQ", ("c", "s"),
+                              bounds={"c": cid, "s": seq}),
+                  MessageType("REPLY", ("c", "s"),
+                              bounds={"c": cid, "s": seq}),
+                  MessageType("FWD", ("vn", "c", "s"),
+                              bounds={"c": cid, "s": seq}),
+                  MessageType("FWDACK", ("vn", "c", "s"),
+                              bounds={"c": cid, "s": seq}),
                   MessageType("XFER", ("vn", "prim", "back")
-                              + amo_fields),
+                              + amo_fields,
+                              bounds={"prim": sid, "back": sid,
+                                      **amo_b}),
                   MessageType("XFERACK", ("vn",))],
         timers=[TimerType("PINGCHECK", (), 100, 100),
                 TimerType("PING", (), 25, 25),
-                TimerType("CLIENT", ("s",), 100, 100)],
+                TimerType("CLIENT", ("s",), 100, 100,
+                          bounds={"s": seq})],
         net_cap=32, timer_cap=4)
 
     # ------------------------------------------------ ViewServer helpers
@@ -418,4 +449,96 @@ def pb_spec(ns: int = 2, n_clients: int = 1, w: int = 1) -> ProtocolSpec:
         return done
 
     spec.goals["CLIENTS_DONE"] = clients_done
+    return spec
+
+
+def paxos_spec(n_acceptors: int = 3, quorum: int = 0,
+               never_decided: bool = False) -> ProtocolSpec:
+    """Single-decree Paxos (one ballot, one proposer, ``n_acceptors``
+    INTERCHANGEABLE acceptors) — the symmetry-reduction flagship
+    (ISSUE 15, tpu/symmetry.py): the acceptors are declared a
+    ``symmetry`` group, so states that differ only in WHICH acceptors
+    have promised/accepted collapse to one canonical orbit
+    representative when the reduction is on (engines' ``symmetry=True``
+    knob; default OFF keeps raw counts).
+
+    The spec is written in the symmetry-safe style the C5 conformance
+    rule enforces: the proposer identifies responders by ``_from``
+    (relabeled by the canonicalize pass) and tracks per-acceptor
+    promise/accept bits in ``index_group`` arrays (permuted WITH the
+    group); no handler compares ``node_index()`` against a constant.
+    Every lane is domain-bounded, so the packed frontier encoding
+    (tpu/packing.py) compresses it well past the 2x acceptance bar.
+
+    Flow: initial PREPAREs fan out; acceptors PROMISE; at quorum the
+    proposer broadcasts ACCEPT; acceptors reply ACCEPTED; at quorum
+    the proposer decides (goal DECIDED).  ``never_decided`` installs
+    the violation-probe invariant instead (witness tests)."""
+    NA = n_acceptors
+    Q = quorum or NA // 2 + 1
+    spec = ProtocolSpec(
+        "paxos-gen",
+        nodes=[NodeKind("proposer", 1, (
+                   Field("ph", hi=2),
+                   Field("prom", size=NA, hi=1,
+                         index_group="acceptor"),
+                   Field("accs", size=NA, hi=1,
+                         index_group="acceptor"),
+                   Field("dec", hi=1))),
+               NodeKind("acceptor", NA, (
+                   Field("bal", hi=1), Field("acc", hi=1)))],
+        messages=[MessageType("PREPARE", ()),
+                  MessageType("PROMISE", ()),
+                  MessageType("ACCEPT", ()),
+                  MessageType("ACCEPTED", ())],
+        timers=[],
+        net_cap=4 * NA + 2, timer_cap=2,
+        symmetry=("acceptor",))
+
+    @spec.on("acceptor", "PREPARE")
+    def acc_prepare(ctx, m):
+        ctx.put("bal", 1)
+        ctx.send("PROMISE", 0)
+
+    @spec.on("proposer", "PROMISE")
+    def prop_promise(ctx, m):
+        ai = m["_from"] - 1
+        ctx.put_at("prom", ai, 1)
+        cnt = 0
+        for a in range(NA):
+            cnt = cnt + ctx.get_at("prom", a)
+        go = (ctx.get("ph") == 0) & (cnt >= Q)
+        ctx.put("ph", 1, when=go)
+        for a in range(NA):
+            ctx.send("ACCEPT", 1 + a, when=go)
+
+    @spec.on("acceptor", "ACCEPT")
+    def acc_accept(ctx, m):
+        ctx.put("acc", 1)
+        ctx.send("ACCEPTED", 0)
+
+    @spec.on("proposer", "ACCEPTED")
+    def prop_accepted(ctx, m):
+        ai = m["_from"] - 1
+        ctx.put_at("accs", ai, 1)
+        cnt = 0
+        for a in range(NA):
+            cnt = cnt + ctx.get_at("accs", a)
+        win = (ctx.get("ph") >= 1) & (cnt >= Q)
+        ctx.put("dec", 1, when=win)
+        ctx.put("ph", 2, when=win)
+
+    for a in range(NA):
+        spec.initial_messages.append(("PREPARE", 0, 1 + a, {}))
+
+    def decided(v):
+        return v.get("proposer", 0, "dec") == 1
+
+    def none_decided(v):
+        return v.get("proposer", 0, "dec") == 0
+
+    if never_decided:
+        spec.invariants["NONE_DECIDED"] = none_decided
+    else:
+        spec.goals["DECIDED"] = decided
     return spec

@@ -75,6 +75,9 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import queue as queue_mod
+import threading
+import time
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -123,6 +126,13 @@ def dropped_warn_threshold() -> int:
         return DROPPED_WARN_DEFAULT
 
 
+def _async_env_default() -> bool:
+    v = os.environ.get("DSLABS_SPILL_ASYNC")
+    if v is None:
+        return True
+    return v.strip().lower() not in ("0", "", "off", "false", "no")
+
+
 @dataclasses.dataclass(frozen=True)
 class SpillConfig:
     """Spill-tier knobs.  ``high_water``: device-table load factor that
@@ -130,33 +140,65 @@ class SpillConfig:
     backstop in the step programs catches anything that outruns it).
     ``host_cap``: max keys the host tier accepts; crossing it raises
     CapacityOverflow (host RAM is large, not infinite) — the
-    supervisor's capacity ladder retries with a bigger tier."""
+    supervisor's capacity ladder retries with a bigger tier.
+    ``async_drain`` (ISSUE 15c, default ON; DSLABS_SPILL_ASYNC=0 pins
+    the legacy sync-per-chunk gear): the drain's host half — tier
+    refilter, prune mask, spool, eviction absorb — runs on a single
+    ordered worker while the device re-dispatches the next chunk, so
+    host round-trips stop serializing against device compute.  The
+    single ordered queue preserves every exactness invariant (each
+    batch refilters against the pre-eviction tier; counts are read
+    behind a barrier)."""
 
     high_water: float = float(
         os.environ.get("DSLABS_SPILL_HIGH_WATER", "") or 0.60)
     host_cap: int = int(
         os.environ.get("DSLABS_SPILL_HOST_CAP", "") or (1 << 26))
+    async_drain: bool = dataclasses.field(
+        default_factory=_async_env_default)
 
 
 @dataclasses.dataclass
 class SpillStats:
-    """The accounting SearchOutcome surfaces (never a silent spill)."""
+    """The accounting SearchOutcome surfaces (never a silent spill).
+
+    ``drain_wall_ms``/``drain_wait_ms`` are the async-drain wall split
+    (ISSUE 15c): total host milliseconds spent inside drain jobs vs
+    milliseconds the driver actually BLOCKED at a barrier waiting for
+    them — their difference is host work that overlapped device
+    compute (the pipelining win; zero wait = full overlap)."""
 
     spilled_keys: int = 0        # keys evicted device -> host tier
     host_tier_hits: int = 0      # re-discoveries the refilter removed
     respilled_frontier: int = 0  # frontier rows through the host spool
     evictions: int = 0           # bulk table evictions
     reinjections: int = 0        # deferred re-expansion waves injected
+    drain_wall_ms: int = 0       # host ms inside drain jobs
+    drain_wait_ms: int = 0       # host ms blocked at drain barriers
+
+    @property
+    def overlap_ms(self) -> int:
+        return max(0, self.drain_wall_ms - self.drain_wait_ms)
+
+    @property
+    def overlap_ratio(self) -> float:
+        """Fraction of drain-host wall hidden behind device compute."""
+        if self.drain_wall_ms <= 0:
+            return 0.0
+        return round(self.overlap_ms / self.drain_wall_ms, 4)
 
     def as_array(self) -> np.ndarray:
         return np.asarray([self.spilled_keys, self.host_tier_hits,
                            self.respilled_frontier, self.evictions,
-                           self.reinjections], np.int64)
+                           self.reinjections, self.drain_wall_ms,
+                           self.drain_wait_ms], np.int64)
 
     @classmethod
     def from_array(cls, a) -> "SpillStats":
         a = np.asarray(a, np.int64).reshape(-1)
-        return cls(*(int(x) for x in a[:5]))
+        vals = [int(x) for x in a[:7]]
+        vals += [0] * (7 - len(vals))     # pre-round-2 dumps: 5 slots
+        return cls(*vals)
 
 
 def _rows_to_u64(keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
@@ -267,12 +309,60 @@ class FrontierSpool:
         return np.concatenate(self.segments, axis=0)
 
 
+class _DrainWorker:
+    """The async drain's single ordered worker (ISSUE 15c): jobs run
+    strictly in submission order on one daemon thread, so a refilter
+    submitted before an eviction always sees the pre-eviction tier —
+    the exactness invariant needs ORDER, not synchrony.  A job that
+    raises (e.g. the tier's CapacityOverflow) parks the exception and
+    skips the rest of the queue; the next :meth:`barrier` re-raises it
+    on the driver thread — loud, never swallowed."""
+
+    def __init__(self):
+        self._q: "queue_mod.Queue" = queue_mod.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._exc: Optional[BaseException] = None
+        self.busy_secs = 0.0
+
+    def _loop(self) -> None:
+        while True:
+            fn = self._q.get()
+            try:
+                if fn is not None and self._exc is None:
+                    t0 = time.time()
+                    fn()
+                    self.busy_secs += time.time() - t0
+            except BaseException as e:  # noqa: BLE001 — re-raised at
+                self._exc = e           # the next barrier
+            finally:
+                self._q.task_done()
+
+    def submit(self, fn) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name="dslabs-spill-drain")
+            self._thread.start()
+        self._q.put(fn)
+
+    def pending(self) -> bool:
+        return self._q.unfinished_tasks > 0
+
+    def barrier(self) -> None:
+        self._q.join()
+        if self._exc is not None:
+            e, self._exc = self._exc, None
+            raise e
+
+
 class SpillManager:
     """Per-run spill state shared by a driver's device half.
 
     The driver owns WHEN (load-factor checks, abort codes from the
     step program); this object owns the host tier, the two spools, the
-    exact-count bookkeeping, and the refilter math."""
+    exact-count bookkeeping, the refilter math, and — since ISSUE 15c
+    — the async drain queue that overlaps all of that host work with
+    the next device chunk."""
 
     def __init__(self, config: Optional[SpillConfig] = None):
         self.config = config or SpillConfig()
@@ -280,6 +370,8 @@ class SpillManager:
         self.spool_cur = FrontierSpool()    # level being consumed
         self.spool_next = FrontierSpool()   # level being assembled
         self.stats = SpillStats()
+        self._worker: Optional[_DrainWorker] = None
+        self._walls_reported = (0.0, 0.0)   # (busy, wait) last snapshot
         # Optional telemetry recorder (tpu/telemetry.py), set by the
         # owning engine at run start: evictions and reinjections become
         # flight-recorder events (host bookkeeping only — the device
@@ -290,6 +382,77 @@ class SpillManager:
         # docstring's unique formula.
         self.dup_epoch = 0
 
+    def reset_run(self) -> None:
+        """Fresh-run reset: tier, spools, counters, and epoch all
+        restart empty (the worker thread survives).  Called by the
+        drivers at the top of every NON-resume run — an engine reused
+        across runs (the bench's warm-up-then-measure pattern) must
+        not refilter run 2 against run 1's tier: that dropped live
+        states as 're-discoveries' and corrupted counts (the latent
+        reuse bug ISSUE 15's capacity2 phase exposed).  Resume paths
+        call :meth:`restore` instead, which rebuilds the tier from the
+        dump."""
+        self.barrier()
+        self.tier = HostVisitedTier(host_cap=self.config.host_cap)
+        self.spool_cur = FrontierSpool()
+        self.spool_next = FrontierSpool()
+        self.stats = SpillStats()
+        self.dup_epoch = 0
+        if self._worker is not None:
+            self._worker.busy_secs = 0.0
+        self._walls_reported = (0.0, 0.0)
+
+    # ----------------------------------------------------- async drain
+
+    def submit_drain(self, fn, evict: bool = False) -> None:
+        """Queue one drain job (refilter+spool, or an eviction
+        absorb).  Async gear: runs on the ordered worker while the
+        device continues; sync gear (async_drain=False): runs inline
+        — byte-identical semantics, the legacy timing."""
+        if not self.config.async_drain:
+            fn()
+            return
+        if self._worker is None:
+            self._worker = _DrainWorker()
+        self._worker.submit(fn)
+
+    def barrier(self) -> None:
+        """Wait for every queued drain job; re-raises a parked job
+        exception.  Every count/spool READ goes behind this — the
+        driver blocks only when it actually needs the numbers, which
+        is what turns the drain wall into overlap."""
+        w = self._worker
+        if w is None:
+            return
+        if not w.pending():
+            # Queue already drained — but a parked exception from a
+            # completed job must STILL surface here (losing it would
+            # be the silent-swallow this class exists to prevent).
+            w.barrier()
+            return
+        t0 = time.time()
+        try:
+            w.barrier()
+        finally:
+            self.stats.drain_wait_ms += int(
+                (time.time() - t0) * 1000)
+            self.stats.drain_wall_ms = int(w.busy_secs * 1000)
+
+    def level_walls(self) -> dict:
+        """Drain wall split SINCE THE LAST CALL — the per-level
+        spill-overlap numbers the drivers attach to their level
+        records (telemetry satellite)."""
+        busy = (self._worker.busy_secs if self._worker is not None
+                else 0.0)
+        self.stats.drain_wall_ms = int(busy * 1000)
+        wait = self.stats.drain_wait_ms / 1000.0
+        pb, pw = self._walls_reported
+        self._walls_reported = (busy, wait)
+        return {"drain_wall": round(busy - pb, 4),
+                "drain_wait": round(wait - pw, 4),
+                "drain_overlap": round(max(0.0, (busy - pb)
+                                           - (wait - pw)), 4)}
+
     # ------------------------------------------------------------ state
 
     @property
@@ -297,6 +460,7 @@ class SpillManager:
         """Spill machinery engaged: once anything has been tiered or
         spooled, level boundaries must run the refilter path.  Until
         then the driver keeps its fast on-device promote."""
+        self.barrier()
         return (len(self.tier) > 0 or bool(self.spool_cur.segments)
                 or bool(self.spool_next.segments))
 
@@ -304,7 +468,10 @@ class SpillManager:
         return vis_n >= int(self.config.high_water * cap)
 
     def unique(self, vis_n_device: int) -> int:
-        """Exact distinct-state count across tiers (module docstring)."""
+        """Exact distinct-state count across tiers (module docstring).
+        Reads behind the drain barrier: pending refilters still owe
+        their dup_epoch corrections."""
+        self.barrier()
         return len(self.tier) + int(vis_n_device) - self.dup_epoch
 
     # ------------------------------------------------------- operations
@@ -353,6 +520,7 @@ class SpillManager:
                     tier=len(self.tier))
 
     def pop_current(self) -> Optional[np.ndarray]:
+        self.barrier()
         seg = self.spool_cur.pop()
         if seg is not None:
             self.stats.reinjections += 1
@@ -363,6 +531,7 @@ class SpillManager:
 
     def advance_level(self) -> None:
         """Level boundary: the assembled next level becomes current."""
+        self.barrier()
         assert not self.spool_cur.segments, \
             "advance_level with unconsumed current-level segments"
         self.spool_cur, self.spool_next = (self.spool_next,
@@ -373,6 +542,7 @@ class SpillManager:
     def checkpoint_keys(self, device_keys: np.ndarray) -> np.ndarray:
         """visited_keys for the unified dump: device ∪ tier, exact-
         deduplicated (the resumer's unique base is len(keys))."""
+        self.barrier()
         parts = [np.asarray(device_keys, np.uint32).reshape(-1, 4),
                  self.tier.key_rows()]
         allk = np.concatenate(parts, axis=0)
@@ -393,6 +563,7 @@ class SpillManager:
         """Resume-from-dump: ALL dumped keys load into the host tier
         and the device epoch restarts empty — bit-exact by the unique
         formula (len(tier) + 0 - 0 = the dump's distinct count)."""
+        self.barrier()
         self.tier = HostVisitedTier(host_cap=self.config.host_cap)
         self.spool_cur = FrontierSpool()
         self.spool_next = FrontierSpool()
@@ -403,6 +574,12 @@ class SpillManager:
 
     def attach(self, outcome) -> None:
         """Surface the accounting on a SearchOutcome (never silent)."""
+        self.barrier()
+        if self._worker is not None:
+            self.stats.drain_wall_ms = int(
+                self._worker.busy_secs * 1000)
         outcome.spilled_keys = self.stats.spilled_keys
         outcome.host_tier_hits = self.stats.host_tier_hits
         outcome.respilled_frontier = self.stats.respilled_frontier
+        outcome.spill_drain_ms = self.stats.drain_wall_ms
+        outcome.spill_wait_ms = self.stats.drain_wait_ms

@@ -1,0 +1,144 @@
+"""Symmetry reduction: canonical ordering of indistinguishable node ids
+(ISSUE 15 leg (b), ROADMAP #4b — the classic explicit-state trick).
+
+A spec that declares ``symmetry=("acceptor", ...)`` groups marks those
+node kinds' instances as interchangeable: any permutation of the group
+is an automorphism of the transition system (the C5 conformance rule
+statically rejects handlers that branch on WHICH member they are).
+Every permutation image of a reachable state is therefore behaviorally
+identical — exploring one representative per orbit covers them all,
+cutting the reachable set by up to ``|group|!``.
+
+``ProtocolSpec.compile()`` turns the declaration into a
+:class:`SymmetrySpec` — static permutation tables over the packed node
+lanes (instance blocks swap, group-indexed array fields permute their
+elements) and the node-id relabel map.  :func:`build_canonicalizer`
+compiles those tables into a fused device pass the engines run RIGHT
+BEFORE fingerprinting (opt-in, default OFF — canonical unique counts
+differ from raw counts by design, so the pinned lab counts stay
+untouched unless a caller asks):
+
+  for each permutation p:  candidate_p = apply(p, rows)
+      - node lanes gather through the static lane_src table,
+      - message records relabel from/to through the relab map and the
+        network re-sorts to canonical order (sorted-set hashing),
+      - per-node timer queues permute with their nodes,
+      - the exception lane rides along unchanged;
+  canonical(rows) = lexicographic min over candidates.
+
+Only the FINGERPRINT sees the canonical form — stored frontier rows
+stay the original states, so witnesses, traces, and predicate flags
+replay on real reachable states; symmetric twins simply hash equal and
+dedup to whichever representative arrived first.  Wired into both
+engines' hash step and the sharded owner-hash via the shared
+``_expand_chunk`` fingerprint site (owner routing keys on the canonical
+fingerprint, so twins land on one owner and dedup exactly).
+
+Scope (first cut, documented): the from/to lanes of the compiler's
+uniform message records are relabeled; message/timer PAYLOAD fields and
+timer records carrying raw node ids are NOT — specs should identify
+senders via ``_from`` and index per-member state with ``index_group``
+fields (my kingdom for a dependent type system).  The conformance
+linter's C5 rule flags the detectable violations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Tuple
+
+import numpy as np
+
+__all__ = ["SymmetrySpec", "build_canonicalizer"]
+
+
+@dataclasses.dataclass(frozen=True)
+class SymmetrySpec:
+    """Static permutation tables for one protocol's symmetry groups.
+
+    ``relab``    [P, n_nodes]    relab[p][old_node_id] = new_node_id
+    ``lane_src`` [P, node_width] new_nodes[l] = old_nodes[lane_src[p][l]]
+    ``groups``   ((kind, base, count), ...) for reporting
+    ``msg_node_lanes``  message-record lanes holding node ids (the
+                        compiler's uniform [tag, frm, to, ...] layout)
+    """
+
+    relab: np.ndarray
+    lane_src: np.ndarray
+    groups: Tuple[Tuple[str, int, int], ...] = ()
+    msg_node_lanes: Tuple[int, ...] = (1, 2)
+
+    @property
+    def n_perms(self) -> int:
+        return int(self.relab.shape[0])
+
+
+def build_canonicalizer(protocol, offsets) -> Callable:
+    """Compile ``protocol.symmetry`` into the fused canonicalize pass:
+    ``fn(rows [N, lanes] int32) -> [N, lanes] int32`` (pure jnp —
+    traces into the engines' expand programs).  ``offsets`` is the
+    engine's ``(o_net, o_timers, o_exc)`` flat-row split."""
+    import jax
+    import jax.numpy as jnp
+
+    from dslabs_tpu.tpu.engine import (SENTINEL, _row_less,
+                                       canonicalize_net)
+
+    sym: SymmetrySpec = protocol.symmetry
+    if sym is None:
+        raise ValueError(f"{protocol.name}: no symmetry groups declared")
+    p = protocol
+    o0, o1, o2 = offsets
+    nn = p.n_nodes
+    relab = np.asarray(sym.relab, np.int64)
+    lane_src = np.asarray(sym.lane_src, np.int64)
+    n_perms = relab.shape[0]
+    # Timer-axis gather: new_timers[j] = old_timers[inv[j]] where
+    # relab[old] = new  =>  inv[new] = old.
+    inv = np.zeros_like(relab)
+    for k in range(n_perms):
+        inv[k][relab[k]] = np.arange(nn)
+
+    def _apply(rows, k):
+        n = rows.shape[0]
+        nodes = rows[:, :o0]
+        if not (lane_src[k] == np.arange(o0)).all():
+            nodes = jnp.take(nodes, lane_src[k], axis=1)
+        net = rows[:, o0:o1].reshape(n, p.net_cap, p.msg_width)
+        occ = net[:, :, 0] != SENTINEL
+        rel = relab[k]
+        if not (rel == np.arange(nn)).all():
+            cols = []
+            for lane in range(p.msg_width):
+                col = net[:, :, lane]
+                if lane in sym.msg_node_lanes:
+                    # One-hot relabel (nn is small; dynamic gathers
+                    # are the measured slow path under the flat vmap).
+                    new = jnp.zeros_like(col)
+                    for j in range(nn):
+                        new = new + jnp.where(col == j,
+                                              jnp.int32(int(rel[j])), 0)
+                    col = jnp.where(occ, new, col)
+                cols.append(col)
+            net = jnp.stack(cols, axis=2)
+            # Relabeled records break the canonical sorted-set order;
+            # re-canonicalize so equal sets hash equal.
+            net = jax.vmap(canonicalize_net)(net)
+        timers = rows[:, o1:o2].reshape(n, nn, p.timer_cap,
+                                        p.timer_width)
+        if not (inv[k] == np.arange(nn)).all():
+            timers = jnp.take(timers, inv[k], axis=1)
+        return jnp.concatenate([
+            nodes, net.reshape(n, -1), timers.reshape(n, -1),
+            rows[:, o2:o2 + 1]], axis=1)
+
+    def canonicalize(rows):
+        # Permutation 0 is the identity (pinned by the compiler):
+        # candidate 0 is the input itself.
+        best = rows
+        for k in range(1, n_perms):
+            cand = _apply(rows, k)
+            best = jnp.where(_row_less(cand, best)[:, None], cand, best)
+        return best
+
+    return canonicalize

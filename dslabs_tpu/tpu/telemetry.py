@@ -183,6 +183,20 @@ DISPATCH_SITES = {
                                   program=False),
     "lanes.flags":           dict(hot=False, donated=False, multi=False,
                                   program=False),
+    # Capacity round 2 (ISSUE 15): the bit-packed frontier codec
+    # (tpu/packing.py) and the symmetry canonicalize pass
+    # (tpu/symmetry.py) are FUSED into device.step / host.expand — no
+    # standalone dispatch in the hot loop — but each registers a
+    # canonical standalone program (like visited.insert) so the jaxpr
+    # auditor (J0-J5) and profiler cover the codec lowerings
+    # themselves.  Registered only by engines whose descriptor is
+    # non-identity / whose reduction is on.
+    "packing.pack":          dict(hot=False, donated=False, multi=False,
+                                  program=True),
+    "packing.unpack":        dict(hot=False, donated=False, multi=False,
+                                  program=True),
+    "symmetry.canonicalize": dict(hot=False, donated=False, multi=False,
+                                  program=True),
 }
 
 # Hot-loop sites whose steady-state dispatches are worth a profiler
@@ -787,6 +801,12 @@ class Telemetry:
                 # `telemetry watch` renders every resident lane of one
                 # lane-batch process.
                 self._status["lanes"] = record["lanes"]
+            if record.get("spill") is not None:
+                # Async-drain wall split (ISSUE 15c): per-level host
+                # drain seconds vs blocked seconds — the live monitor
+                # shows how much of the spill detour is hidden behind
+                # device compute.
+                self._status["drain"] = record["spill"]
             self._status.update({
                 "engine": engine,
                 "depth": record.get("depth", 0),
@@ -835,7 +855,9 @@ class Telemetry:
 
     def on_outcome(self, out, engine: Optional[str] = None) -> None:
         """Ingest a SearchOutcome's accounting: one ``outcome`` record
-        plus gauges for every counter (spill, overflow, recovery)."""
+        plus gauges for every counter (spill, overflow, recovery) and
+        the capacity-round-2 block (bytes_per_state / pack_ratio /
+        symmetry_perms — ISSUE 15, schema-pinned in STATUS.json)."""
         eng = engine or getattr(out, "engine", None) or "search"
         rec = {"t": "outcome", "ts": self._ts(), "engine": eng,
                "end_condition": out.end_condition,
@@ -852,6 +874,27 @@ class Telemetry:
                     self.registry.gauge(f"outcome.{f}").set(v)
             self.registry.gauge("outcome.compile_secs").set(
                 rec["compile_secs"])
+            bps = getattr(out, "bytes_per_state", None)
+            if bps:
+                cap_block = {
+                    "bytes_per_state": int(bps),
+                    "bytes_per_state_unpacked": int(
+                        getattr(out, "bytes_per_state_unpacked", 0)
+                        or 0),
+                    "pack_ratio": float(
+                        getattr(out, "pack_ratio", 1.0) or 1.0),
+                    "symmetry_perms": int(
+                        getattr(out, "symmetry_perms", 0) or 0)}
+                rec["capacity"] = cap_block
+                self._status["capacity"] = cap_block
+                self.registry.gauge("capacity.bytes_per_state").set(
+                    cap_block["bytes_per_state"])
+                self.registry.gauge("capacity.pack_ratio").set(
+                    cap_block["pack_ratio"])
+                if cap_block["symmetry_perms"]:
+                    self.registry.gauge(
+                        "capacity.symmetry_perms").set(
+                        cap_block["symmetry_perms"])
             self._write(rec)
             self.events.append(rec)
             self._status["end_condition"] = out.end_condition
@@ -996,10 +1039,24 @@ def build_report(records: List[dict]) -> dict:
                   "knob_retries"):
             if o.get(k):
                 counts[k] = counts.get(k, 0) + int(o[k])
+    # Capacity round 2 (ISSUE 15): the last outcome's packing /
+    # symmetry block, plus the summed per-level drain-overlap walls.
+    capacity = next((o["capacity"] for o in reversed(outcomes)
+                     if o.get("capacity")), None)
+    drain = {}
+    for lv in levels:
+        sp = lv.get("spill")
+        if isinstance(sp, dict):
+            for k, v in sp.items():
+                try:
+                    drain[k] = round(drain.get(k, 0.0) + float(v), 4)
+                except (TypeError, ValueError):
+                    pass
     return {"meta": meta, "n_spans": len(spans),
             "sites": {t: h.snapshot() for t, h in sites.items()},
             "series": series, "timeline": timeline,
             "outcomes": outcomes, "counts": counts,
+            "capacity": capacity, "drain": drain or None,
             "total_wall": round(total_wall, 3),
             "compile_wall": round(compile_wall, 3),
             "in_flight": open_dispatch}
@@ -1106,6 +1163,12 @@ def render_report(report: dict, source: str = "") -> str:
                             for k, v in sorted(report["counts"].items())))
     else:
         out.append("(all zero)")
+    if report.get("capacity"):
+        out.append("capacity: " + " ".join(
+            f"{k}={v}" for k, v in sorted(report["capacity"].items())))
+    if report.get("drain"):
+        out.append("drain overlap: " + " ".join(
+            f"{k}={v}" for k, v in sorted(report["drain"].items())))
     for o in report["outcomes"]:
         out.append(
             f"outcome: {o.get('end_condition')} engine="
@@ -1252,6 +1315,12 @@ def render_watch(path: str, now: Optional[float] = None) -> str:
         if st.get("spill"):
             out.append("spill: " + " ".join(
                 f"{k}={v}" for k, v in sorted(st["spill"].items())))
+        if st.get("drain"):
+            out.append("drain: " + " ".join(
+                f"{k}={v}" for k, v in sorted(st["drain"].items())))
+        if st.get("capacity"):
+            out.append("capacity: " + " ".join(
+                f"{k}={v}" for k, v in sorted(st["capacity"].items())))
         if st.get("rung"):
             out.append("rung: " + " ".join(
                 f"{k}={v}" for k, v in sorted(st["rung"].items())))
@@ -1323,7 +1392,8 @@ def read_ledger(path: str) -> List[dict]:
 # The bench phases a ledger compare diffs ("headline" is the last-line
 # JSON's top-level value — the number the BENCH_r0N trajectory tracks).
 _LEDGER_PHASES = ("headline", "mesh", "strict", "beam", "swarm",
-                  "spill", "service", "lanes", "cpu_fallback")
+                  "spill", "capacity2", "service", "lanes",
+                  "cpu_fallback")
 
 # Resilience counters the ledger tracks beside the rates (ISSUE 9):
 # a bench run that suddenly needs mesh shrinks / knob re-levels /
@@ -1607,6 +1677,34 @@ def compare_ledger(records: List[dict],
         cmp["lanes"]["occupancy"] = entry
         if lv < best * (1.0 - threshold):
             cmp["regressions"].append(entry)
+    # Capacity-round-2 guard (ISSUE 15): HBM bytes per stored frontier
+    # state on the capacity2 phase vs the BEST (smallest) prior — a
+    # rise past the threshold means the packed encoding regressed
+    # (domain declarations lost, codec disabled), shrinking
+    # frontier/visited capacity at fixed HBM even when states/min
+    # holds.  Same rc-1 severity as a rate regression.
+    cmp["capacity"] = {}
+
+    def _bps(rec):
+        s = rec.get("capacity2")
+        if not isinstance(s, dict):
+            return None
+        try:
+            v = float(s.get("bytes_per_state"))
+        except (TypeError, ValueError):
+            return None
+        return v if v > 0 else None
+
+    lv = _bps(latest)
+    priors_b = [v for v in (_bps(r) for r in prior) if v is not None]
+    if lv is not None and priors_b:
+        best = min(priors_b)
+        entry = {"phase": "capacity:bytes_per_state",
+                 "latest": round(lv, 1), "best_prior": round(best, 1),
+                 "delta_pct": round((lv - best) / best * 100, 1)}
+        cmp["capacity"]["bytes_per_state"] = entry
+        if lv > best * (1.0 + threshold):
+            cmp["regressions"].append(entry)
     return cmp
 
 
@@ -1648,6 +1746,10 @@ def render_compare(cmp: dict, source: str = "") -> str:
                    f"({e['delta_pct']:+.1f}%)")
     for c, e in sorted(cmp.get("lanes", {}).items()):
         out.append(f"lanes {c:19s} latest={e['latest']} "
+                   f"prior_best={e['best_prior']} "
+                   f"({e['delta_pct']:+.1f}%)")
+    for c, e in sorted(cmp.get("capacity", {}).items()):
+        out.append(f"capacity {c:16s} latest={e['latest']} "
                    f"prior_best={e['best_prior']} "
                    f"({e['delta_pct']:+.1f}%)")
     for e in cmp["regressions"]:

@@ -222,6 +222,77 @@ def test_c4_check_spec_reports_unhandled_declared_types():
     sp.compile()          # soft findings do NOT fail the compile gate
 
 
+# ------------------------------------------- red fixtures: C5 symmetry
+
+_C5_RED = textwrap.dedent("""
+    from dslabs_tpu.tpu.compiler import (Field, MessageType, NodeKind,
+                                         ProtocolSpec)
+
+    spec = ProtocolSpec(
+        "sym", nodes=[NodeKind("acceptor", 3, (Field("b"),))],
+        messages=[MessageType("M", ())], timers=[],
+        symmetry=("acceptor",))
+
+    @spec.on("acceptor", "M")
+    def h(ctx, m):
+        me = ctx.node_index()
+        ctx.put("b", 1, when=me == 1)        # member-specific branch
+""")
+
+
+def test_c5_symmetric_kind_branching_on_node_id():
+    """ISSUE 15 red fixture: a handler on a kind inside a declared
+    symmetry group comparing node_index() (here through a tainted
+    local) against a constant is flagged C5."""
+    c5 = [f for f in lint_source(_C5_RED, "fixture.py")
+          if f.code == "C5"]
+    assert len(c5) == 1
+    assert c5[0].obj == "h"
+    assert "interchangeable" in c5[0].message
+
+
+def test_c5_clean_counterparts():
+    """The symmetry-safe styles stay clean: identifying peers via
+    _from, comparing tainted values against payloads (not constants),
+    and the same constant-branching handler on a kind OUTSIDE the
+    symmetry declaration."""
+    clean = _C5_RED.replace("me == 1", 'm["_from"] == me')
+    assert [f.code for f in lint_source(clean, "f.py")] == []
+    outside = _C5_RED.replace('symmetry=("acceptor",)', "symmetry=()")
+    assert [f.code for f in lint_source(outside, "f.py")] == []
+
+
+def test_c5_direct_comparison_and_rules_catalog():
+    src = _C5_RED.replace(
+        "me = ctx.node_index()\n"
+        "    ctx.put(\"b\", 1, when=me == 1)        "
+        "# member-specific branch",
+        "ctx.put(\"b\", 1, when=ctx.node_index() == 2)")
+    c5 = [f for f in lint_source(src, "fixture.py") if f.code == "C5"]
+    assert len(c5) == 1
+    from dslabs_tpu.analysis.core import RULES
+
+    assert "C5" in RULES and "symmetry" in RULES["C5"]
+
+
+def test_c5_compile_gate_guards_group_declarations():
+    """The compile gate's half of C5: unknown group kinds and
+    malformed index_group declarations raise structured SpecErrors."""
+    sp = ProtocolSpec("s1", nodes=[NodeKind("n", 2, ())],
+                      messages=[MessageType("M", ())], timers=[],
+                      symmetry=("ghost",))
+    with pytest.raises(SpecError, match="unknown node kind 'ghost'"):
+        sp.compile()
+    sp2 = ProtocolSpec(
+        "s2",
+        nodes=[NodeKind("p", 1, (Field("x", size=3,
+                                       index_group="a"),)),
+               NodeKind("a", 2, ())],
+        messages=[MessageType("M", ())], timers=[], symmetry=("a",))
+    with pytest.raises(SpecError, match="size 3 but index_group"):
+        sp2.compile()
+
+
 # ------------------------------------------- red fixtures: jaxpr J0-J5
 
 def _entry(fn, args, donate=(), multi=False, builder=None):

@@ -381,6 +381,93 @@ def test_spill_record_trace_rejected():
         TensorSearch(_pruned_pingpong(), spill=True, record_trace=True)
 
 
+# ------------------------------------------------- async drain (ISSUE 15c)
+
+@pytest.mark.capacity2
+def test_async_drain_default_on_and_sync_parity(lab1_base):
+    """The async gear is the default (DSLABS_SPILL_ASYNC), its counts
+    are exact, and the legacy sync gear produces the identical
+    verdict — async is a scheduling change, never a semantic one."""
+    cap = _eighth_cap(lab1_base.unique_states)
+    kw = dict(chunk=16, max_depth=LAB1_DEPTH, visited_cap=cap,
+              frontier_cap=1 << 11)
+    a = TensorSearch(_pruned_clientserver(),
+                     spill=spill_mod.SpillConfig(async_drain=True),
+                     **kw).run()
+    s = TensorSearch(_pruned_clientserver(),
+                     spill=spill_mod.SpillConfig(async_drain=False),
+                     **kw).run()
+    _assert_exact(lab1_base, a)
+    _assert_exact(lab1_base, s)
+    assert a.dropped_states == s.dropped_states == 0
+    # The async run measured its wall split; overlap = drain work the
+    # driver never blocked on (host drain no longer additive with the
+    # device chunk wall).
+    assert a.spill_drain_ms > 0
+    assert a.spill_drain_ms >= a.spill_wait_ms
+    assert s.spill_wait_ms == 0 and s.spill_drain_ms == 0
+
+
+@pytest.mark.capacity2
+def test_async_drain_level_records_carry_wall_split(lab1_base):
+    """The per-level records carry the drain/wait/overlap split
+    (telemetry satellite: the spill detour's cost is attributable per
+    level, not just in aggregate)."""
+    from dslabs_tpu.tpu import telemetry as tel_mod
+
+    cap = _eighth_cap(lab1_base.unique_states)
+    tel = tel_mod.Telemetry()
+    out = TensorSearch(_pruned_clientserver(), chunk=16,
+                       max_depth=LAB1_DEPTH, visited_cap=cap,
+                       frontier_cap=1 << 11, spill=True,
+                       telemetry=tel).run()
+    _assert_exact(lab1_base, out)
+    recs = [r for r in tel.levels if r.get("spill")]
+    assert recs, "spill level records missing the wall split"
+    for r in recs:
+        for k in ("drain_wall", "drain_wait", "drain_overlap"):
+            assert k in r["spill"]
+    total_drain = sum(r["spill"]["drain_wall"] for r in recs)
+    assert abs(total_drain - out.spill_drain_ms / 1000.0) < 0.25
+
+
+@pytest.mark.capacity2
+def test_async_drain_worker_error_surfaces_loudly():
+    """A drain job that raises (host tier full) surfaces at the next
+    barrier as the same loud CapacityOverflow the sync gear raises —
+    never swallowed on the worker thread."""
+    with pytest.raises(CapacityOverflow, match="host spill tier"):
+        TensorSearch(_pruned_clientserver(), chunk=16,
+                     max_depth=LAB1_DEPTH, visited_cap=64,
+                     frontier_cap=1 << 11,
+                     spill=spill_mod.SpillConfig(
+                         async_drain=True, host_cap=32)).run()
+
+
+@pytest.mark.capacity2
+@pytest.mark.fault
+def test_async_drain_abort_revert_chaos(lab1_base):
+    """ACCEPTANCE (abort/revert chaos): transient faults injected at
+    every spill dispatch site under the ASYNC gear retry through the
+    standard boundary with exact counts — the abort-wholesale-revert
+    contract holds while drains are in flight."""
+    cap = _eighth_cap(lab1_base.unique_states)
+    plan = FaultPlan()
+    for site in ("spill_drain", "spill_evict", "spill_reinject"):
+        plan.raise_at(1, engine="device", site=site,
+                      error=TransientDeviceError)
+    sup = SearchSupervisor(
+        _pruned_clientserver(), ladder=("device",), mesh=make_mesh(1),
+        chunk=16, visited_cap=cap, frontier_cap=1 << 11,
+        max_depth=LAB1_DEPTH, policy=RetryPolicy(max_retries=3),
+        spill=spill_mod.SpillConfig(async_drain=True),
+        fault_plan=plan)
+    out = sup.run()
+    _assert_exact(lab1_base, out)
+    assert plan.fired == 3
+    assert out.dropped_states == 0
+
+
 # ------------------------------------------------------------ slow tier
 
 @pytest.mark.slow

@@ -1,0 +1,175 @@
+"""Symmetry reduction (ISSUE 15 leg (b), tpu/symmetry.py): canonical
+ordering of indistinguishable node ids, opt-in and default OFF —
+
+* default OFF: raw unique counts pinned (202 on the single-decree
+  paxos spec, 3 symmetric acceptors) — no default behavior change;
+* symmetry=True: the CANONICAL unique count is pinned (50), strictly
+  smaller than raw, deterministic, and identical across the device
+  loop, the host-dedup oracle, and the 2-device sharded owner-hash;
+* verdict parity: goal found <=> goal found, violation found <=>
+  violation found, exhaustion <=> exhaustion vs the unreduced run;
+* the violation witness replays: the recorded event trace drives the
+  tensor step from the root to a state that genuinely violates the
+  invariant;
+* canonicalize unit law: states that differ only by an acceptor
+  permutation hash equal; packing composes (packed+symmetric ==
+  unpacked+symmetric);
+* guard rails: symmetry=True without declared groups is a loud
+  ValueError; a symmetry-reduced checkpoint never silently resumes an
+  unreduced search (config fingerprints differ).
+
+Marked ``capacity2`` (``make capacity2-smoke``)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from dslabs_tpu.tpu import checkpoint as ckpt_mod  # noqa: E402
+from dslabs_tpu.tpu.engine import (TensorSearch,  # noqa: E402
+                                   flatten_state)
+from dslabs_tpu.tpu.sharded import (ShardedTensorSearch,  # noqa: E402
+                                    make_mesh)
+from dslabs_tpu.tpu.specs import paxos_spec  # noqa: E402
+
+pytestmark = pytest.mark.capacity2
+
+# Pinned counts for paxos_spec(3) exhaustive with the DECIDED goal
+# pruned: the raw reachable set and its canonical quotient (orbit
+# count under the 3! acceptor permutations).  Determinism of the
+# canonical count is part of the contract (lex-min representative).
+RAW_UNIQUE = 202
+CANONICAL_UNIQUE = 50
+
+
+def _pruned():
+    p = paxos_spec(3).compile()
+    return dataclasses.replace(p, goals={},
+                               prunes={"D": p.goals["DECIDED"]})
+
+
+def test_default_off_raw_count_pinned():
+    out = TensorSearch(_pruned(), chunk=256, visited_cap=1 << 14).run()
+    assert out.end_condition == "SPACE_EXHAUSTED"
+    assert out.unique_states == RAW_UNIQUE
+    assert out.symmetry_perms == 0
+
+
+def test_canonical_count_pinned_and_smaller():
+    """ACCEPTANCE: canonical unique count pinned, strictly smaller
+    than raw, verdict parity with the unreduced run."""
+    out = TensorSearch(_pruned(), chunk=256, visited_cap=1 << 14,
+                       symmetry=True).run()
+    assert out.end_condition == "SPACE_EXHAUSTED"
+    assert out.unique_states == CANONICAL_UNIQUE < RAW_UNIQUE
+    assert out.symmetry_perms == 6
+
+
+def test_canonical_count_engine_agreement():
+    """Device loop, host oracle, and the sharded owner-hash all land
+    the same canonical count — symmetric twins dedup to ONE owner."""
+    dev = TensorSearch(_pruned(), chunk=256, visited_cap=1 << 14,
+                       symmetry=True).run()
+    host = TensorSearch(_pruned(), chunk=256, visited_cap=1 << 14,
+                        symmetry=True, use_host_visited=True).run()
+    sh = ShardedTensorSearch(_pruned(), make_mesh(2),
+                             chunk_per_device=64, frontier_cap=512,
+                             visited_cap=1 << 14, symmetry=True).run()
+    for out in (dev, host, sh):
+        assert out.end_condition == "SPACE_EXHAUSTED"
+        assert out.unique_states == CANONICAL_UNIQUE
+        assert out.states_explored == dev.states_explored
+
+
+def test_goal_verdict_parity():
+    p = paxos_spec(3).compile()
+    raw = TensorSearch(p, chunk=256, visited_cap=1 << 14).run()
+    sym = TensorSearch(p, chunk=256, visited_cap=1 << 14,
+                       symmetry=True).run()
+    assert raw.end_condition == sym.end_condition == "GOAL_FOUND"
+    assert raw.predicate_name == sym.predicate_name == "DECIDED"
+
+
+def test_violation_witness_replays():
+    """ACCEPTANCE: the symmetry-reduced violation's recorded event
+    trace replays on the tensor step from the root to a state that
+    genuinely violates the invariant."""
+    p = dataclasses.replace(paxos_spec(3, never_decided=True).compile(),
+                            goals={})
+    eng = TensorSearch(p, chunk=256, visited_cap=1 << 14,
+                       symmetry=True, record_trace=True)
+    out = eng.run()
+    assert out.end_condition == "INVARIANT_VIOLATED"
+    assert out.predicate_name == "NONE_DECIDED"
+    assert out.trace, "violation must carry a replayable trace"
+    row = np.asarray(flatten_state(eng.initial_state()))[0]
+    for ev in out.trace:
+        nxt, ok, over = eng._step_one(jax.numpy.asarray(row),
+                                      jax.numpy.asarray(ev))
+        assert bool(ok), f"trace event {ev} not deliverable on replay"
+        assert int(over) == 0
+        row = np.asarray(nxt)
+    final = eng.unflatten_rows(row[None])
+    inv = p.invariants["NONE_DECIDED"]
+    assert not bool(jax.vmap(inv)(final)[0]), \
+        "replayed final state does not violate the invariant"
+
+
+def test_permuted_states_hash_equal():
+    """Unit law: delivering the root's PREPARE to acceptor 1 vs
+    acceptor 3 yields states in one orbit — canonical rows (and so
+    fingerprints) are identical; the raw rows are not."""
+    eng = TensorSearch(_pruned(), chunk=64, symmetry=True)
+    row0 = flatten_state(eng.initial_state())
+    net = eng.unflatten_rows(np.asarray(row0))["net"][0]
+    # Occupied net rows are the three PREPAREs, sorted by 'to'.
+    occ = [i for i in range(net.shape[0]) if net[i][0] != 2**31 - 1]
+    assert len(occ) == 3
+    rows = []
+    for slot in (occ[0], occ[-1]):
+        nxt, ok, _ = eng._step_one(row0[0], jax.numpy.asarray(slot))
+        assert bool(ok)
+        rows.append(np.asarray(nxt))
+    a, b = rows
+    assert not (a == b).all()
+    ca = np.asarray(eng._canon_rows(jax.numpy.asarray(a[None])))
+    cb = np.asarray(eng._canon_rows(jax.numpy.asarray(b[None])))
+    assert (ca == cb).all()
+
+
+def test_packed_and_symmetry_compose():
+    kw = dict(chunk=256, visited_cap=1 << 14, symmetry=True)
+    packed = TensorSearch(_pruned(), **kw).run()
+    raw = TensorSearch(_pruned(), packed=False, **kw).run()
+    assert packed.unique_states == raw.unique_states == CANONICAL_UNIQUE
+    assert packed.states_explored == raw.states_explored
+    assert packed.bytes_per_state < packed.bytes_per_state_unpacked
+
+
+def test_symmetry_without_groups_is_loud():
+    from dslabs_tpu.tpu.protocols.pingpong import make_pingpong_protocol
+
+    with pytest.raises(ValueError, match="symmetry"):
+        TensorSearch(make_pingpong_protocol(2), symmetry=True)
+
+
+def test_symmetry_checkpoint_identity(tmp_path):
+    """A reduced dump's visited keys describe the QUOTIENT space — an
+    unreduced search refuses it loudly (fingerprint mismatch), never
+    resumes it silently."""
+    pth = str(tmp_path / "sym.ckpt")
+    kw = dict(chunk=64, visited_cap=1 << 14, checkpoint_path=pth,
+              checkpoint_every=1)
+    TensorSearch(_pruned(), symmetry=True, max_depth=4, **kw).run()
+    unreduced = TensorSearch(_pruned(), max_depth=8, **kw)
+    assert not unreduced.has_resumable_checkpoint()
+    with pytest.raises(ckpt_mod.CheckpointMismatch):
+        unreduced.run(resume=True)
+    # The reduced engine itself resumes its own dump exactly.
+    full = TensorSearch(_pruned(), symmetry=True, chunk=64,
+                        visited_cap=1 << 14).run()
+    out = TensorSearch(_pruned(), symmetry=True, **kw).run(resume=True)
+    assert out.unique_states == full.unique_states
+    assert out.end_condition == full.end_condition

@@ -16,7 +16,14 @@ the end-to-end CLI paths the pytest tier exercises through the API —
    compare guards flag an injected amortisation regression (rc 1)
    and stay quiet on parity, and a lane-batch run dir's STATUS.json
    renders its per-lane block through ``telemetry watch``
-   (the lanes leg).
+   (the lanes leg);
+5. (ISSUE 15) drive the PACKED path end to end: a domain-declared
+   generated spec runs with the bit-packed frontier encoding ON,
+   its STATUS.json carries the schema-pinned ``capacity`` block
+   (bytes_per_state / pack_ratio), ``telemetry watch`` renders it,
+   and the ledger's ``capacity:bytes_per_state`` guard flags an
+   injected encoding regression (rc 1) while parity stays rc 0
+   (the capacity2 leg).
 
 Exits nonzero on any mismatch; prints one OK line per step."""
 
@@ -167,6 +174,50 @@ def main() -> int:
     rc = tel_mod.main(["watch", lane_dir, "--once"])
     assert rc == 0, rc
     print("obs-smoke: lanes compare guards + batched watch OK")
+
+    # -- capacity2 leg (ISSUE 15): the packed path end to end.
+    import dataclasses
+
+    from dslabs_tpu.tpu.engine import TensorSearch
+    from dslabs_tpu.tpu.specs import clientserver_spec
+
+    cap_dir = tempfile.mkdtemp(prefix="dslabs_obs_smoke_cap2_")
+    cs = clientserver_spec(2, 2).compile()
+    cs = dataclasses.replace(
+        cs, goals={}, prunes={"DONE": cs.goals["CLIENTS_DONE"]})
+    tel = tel_mod.Telemetry.for_checkpoint(
+        os.path.join(cap_dir, "search.ckpt"), engine_hint="capacity2")
+    search = TensorSearch(cs, chunk=128, frontier_cap=1 << 10,
+                          visited_cap=1 << 12, telemetry=tel)
+    assert search._pk is not None, "generated spec must derive packing"
+    out = search.run()
+    tel.close()
+    assert out.pack_ratio and out.pack_ratio >= 2.0, out.pack_ratio
+    assert out.bytes_per_state < out.bytes_per_state_unpacked, out
+    st = tel_mod.load_status(
+        os.path.join(cap_dir, "STATUS.json"))
+    assert st["capacity"]["bytes_per_state"] == out.bytes_per_state, st
+    assert st["capacity"]["pack_ratio"] == out.pack_ratio, st
+    frame = tel_mod.render_watch(cap_dir)
+    assert "capacity:" in frame and "bytes_per_state" in frame, frame
+    cap_ok = os.path.join(run_dir, "cap_parity.jsonl")
+    base = {"t": "bench", "value": 100.0,
+            "capacity2": {"value": 50.0, "bytes_per_state": 44.0}}
+    for _ in range(2):
+        tel_mod.append_ledger(cap_ok, base)
+    rc = tel_mod.main(["compare", cap_ok])
+    assert rc == 0, "capacity parity ledger must not flag"
+    cap_bad = os.path.join(run_dir, "cap_regress.jsonl")
+    tel_mod.append_ledger(cap_bad, base)
+    tel_mod.append_ledger(cap_bad, {
+        "t": "bench", "value": 100.0,
+        "capacity2": {"value": 50.0, "bytes_per_state": 604.0}})
+    rc = tel_mod.main(["compare", cap_bad])
+    assert rc == 1, "bytes_per_state regression must flag"
+    cmp = tel_mod.compare_ledger(tel_mod.read_ledger(cap_bad))
+    flagged = {e["phase"] for e in cmp["regressions"]}
+    assert "capacity:bytes_per_state" in flagged, cmp
+    print("obs-smoke: packed path + capacity compare guard OK")
     print(json.dumps({"obs_smoke": "ok", "run_dir": run_dir,
                       "trace_dir": trace_dir, "trace_id": trace_id}))
     return 0
