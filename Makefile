@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast lint analysis-smoke perf-smoke fault-smoke swarm-smoke capacity-smoke capacity2-smoke obs-smoke chaos-smoke service-smoke trace-smoke mesh-smoke lanes-smoke lab0 lab1 lab2 lab3 lab4 bench dryrun handout clean
+.PHONY: test test-fast lint analysis-smoke perf-smoke fault-smoke swarm-smoke capacity-smoke capacity2-smoke obs-smoke chaos-smoke service-smoke trace-smoke mesh-smoke lanes-smoke memo-smoke lab0 lab1 lab2 lab3 lab4 bench dryrun handout clean
 
 test:            ## full acceptance + parity suite
 	$(PY) -m pytest tests/ -q
@@ -187,6 +187,22 @@ mesh-smoke:      ## owner-sharded superstep width-parity matrix + Pallas kernel 
 # is the field guide.
 lanes-smoke:     ## batched job lanes: parity matrix + continuous batching + resume + cost split on CPU
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m lanes -p no:cacheprovider
+	JAX_PLATFORMS=cpu $(PY) tools/obs_smoke.py
+
+# memo-smoke = the cross-job memoization suite (tests/test_memo.py,
+# ISSUE 16): structural-fingerprint identity (rename-only resubmits
+# hit, one-handler edits miss), visited-tier save/load with loud
+# pack/symmetry refusals, the exact-key verdict-cache hit (zero
+# dispatches, journaled memo_hit, ~0 COSTS device_secs), warm-start
+# and incremental re-check exact parity vs cold runs (incl. the
+# strict/beam x packed on/off sweep and SIGKILL-mid-warm-start
+# resume), stale-verdict impossibility, the 3-tenant <10% resubmit
+# billing pin, and the memo-off overhead guard — all CPU.  PLUS the
+# memo leg of tools/obs_smoke.py (bench --memo schema + the
+# memo:hit_rate compare guard rc 0/1 both ways).  docs/memo.md is
+# the field guide.
+memo-smoke:      ## cross-job memoization: verdict cache + warm start + incremental re-check parity on CPU
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m memo -p no:cacheprovider
 	JAX_PLATFORMS=cpu $(PY) tools/obs_smoke.py
 
 dryrun:          ## multi-chip sharding dry run on a virtual CPU mesh

@@ -44,12 +44,14 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import shutil
 import subprocess
 import sys
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from dslabs_tpu.service import memo as memo_mod
 from dslabs_tpu.service.queue import Job, ServiceQueue
 from dslabs_tpu.service.scheduler import (AttemptPlan, DeficitRoundRobin,
                                           RetrySpec, degrade,
@@ -159,7 +161,9 @@ class CheckServer:
                  keep: Optional[int] = None,
                  lanes: Optional[int] = None,
                  lane_swap: Optional[bool] = None,
-                 telemetry=None):
+                 telemetry=None,
+                 memo: Optional[bool] = None,
+                 memo_path: Optional[str] = None):
         self.root = os.path.abspath(root)
         os.makedirs(self.root, exist_ok=True)
         self.queue = ServiceQueue(self.root, cap=queue_cap)
@@ -211,6 +215,21 @@ class CheckServer:
             "batches": 0, "jobs": 0, "swaps": 0, "evicted": 0,
             "occupancy_sum": 0.0, "by_signature": {}}
         self._lane_seq = 0
+        # Cross-job memoization (ISSUE 16, service/memo.py): ON by
+        # default in the service path (DSLABS_MEMO) — an identical
+        # resubmit returns its cached verdict with zero device
+        # dispatches, a budget-grown resubmit warm-starts from the
+        # signature's deepest checkpoint, and a one-handler edit
+        # re-checks incrementally from its divergence bound.  OFF
+        # leaves every existing path byte-identical (no memo dir, no
+        # memo events, no introspection children).
+        if memo is None:
+            memo = memo_mod.memo_enabled()
+        self.memo: Optional[memo_mod.MemoStore] = None
+        if memo:
+            self.memo = memo_mod.MemoStore(
+                memo_path or memo_mod.memo_dir(self.root))
+        self._intro_cache: Dict[tuple, dict] = {}
         self.status_path = os.path.join(self.root, SERVER_STATUS_NAME)
         self._lock = threading.Lock()
         self._running: Dict[str, int] = {}
@@ -257,10 +276,19 @@ class CheckServer:
         # every child's flight log) is stamped with it, and
         # `telemetry trace` reassembles the causal tree from disk.
         trace_id = tracing.mint_trace_id()
+        # Memo introspection (ISSUE 16): runs FIRST so the admission
+        # cache can key on the structural fingerprint (satellite:
+        # admission and memoization must never disagree about spec
+        # identity).  Same sandbox discipline as admission — a
+        # CPU-pinned child builds the protocol; a failed introspection
+        # is journaled and the job simply runs cold.
+        intro = self._introspect(factory, factory_kwargs, transform)
+        spec_fp = (intro or {}).get("spec_fp") \
+            if (intro or {}).get("ok") else None
         if self.admission:
             t_adm = time.time()
             findings, cached = self._admit(factory, factory_kwargs,
-                                           transform)
+                                           transform, fp=spec_fp)
             unwaived = [f for f in findings if not f.get("waived")]
             self.queue.log_event(
                 "admission", tenant=tenant, factory=factory,
@@ -292,6 +320,18 @@ class CheckServer:
                   frontier_cap=frontier_cap, visited_cap=visited_cap,
                   ladder=tuple(ladder), fault=fault,
                   trace_id=trace_id)
+        # Exact-key verdict cache (ISSUE 16 leg a): a structural +
+        # budget + knob match returns the cached verdict with ZERO
+        # device dispatches — journaled memo_hit, cached=true verdict,
+        # near-zero COSTS charge (no flight log to bill).
+        if (self.memo is not None and fault is None and spec_fp
+                and intro.get("ok")):
+            plan = self.memo.plan(
+                intro, strict, chunk, frontier_cap, visited_cap,
+                tuple(ladder), max_depth, max_secs,
+                env=self._memo_env())
+            if plan.mode == "hit":
+                return self._complete_memo_hit(job, st, plan)
         res = self.queue.submit(job)
         if res.get("accepted"):
             res["trace_id"] = trace_id
@@ -306,15 +346,23 @@ class CheckServer:
         self._write_status()
         return res
 
-    def _admit(self, factory, factory_kwargs,
-               transform) -> Tuple[List[dict], bool]:
+    def _admit(self, factory, factory_kwargs, transform,
+               fp: Optional[str] = None) -> Tuple[List[dict], bool]:
         """The cached admission check; returns ``(findings, cached)``
         so the journal's admission event can tell a paid subprocess
         check from a cache hit (their latencies differ by ~1000x and
-        the trace timeline should say which one a tenant waited on)."""
-        key = (factory,
-               json.dumps(factory_kwargs or {}, sort_keys=True),
-               transform or "")
+        the trace timeline should say which one a tenant waited on).
+
+        With memoization on, the cache keys on the STRUCTURAL spec
+        fingerprint (ISSUE 16 satellite) — the same identity the memo
+        store uses, so a rename-only resubmit hits both caches and
+        admission can never disagree with memoization about what a
+        spec IS.  Without a fingerprint (memo off, introspection
+        failed) the legacy source key applies."""
+        key = (("fp", fp) if fp else
+               (factory,
+                json.dumps(factory_kwargs or {}, sort_keys=True),
+                transform or ""))
         with self._lock:
             cached = self._admission_cache.get(key)
         if cached is not None:
@@ -325,6 +373,120 @@ class CheckServer:
         with self._lock:
             self._admission_cache[key] = findings
         return findings, False
+
+    # ---------------------------------------------------------- memo
+
+    def _memo_env(self) -> dict:
+        """The env the warden CHILD will actually see (os.environ
+        overlaid with the server's env) — the memo key's pack/symmetry
+        gates must be resolved exactly the way the engine will."""
+        return {**os.environ, **self.env}
+
+    def _introspect(self, factory, factory_kwargs,
+                    transform) -> Optional[dict]:
+        """Cached structural introspection (service/memo.py child).
+        The cache key includes the factory MODULE FILE's content hash:
+        a tenant editing the module in place gets a fresh child (fresh
+        interpreter, no stale ``sys.modules``), so an edited spec can
+        never ride a stale fingerprint into the verdict cache."""
+        if self.memo is None:
+            return None
+        src = memo_mod.factory_source_hash(factory, self.extra_sys_path)
+        key = (factory,
+               json.dumps(factory_kwargs or {}, sort_keys=True,
+                          default=repr),
+               transform or "", src or "?")
+        with self._lock:
+            hit = self._intro_cache.get(key)
+        if hit is not None:
+            return hit
+        intro = memo_mod.introspect_child(
+            factory, factory_kwargs, transform,
+            extra_sys_path=self.extra_sys_path, env=self.env)
+        if not intro.get("ok"):
+            self.queue.log_event(
+                "memo", mode="introspect_failed", factory=factory,
+                error=str(intro.get("error"))[:200])
+        with self._lock:
+            self._intro_cache[key] = intro
+        return intro
+
+    def _cached_verdict(self, job: Job, plan) -> dict:
+        cached = plan.verdict or {}
+        return {
+            "job_id": job.job_id, "tenant": job.tenant,
+            "trace_id": job.trace_id,
+            "budget_units": job.budget_units,
+            "status": "done",
+            "end": cached.get("end"),
+            "unique": cached.get("unique"),
+            "explored": cached.get("explored"),
+            "depth": cached.get("depth"),
+            "engine": cached.get("engine"),
+            "predicate": cached.get("predicate"),
+            "witness": cached.get("witness"),
+            "attempts": 0, "failovers": 0, "child_restarts": 0,
+            "knob_shrinks": 0, "rung_steps": 0,
+            "resumed_from_depth": 0, "degraded": False, "deaths": [],
+            "cached": True, "run_dir": self.job_dir(job.job_id),
+            "elapsed_secs": 0.0,
+        }
+
+    def _complete_memo_hit(self, job: Job, st: dict, plan) -> dict:
+        """Land a verdict-cache hit: the job enters and leaves the
+        journal in one motion (submit -> memo_hit -> done), the COSTS
+        charge bills its exact counters against NO flight log (device
+        seconds ~ 0), and no scheduler/warden work happens at all."""
+        res = self.queue.submit(job)
+        if not res.get("accepted"):
+            self.queue.mark_rejected(job.tenant, "queue_full",
+                                     {"trace_id": job.trace_id})
+            with self._lock:
+                st["rejected"] += 1
+            self._write_status()
+            return res
+        res["trace_id"] = job.trace_id
+        verdict = self._cached_verdict(job, plan)
+        self.queue.log_event(
+            "memo_hit", job_id=job.job_id, tenant=job.tenant,
+            trace_id=job.trace_id, sig=plan.sig,
+            device_secs_saved=round(plan.base_device_secs, 4))
+        self.queue.mark_done(job.job_id, {
+            "end": verdict["end"], "unique": verdict["unique"],
+            "explored": verdict["explored"], "depth": verdict["depth"],
+            "attempts": 0, "degraded": False, "cached": True})
+        self._charge(verdict, self.job_dir(job.job_id))
+        self.memo.bump("hits")
+        self.memo.bump("device_secs_saved", plan.base_device_secs)
+        with self._lock:
+            st["submitted"] += 1
+            st["completed"] += 1
+            st["verdicts"] += 1
+            self.results.append(verdict)
+        self._write_status()
+        res["verdict"] = verdict
+        res["memo"] = "hit"
+        return res
+
+    def _memo_plan(self, job: Job):
+        """(intro, plan) for one job at RUN time (restart replay safe:
+        recomputes from the intro cache or a fresh child)."""
+        if self.memo is None:
+            return None, None
+        intro = self._introspect(job.factory, job.factory_kwargs,
+                                 job.transform)
+        if not intro or not intro.get("ok"):
+            return intro, None
+        plan = self.memo.plan(
+            intro, job.strict, job.chunk, job.frontier_cap,
+            job.visited_cap, tuple(job.ladder), job.max_depth,
+            job.max_secs, env=self._memo_env())
+        if job.fault is not None and plan.mode == "hit":
+            # Fault experiments always RUN (the injected condition is
+            # the point); warm/incremental seeding still applies — the
+            # seeded job survives its SIGKILL via the normal resume.
+            return intro, None
+        return intro, plan
 
     # ------------------------------------------------------------ run job
 
@@ -343,6 +505,52 @@ class CheckServer:
         rd = self.job_dir(job.job_id)
         os.makedirs(rd, exist_ok=True)
         ckpt = os.path.join(rd, "ckpt.npz")
+        intro, mplan = self._memo_plan(job)
+        if mplan is not None and mplan.mode == "hit":
+            # A sibling job archived this exact signature between
+            # submit and run (drain ordering) — land it as a hit.
+            verdict = self._cached_verdict(job, mplan)
+            self.queue.log_event(
+                "memo_hit", job_id=job.job_id, tenant=job.tenant,
+                trace_id=job.trace_id, sig=mplan.sig,
+                device_secs_saved=round(mplan.base_device_secs, 4))
+            self.queue.mark_done(job.job_id, {
+                "end": verdict["end"], "unique": verdict["unique"],
+                "explored": verdict["explored"],
+                "depth": verdict["depth"], "attempts": 0,
+                "degraded": False, "cached": True})
+            self._charge(verdict, rd)
+            self.memo.bump("hits")
+            self.memo.bump("device_secs_saved", mplan.base_device_secs)
+            return verdict
+        seeded = False
+        if (mplan is not None and mplan.mode in ("warm", "incremental")
+                and mplan.seed_ckpt and not os.path.exists(ckpt)):
+            # Pre-seed the job's own durable checkpoint from the
+            # archived signature state; the warden child resumes it
+            # via the EXISTING checkpoint path — no new plumbing in
+            # the engine, and a crash mid-run keeps the job's own
+            # (deeper) checkpoint on later attempts.
+            tmp = ckpt + ".seed"
+            shutil.copyfile(mplan.seed_ckpt, tmp)
+            os.replace(tmp, ckpt)
+            seeded = True
+            self.memo.bump("warm_starts" if mplan.mode == "warm"
+                           else "incremental")
+            if mplan.mode == "incremental":
+                self.memo.bump("levels_skipped", mplan.levels_skipped)
+            self.queue.log_event(
+                "memo", mode=mplan.mode, job_id=job.job_id,
+                tenant=job.tenant, trace_id=job.trace_id,
+                sig=mplan.sig, seed_depth=mplan.seed_depth,
+                levels_skipped=mplan.levels_skipped,
+                reason=mplan.reason)
+        elif self.memo is not None:
+            self.memo.bump("misses")
+        wenv = dict(self.env)
+        if self.memo is not None:
+            # Per-level archives for future incremental re-checks.
+            wenv["DSLABS_MEMO_LEVELS"] = os.path.join(rd, "levels")
         plan = AttemptPlan(attempt=1, chunk=job.chunk,
                            ladder=tuple(job.ladder))
         deaths: List[dict] = []
@@ -362,7 +570,7 @@ class CheckServer:
                 # Injected faults model an environment condition of the
                 # FIRST attempt; a scheduler-level retry runs clean.
                 fault=(job.fault if plan.attempt == 1 else None),
-                env=dict(self.env),
+                env=wenv,
                 extra_sys_path=self.extra_sys_path,
                 elastic=self.elastic,
                 # Trace propagation (ISSUE 13): the warden forwards
@@ -374,11 +582,28 @@ class CheckServer:
                 parent_span=plan.span_id(job.job_id),
                 **self.warden_kwargs)
             try:
-                out = w.run(resume=plan.attempt > 1)
+                out = w.run(resume=plan.attempt > 1 or seeded)
             except SupervisorExhausted:
                 deaths += [{"rung": d.rung, "kind": d.kind,
                             "detail": d.detail[:200]} for d in w.deaths]
                 kind = w.deaths[-1].kind if w.deaths else "failed"
+                if seeded and any("Checkpoint" in d.get("detail", "")
+                                  for d in deaths):
+                    # A refused/torn memo seed must never fail the
+                    # job: abandon the seed loudly and run cold.
+                    for p in (ckpt, ckpt + ".prev"):
+                        try:
+                            os.remove(p)
+                        except OSError:
+                            pass
+                    seeded = False
+                    deaths = []
+                    self.queue.log_event(
+                        "memo", mode="seed_abandoned",
+                        job_id=job.job_id, trace_id=job.trace_id,
+                        detail=(w.deaths[-1].detail[:200]
+                                if w.deaths else ""))
+                    continue
                 nxt = degrade(plan, kind, self.retry)
                 if nxt is None:
                     failure = {
@@ -429,6 +654,10 @@ class CheckServer:
                 "explored": out.states_explored,
                 "depth": out.depth,
                 "engine": out.engine,
+                "predicate": out.predicate_name,
+                "witness": memo_mod.witness_digest(
+                    out.predicate_name, out.violating_state,
+                    out.goal_state, out.trace),
                 "attempts": plan.attempt,
                 "failovers": out.failovers,
                 "child_restarts": out.child_restarts,
@@ -447,6 +676,30 @@ class CheckServer:
                 "attempts": plan.attempt,
                 "degraded": verdict["degraded"]})
             self._charge(verdict, rd)
+            if self.memo is not None and intro and intro.get("ok"):
+                try:
+                    dsecs = tracing.CostMeter.flight_costs(
+                        os.path.join(rd, "flight.jsonl"))["device_secs"]
+                except Exception:  # noqa: BLE001
+                    dsecs = 0.0
+                try:
+                    fields = memo_mod.key_fields(
+                        intro, job.strict, job.chunk, job.frontier_cap,
+                        job.visited_cap, tuple(job.ladder),
+                        env=self._memo_env())
+                    self.memo.archive(intro, fields, verdict, rd, dsecs)
+                    self.memo.record_verdict(fields, job.max_depth,
+                                             job.max_secs, verdict,
+                                             dsecs)
+                except Exception as e:  # noqa: BLE001 — reuse is best-effort
+                    self.queue.log_event(
+                        "memo", mode="archive_failed",
+                        job_id=job.job_id,
+                        error=f"{type(e).__name__}: {e}"[:200])
+                if seeded and mplan is not None:
+                    self.memo.bump(
+                        "device_secs_saved",
+                        max(0.0, mplan.base_device_secs - dsecs))
             return verdict
 
     def run_job_batch(self, jobs: List["Job"]) -> List[dict]:
@@ -747,6 +1000,11 @@ class CheckServer:
             # mean dispatches billed per job (share-scaled across lane
             # batches), the number the ledger compare guards.
             "lanes": self._lane_block(),
+            # Cross-job reuse (ISSUE 16): hits / warm starts /
+            # incremental re-checks and the device-seconds they saved
+            # — the multiplier the ledger compare guards.
+            "memo": (self.memo.stats_block() if self.memo is not None
+                     else {"enabled": False}),
             "dispatches_per_job": totals.get("dispatches_per_job"),
             "per_tenant": per_tenant,
             # The cost ledger's view (tpu/tracing.py CostMeter):
@@ -821,6 +1079,10 @@ class CheckServer:
                 # Batched-lane observability (ISSUE 14): occupancy,
                 # packing decisions, per-signature batch sizes.
                 "lanes": lane_block,
+                # Cross-job memoization counters (ISSUE 16).
+                "memo": (self.memo.stats_block()
+                         if self.memo is not None
+                         else {"enabled": False}),
             }
 
     def _write_status(self, force: bool = False) -> None:

@@ -71,6 +71,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import shutil
 import threading
 import warnings
 import zlib
@@ -256,6 +257,28 @@ def save(path: str, ckpt: SearchCheckpoint) -> None:
     if os.path.exists(path):
         os.replace(path, path + ".prev")
     os.replace(tmp, path)
+    _archive_level(path, int(ckpt.depth))
+
+
+def _archive_level(path: str, depth: int) -> None:
+    """Per-level checkpoint archiving (ISSUE 16, service/memo.py): when
+    ``DSLABS_MEMO_LEVELS`` names a directory, every completed dump is
+    ALSO copied there as ``level_<depth>.npz`` — the incremental
+    re-check ladder resumes a spec-edited job from the deepest level
+    below its divergence bound.  Best-effort by design: the archive
+    must never fail a live dump, and every consumer re-verifies the
+    copy's own checksum + config fingerprint before seeding from it."""
+    lvl_dir = os.environ.get("DSLABS_MEMO_LEVELS")
+    if not lvl_dir:
+        return
+    try:
+        os.makedirs(lvl_dir, exist_ok=True)
+        dst = os.path.join(lvl_dir, f"level_{depth}.npz")
+        tmp = dst + ".tmp"
+        shutil.copyfile(path, tmp)
+        os.replace(tmp, dst)
+    except OSError:
+        pass
 
 
 def _candidates(path: str):

@@ -86,7 +86,9 @@ __all__ = ["SpillConfig", "SpillStats", "HostVisitedTier",
            "FrontierSpool", "SpillManager", "spill_env_default",
            "spill_manager_for_audit",
            "VISITED_WARN_DEFAULT", "DROPPED_WARN_DEFAULT",
-           "visited_warn_threshold", "dropped_warn_threshold"]
+           "visited_warn_threshold", "dropped_warn_threshold",
+           "TIER_FORMAT", "TierMismatch", "TierCorrupt",
+           "save_tier", "load_tier", "peek_tier_meta"]
 
 VISITED_WARN_DEFAULT = 0.85
 DROPPED_WARN_DEFAULT = 1_000_000
@@ -285,6 +287,136 @@ class HostVisitedTier:
     def key_rows(self) -> np.ndarray:
         """The whole tier as [K, 4] uint32 rows (checkpoint union)."""
         return _u64_to_rows(self.h1, self.h2)
+
+
+# ------------------------------------------------- tier persistence
+#
+# Versioned on-disk format for the exact host tier (ISSUE 16 satellite:
+# the cross-job memo store persists one tier per spec signature).  Same
+# durability discipline as tpu/checkpoint.py: CRC32 content checksum,
+# atomic tmp+replace with one-deep ``.prev`` rotation, and a LOUD
+# refusal — never a silent empty tier — when the file is foreign (pack
+# descriptor or symmetry flag differs from what the consumer expects)
+# or torn (checksum mismatch on every candidate).
+
+TIER_FORMAT = "dslabs-visited-tier-v1"
+
+
+class TierMismatch(RuntimeError):
+    """The tier on disk belongs to a different configuration (foreign
+    pack descriptor, symmetry flag, or format version): its (h1, h2)
+    fingerprints hash a DIFFERENT encoding of state, so absorbing them
+    would silently corrupt exact-dedup counts."""
+
+
+class TierCorrupt(RuntimeError):
+    """No candidate tier file passed the content checksum."""
+
+
+def _tier_checksum(h1: np.ndarray, h2: np.ndarray,
+                   meta_blob: bytes) -> np.uint32:
+    import zlib
+
+    crc = zlib.crc32(meta_blob)
+    crc = zlib.crc32(np.ascontiguousarray(h1).tobytes(), crc)
+    crc = zlib.crc32(np.ascontiguousarray(h2).tobytes(), crc)
+    return np.uint32(crc & 0xFFFFFFFF)
+
+
+def save_tier(path: str, h1: np.ndarray, h2: np.ndarray,
+              meta: Optional[dict] = None) -> None:
+    """Atomic checksummed tier dump with one-deep rotation.  ``meta``
+    pins the encoding identity (``pack`` descriptor signature,
+    ``sym`` perm count, anything else the producer wants checked);
+    :func:`load_tier` refuses a mismatch loudly."""
+    import json
+
+    full = {"fmt": TIER_FORMAT}
+    full.update(meta or {})
+    blob = json.dumps(full, sort_keys=True).encode()
+    h1 = np.asarray(h1, np.uint64)
+    h2 = np.asarray(h2, np.uint64)
+    host = {"meta": np.bytes_(blob), "h1": h1, "h2": h2,
+            "checksum": _tier_checksum(h1, h2, blob)}
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **host)
+    if os.path.exists(path):
+        os.replace(path, path + ".prev")
+    os.replace(tmp, path)
+
+
+def peek_tier_meta(path: str) -> Optional[dict]:
+    """The tier's meta dict without loading the key arrays, or None
+    when no readable candidate exists."""
+    import json
+
+    for cand in (path, path + ".prev"):
+        if not os.path.exists(cand):
+            continue
+        try:
+            with np.load(cand) as z:
+                if "meta" in z.files:
+                    return json.loads(z["meta"].item().decode())
+        except Exception:  # noqa: BLE001 — torn file: try .prev
+            continue
+    return None
+
+
+def load_tier(path: str, expect_meta: Optional[dict] = None
+              ) -> Tuple[np.ndarray, np.ndarray, dict]:
+    """Load and VERIFY a tier dump -> ``(h1, h2, meta)``.
+
+    * A checksum-failing main file falls back to ``.prev`` with a
+      warning; when every candidate fails, :class:`TierCorrupt`.
+    * ``expect_meta``: every key the caller passes must match the
+      stored meta EXACTLY (plus the format version, always checked) —
+      a foreign pack descriptor or symmetry flag raises
+      :class:`TierMismatch` naming both sides, never returns keys."""
+    import json
+    import warnings
+
+    last_err: Optional[str] = None
+    for cand in (path, path + ".prev"):
+        if not os.path.exists(cand):
+            continue
+        try:
+            with np.load(cand) as z:
+                data = {k: z[k] for k in z.files}
+        except Exception as e:  # noqa: BLE001 — torn zip: try .prev
+            last_err = f"{cand}: unreadable ({type(e).__name__}: {e})"
+            continue
+        if not all(k in data for k in ("meta", "h1", "h2", "checksum")):
+            last_err = f"{cand}: not a tier dump (missing entries)"
+            continue
+        blob = data["meta"].item()
+        h1 = np.asarray(data["h1"], np.uint64)
+        h2 = np.asarray(data["h2"], np.uint64)
+        want = int(np.uint32(data["checksum"]))
+        got = int(_tier_checksum(h1, h2, blob))
+        if want != got:
+            last_err = (f"{cand}: tier checksum mismatch "
+                        f"(stored {want:#010x}, computed {got:#010x})")
+            continue
+        if cand.endswith(".prev") and last_err:
+            warnings.warn(f"tier {path}: main dump unusable "
+                          f"({last_err}); resuming from .prev",
+                          RuntimeWarning, stacklevel=2)
+        meta = json.loads(blob.decode())
+        if meta.get("fmt") != TIER_FORMAT:
+            raise TierMismatch(
+                f"{cand}: tier format {meta.get('fmt')!r} != expected "
+                f"{TIER_FORMAT!r} — refusing a cross-version tier")
+        for k, v in (expect_meta or {}).items():
+            if meta.get(k) != v:
+                raise TierMismatch(
+                    f"{cand}: tier {k!r} mismatch — stored "
+                    f"{meta.get(k)!r}, expected {v!r} (a foreign "
+                    "encoding must never seed exact-dedup state)")
+        return h1, h2, meta
+    raise TierCorrupt(
+        f"{path}: no loadable tier candidate "
+        f"({last_err or 'no file exists'})")
 
 
 class FrontierSpool:

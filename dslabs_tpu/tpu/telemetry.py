@@ -1392,7 +1392,7 @@ def read_ledger(path: str) -> List[dict]:
 # The bench phases a ledger compare diffs ("headline" is the last-line
 # JSON's top-level value — the number the BENCH_r0N trajectory tracks).
 _LEDGER_PHASES = ("headline", "mesh", "strict", "beam", "swarm",
-                  "spill", "capacity2", "service", "lanes",
+                  "spill", "capacity2", "service", "lanes", "memo",
                   "cpu_fallback")
 
 # Resilience counters the ledger tracks beside the rates (ISSUE 9):
@@ -1705,6 +1705,59 @@ def compare_ledger(records: List[dict],
         cmp["capacity"]["bytes_per_state"] = entry
         if lv > best * (1.0 + threshold):
             cmp["regressions"].append(entry)
+    # Cross-job memoization guard (ISSUE 16, service/memo.py): the
+    # memo phase's hit_rate vs the BEST (highest) prior — a drop past
+    # the threshold means identical resubmits stopped reusing verdicts
+    # (fingerprint churn, store invalidation bug), the throughput
+    # multiplier silently lost even at equal cold-run states/min.
+    # device_secs_saved is tracked beside it (rendered, not guarded:
+    # its magnitude scales with workload, the RATE is the invariant).
+    cmp["memo"] = {}
+
+    def _hit_rate(rec):
+        s = rec.get("memo")
+        if not isinstance(s, dict):
+            return None
+        try:
+            v = float(s.get("hit_rate"))
+        except (TypeError, ValueError):
+            return None
+        return v if v >= 0 else None
+
+    lv = _hit_rate(latest)
+    priors_h = [v for v in (_hit_rate(r) for r in prior)
+                if v is not None]
+    if lv is not None and priors_h:
+        best = max(priors_h)
+        entry = {"phase": "memo:hit_rate",
+                 "latest": round(lv, 3), "best_prior": round(best, 3),
+                 "delta_pct": round((lv - best) / best * 100, 1)
+                 if best > 0 else 0.0}
+        cmp["memo"]["hit_rate"] = entry
+        if lv < best * (1.0 - threshold):
+            cmp["regressions"].append(entry)
+
+    def _saved(rec):
+        for block in ("memo", "service"):
+            s = rec.get(block)
+            if isinstance(s, dict):
+                try:
+                    v = float(s.get("device_secs_saved"))
+                except (TypeError, ValueError):
+                    continue
+                if v >= 0:
+                    return v
+        return None
+
+    lv = _saved(latest)
+    priors_s = [v for v in (_saved(r) for r in prior) if v is not None]
+    if lv is not None and priors_s:
+        best = max(priors_s)
+        cmp["memo"]["device_secs_saved"] = {
+            "phase": "service:device_secs_saved",
+            "latest": round(lv, 3), "best_prior": round(best, 3),
+            "delta_pct": round((lv - best) / best * 100, 1)
+            if best > 0 else 0.0}
     return cmp
 
 
@@ -1750,6 +1803,10 @@ def render_compare(cmp: dict, source: str = "") -> str:
                    f"({e['delta_pct']:+.1f}%)")
     for c, e in sorted(cmp.get("capacity", {}).items()):
         out.append(f"capacity {c:16s} latest={e['latest']} "
+                   f"prior_best={e['best_prior']} "
+                   f"({e['delta_pct']:+.1f}%)")
+    for c, e in sorted(cmp.get("memo", {}).items()):
+        out.append(f"memo {c:20s} latest={e['latest']} "
                    f"prior_best={e['best_prior']} "
                    f"({e['delta_pct']:+.1f}%)")
     for e in cmp["regressions"]:

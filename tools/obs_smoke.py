@@ -218,6 +218,57 @@ def main() -> int:
     flagged = {e["phase"] for e in cmp["regressions"]}
     assert "capacity:bytes_per_state" in flagged, cmp
     print("obs-smoke: packed path + capacity compare guard OK")
+
+    # -- memo leg (ISSUE 16, service/memo.py): the same job drained
+    # TWICE through a real CheckServer — the second drain lands as a
+    # journaled memo_hit with zero dispatches — then the compare
+    # guard exercised rc 0/1 both ways: steady hit_rate passes, an
+    # injected hit_rate collapse flags ``memo:hit_rate``, and
+    # ``service:device_secs_saved`` renders in the compare output.
+    from dslabs_tpu.service import CheckServer
+
+    memo_root = tempfile.mkdtemp(prefix="dslabs_obs_smoke_memo_")
+    srv = CheckServer(
+        memo_root, workers=1, admission=False, elastic=False,
+        env={"DSLABS_COMPILE_CACHE":
+             os.environ.get("DSLABS_COMPILE_CACHE",
+                            "/tmp/jaxcache-cpu")})
+    job = dict(factory="dslabs_tpu.tpu.protocols.pingpong:"
+                       "make_exhaustive_pingpong",
+               factory_kwargs={"workload_size": 2}, chunk=64,
+               frontier_cap=1 << 8, visited_cap=1 << 12)
+    srv.submit(tenant="first", **job)
+    first = srv.drain()
+    assert first["completed"] == 1, first
+    srv.submit(tenant="second", **job)
+    second = srv.drain()
+    srv.close()
+    assert second["memo"]["hits"] == 1, second["memo"]
+    with open(os.path.join(memo_root, "journal.jsonl")) as f:
+        kinds = [json.loads(ln).get("t") for ln in f if ln.strip()]
+    assert "memo_hit" in kinds, kinds
+    memo_ok = os.path.join(run_dir, "memo_parity.jsonl")
+    base = {"t": "bench", "value": 100.0,
+            "memo": {"value": 40.0, "hit_rate": 0.5,
+                     "device_secs_saved": 2.0}}
+    for _ in range(2):
+        tel_mod.append_ledger(memo_ok, base)
+    rc = tel_mod.main(["compare", memo_ok])
+    assert rc == 0, "steady memo hit_rate must not flag"
+    memo_bad = os.path.join(run_dir, "memo_regress.jsonl")
+    tel_mod.append_ledger(memo_bad, base)
+    tel_mod.append_ledger(memo_bad, {
+        "t": "bench", "value": 100.0,
+        "memo": {"value": 40.0, "hit_rate": 0.05,
+                 "device_secs_saved": 0.1}})
+    rc = tel_mod.main(["compare", memo_bad])
+    assert rc == 1, "hit_rate collapse must flag"
+    cmp = tel_mod.compare_ledger(tel_mod.read_ledger(memo_bad))
+    flagged = {e["phase"] for e in cmp["regressions"]}
+    assert "memo:hit_rate" in flagged, cmp
+    rendered = tel_mod.render_compare(cmp)
+    assert "device_secs_saved" in rendered, rendered
+    print("obs-smoke: memo drain-twice hit + hit_rate guard OK")
     print(json.dumps({"obs_smoke": "ok", "run_dir": run_dir,
                       "trace_dir": trace_dir, "trace_id": trace_id}))
     return 0
