@@ -166,9 +166,16 @@ trace-smoke:     ## causal tracing + cost-ledger suite (assembler / COSTS / rete
 # cross-width checkpoint resume chain 8->4->2->1, first-class carry
 # placement (partition rules -> NamedSharding everywhere), and the
 # bench --mesh phase schema — all on the CPU virtual 8-device mesh, no
-# TPU hardware needed.  docs/perf.md "mesh dispatch model" is the
-# field guide.
-mesh-smoke:      ## owner-sharded superstep width-parity matrix + Pallas kernel suite on CPU
+# TPU hardware needed.  ISSUE 18 adds the packed-wire suite
+# (tests/test_mesh_packing.py): packed-vs-raw exchange parity across
+# widths {1,2,4,8} + the >= 8x wire bytes-per-state floor, the
+# delta-lane (varint) pb parity, cross-width resume through the packed
+# checkpoint format, the root-fanout/work-stealing imbalance
+# acceptance, packed-spill parity at 1/8 capacity, the
+# pack/decode/steal dispatch-site audits, and the mesh_unpacked /
+# skew_agg observability pins.  docs/perf.md "mesh dispatch model" +
+# "The wire format" are the field guides.
+mesh-smoke:      ## owner-sharded superstep width-parity + packed-wire/steal suite on CPU
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m mesh -p no:cacheprovider
 
 # lanes-smoke = the batched-job-lanes suite (tests/test_lanes.py,

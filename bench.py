@@ -487,6 +487,11 @@ def _run_mesh(budget_secs: float) -> dict:
     import dataclasses
 
     width = int(os.environ.get("DSLABS_MESH_WIDTH", "8") or "8")
+    # The headline benches the balanced mesh (ISSUE 18): root-fanout
+    # seeding plus chunk-granular stealing at level boundaries.  An
+    # explicit DSLABS_MESH_STEAL_THRESHOLD (including "0" = off, the
+    # parity oracle) wins.
+    os.environ.setdefault("DSLABS_MESH_STEAL_THRESHOLD", "1.5")
     _persistent_cache()
     import jax
 
@@ -499,12 +504,14 @@ def _run_mesh(budget_secs: float) -> dict:
     platform = mesh.devices.flat[0].platform
     virtual = platform == "cpu"
     if virtual:
-        from dslabs_tpu.tpu.protocols.clientserver import \
-            make_clientserver_protocol
+        # The GENERATED lab1 spec (identical state space to the hand
+        # twin — 150 unique / 831 explored at depth 6) so the packed
+        # wire engages: the hand protocol derives the identity codec
+        # and would bench raw lanes (ISSUE 18a).
+        from dslabs_tpu.tpu.specs import clientserver_spec
 
         proto = dataclasses.replace(
-            make_clientserver_protocol(n_clients=3, w=4, net_cap=32),
-            goals={})
+            clientserver_spec(3, 4).compile(), goals={})
         config = f"lab1-clientserver c3-w4 strict mesh x{width}"
         kw = dict(chunk=256, frontier_cap=1 << 13,
                   visited_cap=1 << 17)
@@ -535,11 +542,35 @@ def _run_mesh(budget_secs: float) -> dict:
            if lv.get("skew")]
     cv = [lv["skew"]["explored"]["cv"] for lv in levels
           if lv.get("skew")]
+    post = [lv["skew"]["frontier_post_steal"]["imbalance"]
+            for lv in levels
+            if lv.get("skew", {}).get("frontier_post_steal")]
+    stolen = sum(int(lv["steal"]["moved"]) for lv in levels
+                 if lv.get("steal"))
     skew = {
         "imbalance_max": round(max(imb), 4) if imb else 1.0,
         "imbalance_mean": round(sum(imb) / len(imb), 4) if imb else 1.0,
         "cv_max": round(max(cv), 4) if cv else 0.0,
         "levels_measured": len(imb),
+        # Post-rebalance frontier skew (ISSUE 18c): the imbalance the
+        # NEXT level actually expands with, after fanout + stealing.
+        "imbalance_max_post_steal": round(max(post), 4) if post else
+        (round(max(imb), 4) if imb else 1.0),
+        "steal_levels": len(post),
+        "stolen_rows": stolen,
+    }
+    # Estimated ICI wire bytes per exchanged state (ISSUE 18a): the
+    # packed row width the all_to_all actually ships (the engine stamps
+    # it on the outcome) vs the raw-lane width, plus the 16-byte
+    # fingerprint key that rides beside every row either way.  The
+    # ledger guards wire_bytes_per_state (telemetry compare, rc 1 on a
+    # rise: the codec fell back to identity).
+    wire = {
+        "wire_bytes_per_state": int(outcome.bytes_per_state or 0),
+        "wire_bytes_per_state_raw": int(
+            outcome.bytes_per_state_unpacked or 0),
+        "key_bytes_per_state": 16,
+        "pack_ratio": float(outcome.pack_ratio or 1.0),
     }
     return {
         "value": outcome.unique_states / elapsed * 60.0,
@@ -557,6 +588,11 @@ def _run_mesh(budget_secs: float) -> dict:
         "mesh_width": width,
         "virtual_cpu_mesh": virtual,
         "skew": skew,
+        # Top-level copies the ledger guards read (telemetry
+        # compare_ledger: mesh:wire_bytes_per_state rises or
+        # mesh:imbalance_max rises past threshold -> rc 1).
+        "imbalance_max": skew["imbalance_max_post_steal"],
+        "wire": wire,
         "levels": levels,
         "retries": outcome.retries,
         "failovers": outcome.failovers,

@@ -90,6 +90,14 @@ class Field:
     value is a CapacityOverflow, never silent corruption, and init
     values are range-checked at compile time.
 
+    ``delta`` declares an UNBOUNDED monotone-ish counter (view
+    numbers, liveness ticks — fields a static ``hi`` cannot cap) for
+    the delta-from-level-base encoding (ISSUE 18, tpu/packing.py):
+    the mesh engine stores ``v - base`` in ``delta`` bits, carrying
+    the per-level base alongside the frontier; engines that do not
+    track a base (the single-device path) keep the full int32 lane.
+    ``delta`` and ``hi`` are mutually exclusive.
+
     ``index_group`` names a node KIND whose instances index this array
     field (size must equal that kind's count): when the kind is
     declared in the spec's ``symmetry`` groups, the canonicalize pass
@@ -103,6 +111,7 @@ class Field:
     lo: int = 0
     hi: Optional[int] = None
     index_group: Optional[str] = None
+    delta: Optional[int] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -450,6 +459,13 @@ class ProtocolSpec:
                             f"{kind_counts[f.index_group]} instances",
                             spec=self.name, kind=kind.name,
                             field=f.name, code="C5")
+                if f.delta is not None and f.hi is not None:
+                    raise SpecError(
+                        f"field {f.name!r} on kind {kind.name!r} "
+                        "declares both hi= and delta= — a bounded "
+                        "domain and the delta-from-base lane are "
+                        "mutually exclusive", spec=self.name,
+                        kind=kind.name, field=f.name, code="C5")
                 # Init values must sit inside the declared domain —
                 # the packed encoding would otherwise corrupt the root
                 # state silently (tpu/packing.py).
@@ -479,7 +495,15 @@ class ProtocolSpec:
         nodes = []
         for kind, _i in self._instances():
             for f in kind.fields:
-                dom = (f.lo, f.hi) if f.hi is not None else None
+                if f.hi is not None:
+                    dom = (f.lo, f.hi)
+                elif f.delta is not None:
+                    # Delta-from-base lane (ISSUE 18): engines that
+                    # carry a level base pack this in f.delta bits;
+                    # others derive it as raw (packing.derive_packing).
+                    dom = ("delta", int(f.delta))
+                else:
+                    dom = None
                 nodes += [dom] * f.size
         node_dom = (0, max(n_nodes - 1, 0))
 

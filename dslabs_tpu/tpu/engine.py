@@ -1162,7 +1162,22 @@ class TensorSearch:
                 f"({enc}) on an unpacked engine — converting the "
                 f"frontier rows (loud by contract, never silent)",
                 RuntimeWarning, stacklevel=3)
-        ck.frontier = pk.unpack_np(ck.frontier) if len(ck.frontier) \
+        # Delta-lane dumps (ISSUE 18 leg (b)) carry the level base the
+        # rows were packed against; a delta descriptor without one is
+        # a corrupt/foreign dump, refused loudly.
+        base = None
+        if ck.extra and "pack_base" in ck.extra:
+            base = np.asarray(ck.extra["pack_base"],
+                              np.int32).reshape(-1)
+            ck.extra = {k: v for k, v in ck.extra.items()
+                        if k != "pack_base"} or None
+        if pk.has_delta and base is None:
+            raise ckpt_mod.CheckpointMismatch(
+                f"packed checkpoint {enc!r} uses delta lanes but "
+                "carries no pack_base vector — corrupt or foreign "
+                "dump, refusing to guess a bias")
+        ck.frontier = pk.unpack_np(ck.frontier, base) \
+            if len(ck.frontier) \
             else np.zeros((0, self.lanes), np.int32)
 
     @property

@@ -31,6 +31,17 @@ Descriptor model (``LanePacking``):
   the width derivation reserves it);
 * an unbounded lane (``None`` domain — hand twins declare nothing)
   stays a raw 32-bit word, SENTINEL passes through untouched;
+* a **delta lane** (``("delta", bits)`` domain, from
+  ``Field(delta=bits)`` — ISSUE 18 leg (b)) is an unbounded
+  monotone-ish counter (view numbers, liveness ticks) packed as
+  ``v - base`` in ``bits`` bits, where ``base`` is a per-lane int32
+  the CALLER carries (the sharded engine tracks the per-level minimum
+  and re-bases at promote).  Delta lanes are opt-in
+  (``derive_packing(..., delta=True)``) because the base plumbing is
+  an engine contract; with ``delta=False`` (the single-device default)
+  a delta domain derives as raw, so both engines agree on the static
+  part of the layout.  A value outside the ``[base, base + window)``
+  wire window counts as out-of-domain — loud, never silent;
 * lanes are laid out first-fit in declaration order and never straddle
   a word boundary, so pack/unpack are shift+mask on one word each.
 
@@ -80,7 +91,9 @@ class LanePacking:
     Arrays are all length ``lanes`` (np int64/bool constants baked into
     the traced programs): ``word``/``shift``/``width`` place each lane,
     ``lo`` is the domain bias, ``sent`` marks SENTINEL-capable lanes,
-    ``raw`` marks 32-bit passthrough lanes."""
+    ``raw`` marks 32-bit passthrough lanes, ``dlt`` marks
+    delta-from-base lanes (bias supplied at pack/unpack time via the
+    ``base`` vector instead of the static ``lo``)."""
 
     lanes: int
     words: int
@@ -90,6 +103,12 @@ class LanePacking:
     lo: np.ndarray
     sent: np.ndarray
     raw: np.ndarray
+    dlt: Optional[np.ndarray] = None
+
+    def __post_init__(self):
+        if self.dlt is None:
+            object.__setattr__(self, "dlt",
+                               np.zeros(self.lanes, bool))
 
     # ------------------------------------------------------------ meta
 
@@ -98,6 +117,18 @@ class LanePacking:
         """True when packing is a no-op (every lane raw, one word per
         lane) — the hand-twin default; callers skip the wrap entirely."""
         return self.words == self.lanes and bool(self.raw.all())
+
+    @property
+    def has_delta(self) -> bool:
+        """True when any lane is delta-from-base encoded — pack/unpack
+        then REQUIRE the ``base`` vector (a missing base is a loud
+        ValueError, never a silent zero-bias decode)."""
+        return bool(self.dlt.any())
+
+    @property
+    def delta_lanes(self) -> np.ndarray:
+        """Flat lane indices of the delta-encoded lanes, in order."""
+        return np.nonzero(self.dlt)[0]
 
     @property
     def bytes_per_state(self) -> int:
@@ -121,12 +152,18 @@ class LanePacking:
         rows.  Rides checkpoints as the ``frontier_encoding`` marker."""
         if self.identity:
             return "raw"
-        blob = np.concatenate([
+        parts = [
             np.asarray([self.lanes, self.words], np.int64),
             self.word.astype(np.int64), self.shift.astype(np.int64),
             self.width.astype(np.int64), self.lo.astype(np.int64),
             self.sent.astype(np.int64), self.raw.astype(np.int64),
-        ]).tobytes()
+        ]
+        # Delta lanes extend the blob ONLY when present, so every
+        # pre-existing (static-domain) descriptor keeps its signature
+        # and old checkpoints keep resuming.
+        if self.has_delta:
+            parts.append(self.dlt.astype(np.int64))
+        blob = np.concatenate(parts).tobytes()
         return f"packed:{self.words}w:{zlib.crc32(blob) & 0xFFFFFFFF:08x}"
 
     def descriptor(self) -> dict:
@@ -140,6 +177,7 @@ class LanePacking:
             "pack_ratio": round(self.pack_ratio, 3),
             "signature": self.signature(),
             "lane_bits": [int(w) for w in self.width],
+            "delta_lanes": int(self.dlt.sum()),
         }
 
     # ----------------------------------------------- word/lane ranges
@@ -155,18 +193,38 @@ class LanePacking:
 
     # ------------------------------------------------------- jnp path
 
-    def pack_jnp(self, rows, count_bad: bool = False):
+    def _require_base(self, base):
+        if self.has_delta and base is None:
+            raise ValueError(
+                "packing descriptor has delta lanes but no base vector "
+                "was supplied — the caller must carry the per-level "
+                "base (see ISSUE 18 leg (b))")
+
+    def _lo_eff_jnp(self, base):
+        """Effective per-lane bias: the static ``lo`` except on delta
+        lanes, where the caller's ``base`` vector [lanes] supplies it."""
+        import jax.numpy as jnp
+
+        lo = jnp.asarray(self.lo, jnp.int32)
+        if not self.has_delta:
+            return lo
+        return jnp.where(jnp.asarray(self.dlt),
+                         jnp.asarray(base, jnp.int32).reshape(-1), lo)
+
+    def pack_jnp(self, rows, base=None, count_bad: bool = False):
         """[N, lanes] int32 -> [N, words] int32 (device).  With
         ``count_bad``, also returns an int32 [N] vector counting each
         row's values OUTSIDE their declared domain (callers mask to
         live rows and raise loudly — a wrong bound must never silently
-        corrupt a stored state)."""
+        corrupt a stored state).  ``base`` is the [lanes] int32 bias
+        vector, required iff the descriptor has delta lanes."""
         import jax.numpy as jnp
 
+        self._require_base(base)
         if self.identity:
             return ((rows, jnp.zeros((rows.shape[0],), jnp.int32))
                     if count_bad else rows)
-        lo = jnp.asarray(self.lo, jnp.int32)
+        lo = self._lo_eff_jnp(base)
         raw = jnp.asarray(self.raw)
         sent = jnp.asarray(self.sent)
         shift = jnp.asarray(self.shift, jnp.uint32)
@@ -195,11 +253,13 @@ class LanePacking:
         bad = (~raw)[None, :] & ~is_sent & (over | hit_sent)
         return packed, jnp.sum(bad, axis=1).astype(jnp.int32)
 
-    def unpack_jnp(self, packed):
+    def unpack_jnp(self, packed, base=None):
         """[N, words] int32 -> [N, lanes] int32 (device; exact inverse
-        of :meth:`pack_jnp` on in-domain rows)."""
+        of :meth:`pack_jnp` on in-domain rows — with the SAME ``base``
+        the rows were packed against)."""
         import jax.numpy as jnp
 
+        self._require_base(base)
         if self.identity:
             return packed
         pu = packed.astype(jnp.uint32)
@@ -211,7 +271,7 @@ class LanePacking:
                  ).astype(np.uint32))
             parts.append((pu[:, w:w + 1] >> sh[None, :]) & mk[None, :])
         bits = jnp.concatenate(parts, axis=1)
-        lo = jnp.asarray(self.lo, jnp.int32)
+        lo = self._lo_eff_jnp(base)
         raw = jnp.asarray(self.raw)
         sent = jnp.asarray(self.sent)
         mask = jnp.asarray(
@@ -224,16 +284,24 @@ class LanePacking:
 
     # ------------------------------------------------------ host path
 
-    def pack_np(self, rows: np.ndarray) -> np.ndarray:
+    def _lo_eff_np(self, base) -> np.ndarray:
+        if not self.has_delta:
+            return self.lo
+        return np.where(self.dlt,
+                        np.asarray(base, np.int64).reshape(-1), self.lo)
+
+    def pack_np(self, rows: np.ndarray, base=None) -> np.ndarray:
         """Host-side mirror of :meth:`pack_jnp` (exact same bits)."""
+        self._require_base(base)
         rows = np.asarray(rows, np.int32).reshape(-1, self.lanes)
         if self.identity:
             return rows
+        lo_eff = self._lo_eff_np(base)
         mask = ((np.uint64(1) << self.width.astype(np.uint64)) - 1
                 ).astype(np.uint32)
         is_sent = rows == _SENTINEL
         enc = ((rows.astype(np.uint32)
-                - self.lo.astype(np.uint32)) & mask)
+                - lo_eff.astype(np.uint32)) & mask)
         enc = np.where(self.raw[None, :], rows.astype(np.uint32), enc)
         enc = np.where((self.sent & ~self.raw)[None, :] & is_sent,
                        mask[None, :], enc)
@@ -243,7 +311,8 @@ class LanePacking:
             out[:, w] = shifted[:, s:e].sum(axis=1, dtype=np.uint32)
         return out.astype(np.int32)
 
-    def unpack_np(self, packed: np.ndarray) -> np.ndarray:
+    def unpack_np(self, packed: np.ndarray, base=None) -> np.ndarray:
+        self._require_base(base)
         packed = np.asarray(packed, np.int32).reshape(-1, self.words)
         if self.identity:
             return packed
@@ -257,8 +326,8 @@ class LanePacking:
                             & mk[None, :])
         mask = ((np.uint64(1) << self.width.astype(np.uint64)) - 1
                 ).astype(np.uint32)
-        val = (bits.astype(np.int64) + self.lo.astype(np.int64)
-               ).astype(np.int32)
+        val = (bits.astype(np.int64)
+               + self._lo_eff_np(base).astype(np.int64)).astype(np.int32)
         val = np.where(self.raw[None, :], bits.astype(np.int32), val)
         return np.where((self.sent & ~self.raw)[None, :]
                         & (bits == mask[None, :]), _SENTINEL, val)
@@ -297,10 +366,18 @@ def _flat_domains(protocol) -> Tuple[List[Optional[Tuple[int, int]]],
     return doms, sent
 
 
-def derive_packing(protocol, lanes: int) -> LanePacking:
+def derive_packing(protocol, lanes: int,
+                   delta: bool = False) -> LanePacking:
     """Derive the packing descriptor for one protocol's flat rows.
     ``lanes`` is the engine's flat row width (cross-checked).  No
-    declared domains -> the identity descriptor."""
+    declared domains -> the identity descriptor.
+
+    ``delta`` opts into the delta-from-base lanes (ISSUE 18 leg (b)):
+    a ``("delta", bits)`` domain packs ``v - base`` in ``bits`` bits
+    with a caller-carried base vector.  With ``delta=False`` (the
+    single-device engine) delta domains derive as raw 32-bit lanes —
+    correct, just uncompressed — so a spec annotated for the mesh
+    still runs unchanged on one chip."""
     doms, sent_caps = _flat_domains(protocol)
     if len(doms) != lanes:
         raise ValueError(
@@ -312,10 +389,23 @@ def derive_packing(protocol, lanes: int) -> LanePacking:
     lo = np.zeros(lanes, np.int64)
     sent = np.zeros(lanes, bool)
     raw = np.zeros(lanes, bool)
+    dlt = np.zeros(lanes, bool)
     cur_word, cur_bits = 0, 0
     for i, (dom, s_cap) in enumerate(zip(doms, sent_caps)):
+        is_dlt = False
         if dom is None:
             w, is_raw, lo_i = RAW_WIDTH, True, 0
+        elif isinstance(dom, tuple) and len(dom) and dom[0] == "delta":
+            bits = int(dom[1])
+            if bits < 1:
+                raise ValueError(
+                    f"{protocol.name}: lane {i} delta width {bits} "
+                    "must be >= 1 bit")
+            is_dlt = delta and bits < RAW_WIDTH
+            if is_dlt:
+                w, is_raw, lo_i = bits, False, 0
+            else:
+                w, is_raw, lo_i = RAW_WIDTH, True, 0
         else:
             lo_i, hi_i = int(dom[0]), int(dom[1])
             if hi_i < lo_i:
@@ -335,7 +425,8 @@ def derive_packing(protocol, lanes: int) -> LanePacking:
         lo[i] = lo_i
         sent[i] = s_cap and not is_raw
         raw[i] = is_raw
+        dlt[i] = is_dlt
         cur_bits += w
     return LanePacking(lanes=lanes, words=int(cur_word + 1), word=word,
                        shift=shift, width=width, lo=lo, sent=sent,
-                       raw=raw)
+                       raw=raw, dlt=dlt)

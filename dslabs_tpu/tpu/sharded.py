@@ -105,13 +105,20 @@ _LEVEL_TIMING = bool(os.environ.get("DSLABS_LEVEL_TIMING"))
 
 CARRY_PARTITION_RULES = (
     # Wide SoA buffers: frontier shards, next-frontier accumulator,
-    # per-row trace meta — row-sharded over the search axis.
+    # per-row trace meta — row-sharded over the search axis.  Under
+    # the packed wire format (ISSUE 18) cur/nxt hold PACKED words
+    # (width = descriptor.words), same placement.
     (r"^(cur|nxt|tmeta)$", lambda ax: P(ax)),
     # The owner-sharded visited hash table (one [V+1, 4] shard per
     # device; owner = key lane 0 mod D picks the shard).
     (r"^visited$", lambda ax: P(ax)),
     # Terminal-flag rows/meta/counters: one n_flags block per device.
     (r"^(flag_rows|flag_meta|flag_cnt)$", lambda ax: P(ax)),
+    # Delta-encoding level bases (ISSUE 18 leg (b)): one [n_delta]
+    # int32 vector per device, value-replicated by construction (the
+    # chunk step pmin's them) but stored per-device so the carry stays
+    # uniformly sharded and donation-friendly.
+    (r"^(pb_cur|pb_nxt)$", lambda ax: P(ax)),
     # Per-device scalar lanes: occupancies, loop counters, stats.
     (r"^(cur_n|nxt_n|vis_n|j|evp|noapp|explored|overflow|vis_over"
      r"|drops|f_full)$", lambda ax: P(ax)),
@@ -189,7 +196,9 @@ class ShardedTensorSearch(TensorSearch):
                  aot_warmup: Optional[bool] = None,
                  spill=None,
                  telemetry=None,
-                 symmetry: Optional[bool] = None):
+                 symmetry: Optional[bool] = None,
+                 mesh_pack: Optional[bool] = None,
+                 steal_threshold: Optional[float] = None):
         # Frontier checkpointing (SURVEY §5 "dump SoA tensors"): every
         # ``checkpoint_every`` levels the live carry — the OCCUPIED
         # frontier prefix, the occupied visited-table lines, and the
@@ -243,14 +252,20 @@ class ShardedTensorSearch(TensorSearch):
         # overflow and even strict runs skip the prefilter (it measured
         # ~60% of a loaded chunk step).  Multi-device strict keeps it:
         # per-owner buckets have only 2x-mean headroom.
-        # packed=False: the sharded carry (shards, routing buckets, the
-        # fused row exchange) stays raw int32 this round — packed
-        # checkpoints from the single-device engine still resume here
-        # through the loader's loud encoding conversion (engine.py
-        # _normalize_ckpt_frontier).  Symmetry DOES ride along: the
-        # canonicalize pass lives in the shared _expand_chunk hash
-        # step, so the owner-hash keys on canonical fingerprints and
-        # symmetric twins dedup on one owner.
+        # Packed wire format (ISSUE 18): the sharded carry — frontier
+        # shards, routing buckets, the fused row-exchange payload — is
+        # re-typed to the spec-derived bit-packed encoding, so the
+        # owner-hashed all_to_all ships descriptor.words int32 words per
+        # state instead of ``lanes``.  super() still gets packed=False:
+        # the base engine's OWN packing paths (device wave loop, its
+        # checkpoint writer) are not on the sharded hot path, and the
+        # sharded descriptor is derived separately below WITH the
+        # delta-lane extension (delta=True) so view-number-style fields
+        # pack here even though the single-device engine keeps them
+        # raw.  Symmetry DOES ride along: the canonicalize pass lives
+        # in the shared _expand_chunk hash step, so the owner-hash keys
+        # on canonical fingerprints and symmetric twins dedup on one
+        # owner.
         super().__init__(protocol, frontier_cap=frontier_cap,
                          chunk=chunk_per_device, max_depth=max_depth,
                          max_secs=max_secs,
@@ -261,6 +276,37 @@ class ShardedTensorSearch(TensorSearch):
                          checkpoint_every=checkpoint_every,
                          spill=spill, telemetry=telemetry,
                          packed=False, symmetry=symmetry)
+        # Mesh wire codec: DSLABS_MESH_PACK=0 (or mesh_pack=False) keeps
+        # the legacy raw int32 exchange as the parity oracle.  Identity
+        # descriptors (hand twins without domain metadata) fall back to
+        # the raw wire — loudly, via the run()-time telemetry event.
+        self.mesh_pack = (_env_on("DSLABS_MESH_PACK", True)
+                          if mesh_pack is None else bool(mesh_pack))
+        if self.mesh_pack:
+            from dslabs_tpu.tpu.packing import derive_packing
+            pk = derive_packing(protocol, self.lanes, delta=True)
+            self._pk = None if pk.identity else pk
+        else:
+            self._pk = None
+        self.plane = self._pk.words if self._pk is not None else self.lanes
+        self._mesh_delta = (self._pk is not None and self._pk.has_delta)
+        if self._mesh_delta:
+            self._delta_lanes = np.asarray(self._pk.delta_lanes, np.int32)
+        # Chunk-granular work stealing at level boundaries (ISSUE 18
+        # leg (c)): when the per-owner frontier occupancy skew exceeds
+        # the threshold, overfull owners donate packed rows through one
+        # extra all_to_all; dedup ownership (visited shards) never
+        # moves, only expand work, so counts stay bit-identical.
+        # Threshold <= 0 / unset = off (the default keeps today's
+        # dispatch counts byte-identical).  Only meaningful under the
+        # fused row exchange: the legacy promote already rebalances.
+        if steal_threshold is None:
+            _st = os.environ.get("DSLABS_MESH_STEAL_THRESHOLD", "")
+            steal_threshold = float(_st) if _st.strip() else 0.0
+        self._steal_threshold = float(steal_threshold)
+        self._steal_prog_cache = None
+        self._steal_events = 0
+        self._steal_moved = 0
         # Host-RAM spill tier (tpu/spill.py, docs/capacity.md): the
         # carry gains an ``f_full`` abort-code lane, the chunk step
         # aborts-and-reverts GLOBALLY (a psum'd decision — owner-side
@@ -306,6 +352,10 @@ class ShardedTensorSearch(TensorSearch):
                              else bool(row_exchange))
         if not self.use_superstep:
             self.row_exchange = False
+        # Steal rides the fused row exchange only (the legacy promote
+        # already rebalances evenly, so stealing there is redundant).
+        self._steal_on = (self._steal_threshold > 0.0
+                          and self.n_devices > 1 and self.row_exchange)
         # _flag_names is set by super().__init__ (shared with the
         # single-device device-resident loop).  Hot programs are jitted
         # with the rule-derived carry shardings pinned on BOTH sides
@@ -386,7 +436,25 @@ class ShardedTensorSearch(TensorSearch):
             keys += ["tmeta", "flag_meta"]
         if self._spill_on:
             keys += ["f_full"]
+        if self._mesh_delta:
+            keys += ["pb_cur", "pb_nxt"]
         return keys
+
+    # Delta-lane level bases (ISSUE 18 leg (b)).  pb_cur/pb_nxt are
+    # [n_delta] int32 per device: the per-lane minimum over the live
+    # frontier / accumulating next frontier of every ("delta", bits)
+    # lane.  The chunk step pmin's candidate minima across devices, so
+    # the per-device copies are value-identical by construction and the
+    # promote's re-encode needs no collective.
+    _PB_EMPTY = np.int32(2 ** 31 - 1)
+
+    def _base_vec(self, pb):
+        """[n_delta] per-device base -> [lanes] base vector for the
+        codec (non-delta lanes read their static lo; the scatter value
+        for them is ignored by LanePacking)."""
+        didx = jnp.asarray(self._delta_lanes)
+        return (jnp.zeros((self.lanes,), jnp.int32)
+                .at[didx].set(pb.astype(jnp.int32)))
 
     def _carry_shardings(self) -> dict:
         """Rule-derived NamedSharding per carry leaf — the ONE
@@ -440,6 +508,16 @@ class ShardedTensorSearch(TensorSearch):
         ne = self._num_events()
         ax = self.axis
         lanes = self.lanes
+        # Packed wire format (ISSUE 18): frontier shards and the fused
+        # row-exchange payload hold PACKED words; owners decode
+        # in-register at expand time (unpack below), producers encode
+        # each successor batch ONCE and both the wire and the nxt store
+        # reuse the same packed rows.  plane == lanes when the codec is
+        # identity / disabled — every shape below degenerates to the
+        # legacy raw layout.
+        pk = self._pk
+        plane = self.plane
+        delta = self._mesh_delta
         # On one device every successor routes to the sole owner, so the
         # bucket can hold the whole batch exactly (no overflow headroom
         # needed) — halving the rows the probe loop and flag exchange
@@ -481,7 +559,12 @@ class ShardedTensorSearch(TensorSearch):
             cur, cur_n = carry["cur"], carry["cur_n"][0]
             j = carry["j"][0]
             start = j * C
-            rows_chunk = jax.lax.dynamic_slice(cur, (start, 0), (C, lanes))
+            rows_chunk = jax.lax.dynamic_slice(cur, (start, 0), (C, plane))
+            base_cur = self._base_vec(carry["pb_cur"]) if delta else None
+            if pk is not None:
+                # In-register decode at expand time: the frontier shard
+                # stores packed words, the expansion grid wants lanes.
+                rows_chunk = pk.unpack_jnp(rows_chunk, base_cur)
             valid = (start + jnp.arange(C)) < cur_n
             ev_pass = carry["evp"][0]
             (rows, valids, fp, unique, overflow, ev_rem, event_ids,
@@ -542,6 +625,35 @@ class ShardedTensorSearch(TensorSearch):
             for n in p.prunes:
                 pruned = pruned | flags[f"prune:{n}"]
 
+            # ---- encode the successor batch ONCE: the same packed rows
+            # ride the owner-hashed all_to_all (the ~pack_ratio x ICI
+            # cut) AND the nxt store.  Out-of-domain values (a wrong
+            # Field bound, or a delta value past its window) are counted
+            # on LIVE rows only and folded into the semantic-overflow
+            # counter — _sync_checks raises the loud CapacityOverflow.
+            pack_bad = jnp.int32(0)
+            if pk is not None:
+                rows_store, bad = pk.pack_jnp(rows, base_cur,
+                                              count_bad=True)
+                pack_bad = jnp.sum(
+                    jnp.where(valids, bad, 0)).astype(jnp.int32)
+            else:
+                rows_store = rows
+            if delta:
+                # Candidate next-level base: per-lane min of the live
+                # successors' delta values, pmin'd across the mesh so
+                # every device carries the identical base and the
+                # promote re-encode needs no collective.  The min over
+                # ALL live successors (pruned included) is a lower
+                # bound of the stored subset — a valid (just possibly
+                # looser) base.
+                dvals = rows[:, jnp.asarray(self._delta_lanes)]
+                dvals = jnp.where(valids[:, None], dvals,
+                                  jnp.int32(self._PB_EMPTY))
+                cand = jnp.min(dvals, axis=0).astype(jnp.int32)
+                pb_nxt = jax.lax.pmin(
+                    jnp.minimum(carry["pb_nxt"], cand), ax)
+
             # ---- ownership routing: exchange FINGERPRINTS ONLY, never
             # state rows.  Successor rows stay on the device that produced
             # them; owners deduplicate the 16-byte keys and return a fresh
@@ -578,7 +690,7 @@ class ShardedTensorSearch(TensorSearch):
                 # compaction scatter) both disappear; the level
                 # promote shrinks to a local buffer swap
                 # (_build_finish).
-                parts = [rows, pruned[:, None].astype(jnp.int32)]
+                parts = [rows_store, pruned[:, None].astype(jnp.int32)]
                 if self.record_trace:
                     parts.append(jax.lax.bitcast_convert_type(
                         meta, jnp.int32))
@@ -636,12 +748,12 @@ class ShardedTensorSearch(TensorSearch):
                 # placement — the distribution the per-device skew
                 # lanes judge).  No flag needs to travel back to the
                 # producer, so the reverse all_to_all is gone.
-                app_rows = recv_rows[:, :lanes]
-                app_pruned = recv_rows[:, lanes] != 0
+                app_rows = recv_rows[:, :plane]
+                app_pruned = recv_rows[:, plane] != 0
                 app_fresh = fresh_s            # implies recv_valid
                 if self.record_trace:
                     app_meta = jax.lax.bitcast_convert_type(
-                        recv_rows[:, lanes + 1:], jnp.uint32)
+                        recv_rows[:, plane + 1:], jnp.uint32)
                 if stop_after == "back":
                     out = _stopped(carry, rows, app_fresh, app_pruned)
                     out["visited"] = new_visited
@@ -663,7 +775,7 @@ class ShardedTensorSearch(TensorSearch):
                     out = _stopped(carry, rows, fresh_rows)
                     out["visited"] = new_visited
                     return out
-                app_rows = rows
+                app_rows = rows_store
                 app_pruned = pruned
                 app_fresh = fresh_rows
                 if self.record_trace:
@@ -723,7 +835,8 @@ class ShardedTensorSearch(TensorSearch):
                 # caller opts in (bench throughput runs).  A full
                 # visited table is its own flag (vis_over): sound
                 # treat-as-fresh degradation, fatal only in strict.
-                "overflow": carry["overflow"].at[0].add(overflow),
+                "overflow": carry["overflow"].at[0].add(
+                    overflow + pack_bad),
                 "vis_over": carry["vis_over"].at[0].add(vis_over),
                 # ev_drops (valid events past the ev_budget) truncate
                 # expansion coverage like a routing/frontier drop: fatal
@@ -736,6 +849,9 @@ class ShardedTensorSearch(TensorSearch):
                 # Trace meta rides the SAME append scatter as the rows.
                 out["tmeta"] = carry["tmeta"].at[sdst].set(app_meta)
                 out["flag_meta"] = flag_meta
+            if delta:
+                out["pb_cur"] = carry["pb_cur"]
+                out["pb_nxt"] = pb_nxt
             if spill_on:
                 front_full = (nxt_n + jnp.sum(sel).astype(jnp.int32)
                               ) > F
@@ -744,9 +860,12 @@ class ShardedTensorSearch(TensorSearch):
                 tb = jax.lax.psum(tbl_full.astype(jnp.int32), ax) > 0
                 abort = fa | tb
                 code = fa.astype(jnp.int32) + 2 * tb.astype(jnp.int32)
-                for k in ("j", "evp", "nxt", "nxt_n", "visited",
+                revert = ["j", "evp", "nxt", "nxt_n", "visited",
                           "vis_n", "explored", "overflow", "vis_over",
-                          "drops", "flag_cnt", "flag_rows"):
+                          "drops", "flag_cnt", "flag_rows"]
+                if delta:
+                    revert.append("pb_nxt")
+                for k in revert:
                     out[k] = jnp.where(abort, carry[k], out[k])
                 out["f_full"] = jnp.where(abort, code,
                                           jnp.int32(0))[None]
@@ -922,6 +1041,9 @@ class ShardedTensorSearch(TensorSearch):
         ~1% of the level's chunk work."""
         D = self.n_devices
         F, lanes = self.f_cap, self.lanes
+        plane = self.plane
+        pk = self._pk
+        delta = self._mesh_delta
         ax = self.axis
         share = F // D
 
@@ -939,21 +1061,48 @@ class ShardedTensorSearch(TensorSearch):
             else:
                 per = (nxt_n + D - 1) // D          # rows per share
                 send = jnp.stack([
-                    jax.lax.dynamic_slice(nxt, (s * per, 0), (share, lanes))
-                    for s in range(D)])             # [D, share, lanes]
+                    jax.lax.dynamic_slice(nxt, (s * per, 0), (share, plane))
+                    for s in range(D)])             # [D, share, plane]
                 r = jnp.arange(share)
                 send_valid = jnp.stack([
                     (r < per) & (s * per + r < nxt_n) for s in range(D)])
                 recv = jax.lax.all_to_all(send, ax, 0, 0)
                 recv_valid = jax.lax.all_to_all(send_valid, ax, 0, 0)
-                rows = recv.reshape(D * share, lanes)
+                rows = recv.reshape(D * share, plane)
                 v = recv_valid.reshape(-1)
                 pos = jnp.cumsum(v) - 1
                 dst = jnp.where(v, pos, F)
                 carry["cur"] = jnp.zeros(
-                    (F + 1, lanes), jnp.int32).at[dst].set(rows)[:F]
+                    (F + 1, plane), jnp.int32).at[dst].set(rows)[:F]
                 carry["cur_n"] = jnp.sum(v).astype(jnp.int32)[None]
-            carry["nxt"] = jnp.zeros((F + 1, lanes), jnp.int32)
+            if delta:
+                # Delta re-base (ISSUE 18 leg (b)): the promoted rows
+                # were packed against the OLD level base; re-encode them
+                # against the accumulated next-level base (pb_nxt, a
+                # global pmin computed inside the chunk steps — already
+                # value-identical on every device, so this stays
+                # elementwise: the fused promote keeps ZERO collectives).
+                pb_old = carry["pb_cur"]
+                # A lane whose pb_nxt never saw a successor (empty next
+                # frontier) keeps the old base so the (vacuous)
+                # re-encode stays in-window.
+                pb_new = jnp.where(
+                    carry["pb_nxt"] == jnp.int32(self._PB_EMPTY),
+                    pb_old, carry["pb_nxt"])
+                raw_rows = pk.unpack_jnp(carry["cur"],
+                                         self._base_vec(pb_old))
+                repacked, bad = pk.pack_jnp(raw_rows,
+                                            self._base_vec(pb_new),
+                                            count_bad=True)
+                occ = jnp.arange(F) < carry["cur_n"][0]
+                carry["cur"] = jnp.where(occ[:, None], repacked,
+                                         jnp.int32(0))
+                carry["overflow"] = carry["overflow"].at[0].add(
+                    jnp.sum(jnp.where(occ, bad, 0)).astype(jnp.int32))
+                carry["pb_cur"] = pb_new
+                carry["pb_nxt"] = jnp.full_like(
+                    pb_old, jnp.int32(self._PB_EMPTY))
+            carry["nxt"] = jnp.zeros((F + 1, plane), jnp.int32)
             carry["nxt_n"] = jnp.zeros((1,), jnp.int32)
             carry["j"] = jnp.zeros((1,), jnp.int32)
             carry["evp"] = jnp.zeros((1,), jnp.int32)
@@ -974,6 +1123,174 @@ class ShardedTensorSearch(TensorSearch):
         drift apart."""
         return match_partition_rules(CARRY_PARTITION_RULES,
                                      self._carry_names(), self.axis)
+
+    # ------------------------------------------- boundary work stealing
+
+    def _build_steal(self):
+        """Chunk-granular work-stealing rebalance (ISSUE 18 leg (c)):
+        ONE extra all_to_all at a level boundary moves packed frontier
+        rows from overfull owners to underfull ones per a replicated
+        host-built [D, D] donation plan (plan[s, r] = rows device s
+        donates to device r, each entry <= one chunk).  Only EXPAND
+        work migrates — visited shards, and therefore dedup ownership
+        and every count, are untouched; the donated rows were already
+        deduplicated when they landed on their owner, so moving them
+        is a pure relabeling of who expands what.  Donors give away
+        their frontier TAIL (the suffix above the kept prefix), so the
+        surviving prefix needs no compaction."""
+        D = self.n_devices
+        F = self.f_cap
+        K = self.cpd
+        plane = self.plane
+        ax = self.axis
+
+        def local(carry, plan):
+            carry = dict(carry)
+            cur, cur_n = carry["cur"], carry["cur_n"][0]
+            s = jax.lax.axis_index(ax)
+            give = plan[s]                          # [D] rows to donate
+            cum = jnp.cumsum(give)
+            tot = cum[-1]
+            # Donation r occupies [cur_n - cum[r], cur_n - cum[r] +
+            # give[r]) of the local frontier — disjoint tail slices.
+            starts = jnp.maximum(cur_n - cum, 0)
+            offs = jnp.arange(K)
+            # Exact gather (not dynamic_slice: its out-of-bounds start
+            # clamping would silently shift a tail window that sits
+            # within K of the cap).
+            send = jnp.stack([
+                jnp.take(cur, (starts[r] + offs).clip(0, F - 1),
+                         axis=0)
+                for r in range(D)])                 # [D, K, plane]
+            sv = offs[None, :] < give[:, None]
+            recv = jax.lax.all_to_all(send, ax, 0, 0).reshape(
+                D * K, plane)
+            rv = jax.lax.all_to_all(sv, ax, 0, 0).reshape(-1)
+            keep_n = cur_n - tot
+            pos = jnp.cumsum(rv) - 1
+            dst = jnp.where(rv, keep_n + pos, F)
+            got = jnp.sum(rv).astype(jnp.int32)
+            # A receiver past frontier_cap drops the excess — counted
+            # loudly (strict runs raise at the next sync); the host
+            # plan never builds one (targets <= total // D <= F).
+            lost = jnp.sum(rv & (dst >= F)).astype(jnp.int32)
+            carry["cur"] = cur.at[dst].set(recv, mode="drop")
+            carry["cur_n"] = (keep_n + got - lost)[None]
+            carry["drops"] = carry["drops"].at[0].add(lost)
+            return carry
+
+        spec = self._carry_specs()
+        return self._sharded_jit(
+            shard_map(local, mesh=self.mesh, in_specs=(spec, P()),
+                      out_specs=spec, check_rep=False),
+            extra_in=(self._replicated(),))
+
+    def _steal_prog(self):
+        if self._steal_prog_cache is None:
+            self._steal_prog_cache = self._build_steal()
+        return self._steal_prog_cache
+
+    def _steal_plan(self, occ, depth):
+        """Host-side donation planner over the per-device frontier
+        occupancy lanes (read from the SAME fused stats vector as the
+        level sync — zero extra readbacks).  Returns a [D, D] int32
+        plan or None.  Two regimes:
+
+        * ``depth == 1`` — root-fanout seeding: the level-1 frontier is
+          the lone root's successor set; split it evenly across owners
+          unconditionally (no threshold, no chunk rounding) so the
+          early tree never serializes on one owner.
+        * deeper levels — gated on ``imbalance_max >``
+          DSLABS_MESH_STEAL_THRESHOLD, and donations move in WHOLE
+          chunks (the superstep's work quantum: a partial chunk costs a
+          full chunk step, so finer migration cannot help)."""
+        D, K = self.n_devices, self.cpd
+        occ = [int(x) for x in occ]
+        total = sum(occ)
+        if D == 1 or total < 2:
+            return None
+        mean = total / D
+        imb = max(occ) / mean
+        fanout = depth == 1
+        if not fanout and imb <= self._steal_threshold:
+            return None
+        target = total // D
+        if fanout:
+            # A successor set smaller than the mesh still fans out: one
+            # row per owner beats D-1 idle owners at level 2.
+            target = max(1, target)
+        donors = [[d, occ[d] - target] for d in range(D)
+                  if occ[d] > target]
+        recvs = [[d, target - occ[d]] for d in range(D)
+                 if occ[d] < target]
+        donors.sort(key=lambda x: -x[1])
+        recvs.sort(key=lambda x: -x[1])
+        plan = np.zeros((D, D), np.int32)
+        for d, ex in donors:
+            for r_ent in recvs:
+                if ex <= 0:
+                    break
+                r, need = r_ent
+                if need <= 0:
+                    continue
+                amt = min(ex, need, K)
+                if not fanout:
+                    amt = (amt // K) * K     # whole chunks only
+                if amt <= 0:
+                    continue
+                plan[d, r] = amt
+                ex -= amt
+                r_ent[1] -= amt
+        if not plan.any():
+            return None
+        return plan
+
+    def _maybe_steal(self, carry, depth):
+        """Boundary steal hook — runs right after the level promote,
+        using the per-device nxt_n lanes (== the promoted frontier
+        occupancy under the fused row exchange) from the level's stats
+        readback.  Updates the level record and emits a telemetry
+        event; counts stay bit-identical by construction (the visited
+        shards never move)."""
+        if not self._steal_on:
+            return carry
+        pdev = getattr(self, "_last_per_device", None)
+        if not pdev:
+            return carry
+        occ = pdev.get("frontier")
+        if occ is None:
+            return carry
+        plan = self._steal_plan(occ, depth)
+        if plan is None:
+            return carry
+        prog = self._prog("steal", self._steal_prog())
+        pl = jax.device_put(jnp.asarray(plan), self._replicated())
+        carry = self._dispatch("sharded.steal", prog, carry, pl)
+        moved = int(plan.sum())
+        occ_after = [int(o) - int(plan[d].sum()) + int(plan[:, d].sum())
+                     for d, o in enumerate(occ)]
+        self._steal_events += 1
+        self._steal_moved += moved
+        from dslabs_tpu.tpu.telemetry import skew_metrics
+        before = skew_metrics(occ)
+        after = skew_metrics(occ_after)
+        recs = getattr(self, "_level_records", None)
+        if recs:
+            recs[-1]["steal"] = {
+                "moved": moved,
+                "imbalance_before": before["imbalance"],
+                "imbalance_after": after["imbalance"],
+            }
+            sk = recs[-1].setdefault("skew", {})
+            sk["frontier_post_steal"] = after
+        pdev["frontier"] = occ_after
+        tel = getattr(self, "_telemetry", None)
+        if tel is not None:
+            tel.event("steal", engine="sharded", depth=depth,
+                      moved=moved,
+                      imbalance_before=round(before["imbalance"], 3),
+                      imbalance_after=round(after["imbalance"], 3))
+        return carry
 
     # ----------------------------------------------------------------- run
 
@@ -1016,18 +1333,29 @@ class ShardedTensorSearch(TensorSearch):
         if fn is not None:
             return fn
         D, F, V, lanes = self.n_devices, self.f_cap, self.v_cap, self.lanes
+        plane, pk, delta = self.plane, self._pk, self._mesh_delta
         nf = len(self._flag_names)
 
         def build(row0, k0):
             onehot_d = jnp.arange(D) == owner
+            if delta:
+                # Level-0 base = the root row's own delta values (the
+                # min over a one-row frontier).
+                pb0 = row0[jnp.asarray(self._delta_lanes)].astype(
+                    jnp.int32)
+                row0s = pk.pack_jnp(row0[None], self._base_vec(pb0))[0]
+            elif pk is not None:
+                row0s = pk.pack_jnp(row0[None])[0]
+            else:
+                row0s = row0
             out = {
-                "cur": jnp.zeros((D * F, lanes), jnp.int32).at[
-                    owner * F].set(row0),
+                "cur": jnp.zeros((D * F, plane), jnp.int32).at[
+                    owner * F].set(row0s),
                 "cur_n": onehot_d.astype(jnp.int32),
                 "j": jnp.zeros((D,), jnp.int32),
                 "evp": jnp.zeros((D,), jnp.int32),
                 "noapp": jnp.zeros((D,), jnp.int32),
-                "nxt": jnp.zeros((D * (F + 1), lanes), jnp.int32),
+                "nxt": jnp.zeros((D * (F + 1), plane), jnp.int32),
                 "nxt_n": jnp.zeros((D,), jnp.int32),
                 "visited": jnp.full((D * (V + 1), 4), MAXU32,
                                     jnp.uint32).at[
@@ -1045,6 +1373,10 @@ class ShardedTensorSearch(TensorSearch):
                 out["flag_meta"] = jnp.zeros((D * nf, 9), jnp.uint32)
             if self._spill_on:
                 out["f_full"] = jnp.zeros((D,), jnp.int32)
+            if delta:
+                out["pb_cur"] = jnp.tile(pb0, D)
+                out["pb_nxt"] = jnp.full(
+                    (D * pb0.shape[0],), jnp.int32(self._PB_EMPTY))
             return out
 
         fn = jax.jit(build, out_shardings=self._carry_shardings())
@@ -1066,11 +1398,11 @@ class ShardedTensorSearch(TensorSearch):
                                         sharding=shards[name])
 
         out = {
-            "cur": sd("cur", (D * F, lanes)),
+            "cur": sd("cur", (D * F, self.plane)),
             "cur_n": sd("cur_n", (D,)),
             "j": sd("j", (D,)), "evp": sd("evp", (D,)),
             "noapp": sd("noapp", (D,)),
-            "nxt": sd("nxt", (D * (F + 1), lanes)),
+            "nxt": sd("nxt", (D * (F + 1), self.plane)),
             "nxt_n": sd("nxt_n", (D,)),
             "visited": sd("visited", (D * (V + 1), 4), jnp.uint32),
             "vis_n": sd("vis_n", (D,)),
@@ -1086,6 +1418,10 @@ class ShardedTensorSearch(TensorSearch):
             out["flag_meta"] = sd("flag_meta", (D * nf, 9), jnp.uint32)
         if self._spill_on:
             out["f_full"] = sd("f_full", (D,))
+        if self._mesh_delta:
+            nd = len(self._delta_lanes)
+            out["pb_cur"] = sd("pb_cur", (D * nd,))
+            out["pb_nxt"] = sd("pb_nxt", (D * nd,))
         return out
 
     def aot_warmup(self) -> float:
@@ -1222,6 +1558,42 @@ class ShardedTensorSearch(TensorSearch):
             sites["sharded.spill_evict"] = dict(
                 fn=progs["evict"], args=(sds,), donate=(0,),
                 multi=True, builder=None)
+        # Packed-wire codec lowerings (ISSUE 18): the sharded engine's
+        # own pack/decode over one chunk batch, so J1-J5 cover the
+        # codec the superstep inlines (delta descriptors take the base
+        # vector argument).
+        if self._pk is not None:
+            pk = self._pk
+            rows_sds = jax.ShapeDtypeStruct((self.cpd, self.lanes),
+                                            jnp.int32)
+            packed_sds = jax.ShapeDtypeStruct((self.cpd, self.plane),
+                                              jnp.int32)
+            if pk.has_delta:
+                base_sds = jax.ShapeDtypeStruct((self.lanes,),
+                                                jnp.int32)
+                mk_p = lambda: jax.jit(lambda r, b: pk.pack_jnp(r, b))
+                mk_u = lambda: jax.jit(lambda r, b: pk.unpack_jnp(r, b))
+                sites["packing.pack"] = dict(
+                    fn=mk_p(), args=(rows_sds, base_sds), donate=(),
+                    multi=False, builder=mk_p)
+                sites["packing.unpack"] = dict(
+                    fn=mk_u(), args=(packed_sds, base_sds), donate=(),
+                    multi=False, builder=mk_u)
+            else:
+                sites["packing.pack"] = dict(
+                    fn=jax.jit(pk.pack_jnp), args=(rows_sds,),
+                    donate=(), multi=False,
+                    builder=lambda: jax.jit(pk.pack_jnp))
+                sites["packing.unpack"] = dict(
+                    fn=jax.jit(pk.unpack_jnp), args=(packed_sds,),
+                    donate=(), multi=False,
+                    builder=lambda: jax.jit(pk.unpack_jnp))
+        if self._steal_on:
+            plan_sds = jax.ShapeDtypeStruct(
+                (self.n_devices, self.n_devices), jnp.int32)
+            sites["sharded.steal"] = dict(
+                fn=self._steal_prog(), args=(sds, plan_sds),
+                donate=(0,), multi=True, builder=self._build_steal)
         return sites
 
     def _terminal_from_flags(self, carry, explored, vis_total, depth, t0):
@@ -1294,7 +1666,7 @@ class ShardedTensorSearch(TensorSearch):
         while m < need:
             m <<= 1
         m = max(min(m, self.f_cap), 1)
-        lanes = self.lanes
+        plane = self.plane
         cache = getattr(self, "_snap_fns", None)
         if cache is None:
             cache = self._snap_fns = {}
@@ -1303,9 +1675,9 @@ class ShardedTensorSearch(TensorSearch):
                 return cache[m](carry)
 
         def local(c):
-            return {
+            out = {
                 "cur": jax.lax.dynamic_slice(
-                    c["cur"], (0, 0), (m, lanes)),
+                    c["cur"], (0, 0), (m, plane)),
                 "cur_n": c["cur_n"] + 0,
                 "visited": c["visited"] + jnp.uint32(0),
                 "vis_n": c["vis_n"] + 0,
@@ -1316,10 +1688,15 @@ class ShardedTensorSearch(TensorSearch):
                 "flag_cnt": c["flag_cnt"] + 0,
                 "flag_rows": c["flag_rows"] + 0,
             }
+            if self._mesh_delta:
+                out["pb_cur"] = c["pb_cur"] + 0
+            return out
 
         spec = self._carry_specs()
         keys = ["cur", "cur_n", "visited", "vis_n", "explored",
                 "overflow", "vis_over", "drops", "flag_cnt", "flag_rows"]
+        if self._mesh_delta:
+            keys.append("pb_cur")
         snap_spec = {k: spec[k] for k in keys}
         fn = jax.jit(shard_map(local, mesh=self.mesh, in_specs=(spec,),
                                out_specs=snap_spec, check_rep=False))
@@ -1337,11 +1714,11 @@ class ShardedTensorSearch(TensorSearch):
         from dslabs_tpu.tpu import checkpoint as ckpt_mod
 
         D = self.n_devices
-        cur = np.asarray(snap["cur"]).reshape(D, -1, self.lanes)
+        cur = np.asarray(snap["cur"]).reshape(D, -1, self.plane)
         cur_n = np.asarray(snap["cur_n"]).reshape(-1)
         parts = [cur[d, :cur_n[d]] for d in range(D)]
         frontier = (np.concatenate(parts) if cur_n.sum()
-                    else np.zeros((0, self.lanes), np.int32))
+                    else np.zeros((0, self.plane), np.int32))
         vis = np.asarray(snap["visited"]).reshape(
             D, self.v_cap + 1, 4)[:, :-1]
         occ = ~(vis == MAXU32).all(axis=2)
@@ -1350,13 +1727,28 @@ class ShardedTensorSearch(TensorSearch):
             fp_map = np.asarray(
                 [(k + v[0] + (v[1],)) for k, v in self._fp_map.items()],
                 dtype=np.int64)
+        # Frontier rows ride in the mesh engine's NATIVE encoding
+        # (packed when the descriptor is non-identity) with the marker
+        # — and, for delta descriptors, the level base — so any ladder
+        # rung converts on resume (engine.py _normalize_ckpt_frontier;
+        # loud, never silent).
+        extra = None
+        if self._pk is not None:
+            extra = {"frontier_encoding": np.bytes_(
+                self._pk.signature().encode())}
+            if self._mesh_delta:
+                pb = np.asarray(snap["pb_cur"]).reshape(
+                    D, -1)[0].astype(np.int32)
+                base = np.zeros((self.lanes,), np.int32)
+                base[self._delta_lanes] = pb
+                extra["pack_base"] = base
         ckpt_mod.save(self.checkpoint_path, ckpt_mod.SearchCheckpoint(
             fingerprint=self._ckpt_fingerprint(), depth=depth,
             explored=int(np.asarray(snap["explored"]).sum()),
             elapsed=elapsed, frontier=frontier, visited_keys=vis[occ],
             vis_over=int(np.asarray(snap["vis_over"]).sum()),
             dropped=int(np.asarray(snap["drops"]).sum()),
-            fp_map=fp_map))
+            fp_map=fp_map, extra=extra))
 
     def _save_checkpoint(self, carry, depth: int, elapsed: float,
                          max_n: int = None) -> None:
@@ -1399,27 +1791,63 @@ class ShardedTensorSearch(TensorSearch):
 
             sp = self._spill
             sp.restore(ck.visited_keys, ck.extra)
+            # ck.frontier was normalized to RAW lanes by the loader;
+            # the spool's steady-state encoding is packed for
+            # non-delta descriptors — re-encode the deferred segments
+            # to match (_sh_spill_drain's contract), keep raw for
+            # delta (re-based per inject) and identity codecs.
             rows = np.asarray(ck.frontier, np.int32)
+            spool_rows = rows
+            if self._pk is not None and not self._mesh_delta:
+                spool_rows = self._pk.pack_np(rows)
             segcap = self.n_devices * self.f_cap
             for i in range(segcap, len(rows), segcap):
-                sp.spool_cur.push(rows[i:i + segcap])
+                sp.spool_cur.push(spool_rows[i:i + segcap])
             ck = _dc.replace(ck, frontier=rows[:segcap],
                              visited_keys=np.zeros((0, 4), np.uint32))
         return self._resume_carry(ck), ck.depth, ck.elapsed
 
     def _resume_carry(self, ck):
         D, F, V, lanes = self.n_devices, self.f_cap, self.v_cap, self.lanes
+        plane = self.plane
         nf = len(self._flag_names)
         n = len(ck.frontier)
         if -(-n // D) > F:
             raise CapacityOverflow(
                 f"{self.p.name}: frontier_cap {F}/device too small to "
                 f"resume {n} checkpointed frontier rows on {D} devices")
+        # The loader normalized the dump's frontier to RAW lanes
+        # (engine.py _normalize_ckpt_frontier) — re-encode to this
+        # engine's native packed storage here, with a fresh level base
+        # (the per-lane min over the resumed rows) when the descriptor
+        # has delta lanes.
+        frontier = np.asarray(ck.frontier, np.int32).reshape(-1, lanes)
+        pb0 = None
+        if self._mesh_delta:
+            didx = self._delta_lanes
+            pb0 = (frontier[:, didx].min(axis=0).astype(np.int32)
+                   if n else np.zeros((len(didx),), np.int32))
+            base = np.zeros((lanes,), np.int32)
+            base[didx] = pb0
+            spans = frontier[:, didx].astype(np.int64) - pb0
+            # Max in-window span: the lane mask, minus the reserved
+            # all-ones sentinel code where one exists.
+            win = ((1 << self._pk.width[didx].astype(np.int64)) - 1
+                   - self._pk.sent[didx].astype(np.int64))
+            if n and (spans > win[None, :]).any():
+                raise CapacityOverflow(
+                    f"{self.p.name}: resumed frontier spans a delta "
+                    "window wider than the declared Field(delta=) "
+                    "bits — raise the delta bits on the offending "
+                    "field")
+            frontier = self._pk.pack_np(frontier, base)
+        elif self._pk is not None:
+            frontier = self._pk.pack_np(frontier)
         per = max(1, -(-n // D))
-        cur = np.zeros((D, per, lanes), np.int32)
+        cur = np.zeros((D, per, plane), np.int32)
         cur_n = np.zeros((D,), np.int32)
         for d in range(D):
-            rows = ck.frontier[d * per:(d + 1) * per]
+            rows = frontier[d * per:(d + 1) * per]
             cur[d, :len(rows)] = rows
             cur_n[d] = len(rows)
         keys = ck.visited_keys
@@ -1440,7 +1868,7 @@ class ShardedTensorSearch(TensorSearch):
 
         shard = NamedSharding(self.mesh, P(self.axis))
         dev_in = {k: jax.device_put(v, shard) for k, v in {
-            "cur0": cur.reshape(D * per, lanes),
+            "cur0": cur.reshape(D * per, plane),
             "cur_n": cur_n,
             "keys": kbuf.reshape(D * kmax, 4),
             "kval": kval.reshape(D * kmax),
@@ -1453,13 +1881,13 @@ class ShardedTensorSearch(TensorSearch):
             table, ins, unres = visited_mod.insert(
                 visited_mod.empty_table(V), s["keys"], s["kval"])
             out = {
-                "cur": jnp.zeros((F, lanes), jnp.int32).at[:per].set(
+                "cur": jnp.zeros((F, plane), jnp.int32).at[:per].set(
                     s["cur0"]),
                 "cur_n": s["cur_n"],
                 "j": jnp.zeros((1,), jnp.int32),
                 "evp": jnp.zeros((1,), jnp.int32),
                 "noapp": jnp.zeros((1,), jnp.int32),
-                "nxt": jnp.zeros((F + 1, lanes), jnp.int32),
+                "nxt": jnp.zeros((F + 1, plane), jnp.int32),
                 "nxt_n": jnp.zeros((1,), jnp.int32),
                 "visited": table,
                 "vis_n": jnp.sum(ins).astype(jnp.int32)[None],
@@ -1475,6 +1903,10 @@ class ShardedTensorSearch(TensorSearch):
                 out["flag_meta"] = jnp.zeros((nf, 9), jnp.uint32)
             if self._spill_on:
                 out["f_full"] = jnp.zeros((1,), jnp.int32)
+            if self._mesh_delta:
+                out["pb_cur"] = jnp.asarray(pb0, jnp.int32)
+                out["pb_nxt"] = jnp.full((len(pb0),), jnp.int32(
+                    self._PB_EMPTY))
             return out, jnp.sum(unres).astype(jnp.int32)[None]
 
         ax = self.axis
@@ -1511,7 +1943,7 @@ class ShardedTensorSearch(TensorSearch):
 
         def reset(c):
             out = dict(c)
-            out["nxt"] = jnp.zeros((F + 1, lanes), jnp.int32)
+            out["nxt"] = jnp.zeros((F + 1, self.plane), jnp.int32)
             out["nxt_n"] = jnp.zeros((1,), jnp.int32)
             out["f_full"] = jnp.zeros((1,), jnp.int32)
             return out
@@ -1537,21 +1969,38 @@ class ShardedTensorSearch(TensorSearch):
     def _sh_spill_drain(self, carry):
         """Gather every device's occupied nxt prefix (ONE batched
         readback), refilter against the host tier, drop exception/
-        pruned rows, spool the keepers, and reset nxt on device."""
+        pruned rows, spool the keepers, and reset nxt on device.
+
+        Spool encoding (ISSUE 18): PACKED rows when the descriptor has
+        no delta lanes (the host tier holds pack_ratio x more states at
+        fixed RAM — keys/refilter masks come from a host-side unpack);
+        RAW rows under a delta descriptor (the level base changes at
+        each re-inject, so a fixed-encoding spool would go stale)."""
         sp = self._spill
-        D, F, lanes = self.n_devices, self.f_cap, self.lanes
+        D, F = self.n_devices, self.f_cap
+        pk, plane = self._pk, self.plane
+        spool_packed = pk is not None and not self._mesh_delta
 
         def fetch():
-            nxt = np.asarray(carry["nxt"]).reshape(D, F + 1, lanes)
+            nxt = np.asarray(carry["nxt"]).reshape(D, F + 1, plane)
             counts = np.asarray(carry["nxt_n"]).reshape(-1)
             if counts.sum():
                 rows = np.concatenate(
                     [nxt[d, :counts[d]] for d in range(D)])
             else:
-                rows = np.zeros((0, lanes), np.int32)
-            return rows, self._spill_keys_of(rows, F)
+                rows = np.zeros((0, plane), np.int32)
+            if pk is None:
+                raw = rows
+            elif self._mesh_delta:
+                pb = np.asarray(carry["pb_cur"]).reshape(D, -1)[0]
+                base = np.zeros((self.lanes,), np.int32)
+                base[self._delta_lanes] = pb
+                rows = raw = pk.unpack_np(rows, base)
+            else:
+                raw = pk.unpack_np(rows)
+            return rows, raw, self._spill_keys_of(raw, F)
 
-        rows, keys = self._dispatch("sharded.spill_drain", fetch)
+        rows, raw, keys = self._dispatch("sharded.spill_drain", fetch)
         if len(rows):
             # Async drain (ISSUE 15c): the host half rides the ordered
             # worker while the mesh re-dispatches — see engine.py
@@ -1559,7 +2008,8 @@ class ShardedTensorSearch(TensorSearch):
             def host_half():
                 kept = sp.refilter(rows, keys)
                 if len(kept):
-                    kept = kept[self._spill_keep_mask(kept, F)]
+                    ku = pk.unpack_np(kept) if spool_packed else kept
+                    kept = kept[self._spill_keep_mask(ku, F)]
                 sp.spool(kept)
 
             sp.submit_drain(host_half)
@@ -1589,12 +2039,38 @@ class ShardedTensorSearch(TensorSearch):
         the jitted set programs stay O(log f_cap).  Returns
         ``(carry, per_device_max)`` — the chunk-grid bound."""
         D, F, lanes = self.n_devices, self.f_cap, self.lanes
+        plane = self.plane
         n = len(rows)
         per = max(1, -(-n // D))
         if per > F:
             raise CapacityOverflow(
                 f"{self.p.name}: spool segment of {n} rows exceeds "
                 f"frontier_cap {F}/device on {D} devices")
+        if self._mesh_delta and n:
+            # Delta spools hold RAW rows (_sh_spill_drain): re-encode
+            # the segment against the CURRENT level base — pb_cur only
+            # moves at promote, and the level's nxt rows all pack
+            # against one base, so this stays consistent with what the
+            # chunk step decodes.  A value outside the window from the
+            # current base is the declared-bits contract being
+            # exceeded: loud, with the fix named.
+            rows = np.asarray(rows, np.int32).reshape(-1, lanes)
+            pb = np.asarray(carry["pb_cur"]).reshape(D, -1)[0]
+            base = np.zeros((lanes,), np.int32)
+            base[self._delta_lanes] = pb
+            spans = (rows[:, self._delta_lanes].astype(np.int64)
+                     - pb.astype(np.int64))
+            win = ((1 << self._pk.width[self._delta_lanes].astype(
+                np.int64)) - 1
+                - self._pk.sent[self._delta_lanes].astype(np.int64))
+            if (spans < 0).any() or (spans > win[None, :]).any():
+                raise CapacityOverflow(
+                    f"{self.p.name}: spill re-inject found delta-lane "
+                    "values outside the window from the current level "
+                    "base — raise the Field(delta=) bits (spill defers "
+                    "re-basing, so deep spilled runs need wider "
+                    "windows)")
+            rows = self._pk.pack_np(rows, base)
         m = self.cpd
         while m < per:
             m <<= 1
@@ -1607,7 +2083,7 @@ class ShardedTensorSearch(TensorSearch):
 
             def inject(c, seg, nn):
                 out = dict(c)
-                out["cur"] = jnp.zeros((F, lanes),
+                out["cur"] = jnp.zeros((F, plane),
                                        jnp.int32).at[:m].set(seg)
                 out["cur_n"] = nn
                 out["j"] = jnp.zeros((1,), jnp.int32)
@@ -1620,14 +2096,14 @@ class ShardedTensorSearch(TensorSearch):
                 inject, mesh=self.mesh,
                 in_specs=(spec, P(ax), P(ax)), out_specs=spec,
                 check_rep=False), extra_in=(seg_shard, seg_shard))
-        buf = np.zeros((D, m, lanes), np.int32)
+        buf = np.zeros((D, m, plane), np.int32)
         counts = np.zeros((D,), np.int32)
         for d in range(D):
             part = rows[d * per:(d + 1) * per]
             buf[d, :len(part)] = part
             counts[d] = len(part)
         shard = NamedSharding(self.mesh, P(self.axis))
-        seg = jax.device_put(buf.reshape(D * m, lanes), shard)
+        seg = jax.device_put(buf.reshape(D * m, plane), shard)
         nn = jax.device_put(counts, shard)
         carry = self._dispatch("sharded.spill_reinject", fn, carry,
                                seg, nn)
@@ -1646,12 +2122,21 @@ class ShardedTensorSearch(TensorSearch):
         vis = np.asarray(carry["visited"]).reshape(D, V + 1, 4)
         occ = np.concatenate(
             [visited_mod.host_occupied(vis[d]) for d in range(D)])
+        # The spool holds packed rows for non-delta descriptors
+        # (_sh_spill_drain) — the dump then carries the encoding
+        # marker; delta spools are raw, so their dump is raw too.
+        spool_packed = self._pk is not None and not self._mesh_delta
+        extra = sp.checkpoint_extra() or {}
+        if spool_packed:
+            extra["frontier_encoding"] = np.bytes_(
+                self._pk.signature().encode())
         ckpt_mod.save(self.checkpoint_path, ckpt_mod.SearchCheckpoint(
             fingerprint=self._ckpt_fingerprint(), depth=depth,
             explored=explored, elapsed=elapsed,
-            frontier=sp.spool_cur.concat(self.lanes),
+            frontier=sp.spool_cur.concat(
+                self.plane if spool_packed else self.lanes),
             visited_keys=sp.checkpoint_keys(occ),
-            extra=sp.checkpoint_extra()))
+            extra=extra or None))
 
     def run(self, check_initial: bool = True,
             initial: Optional[dict] = None,
@@ -1699,6 +2184,19 @@ class ShardedTensorSearch(TensorSearch):
                 if out.trace_id is None:
                     out.trace_id = tel.trace_id
                 tel.on_outcome(out, engine="sharded")
+                if self.n_devices > 1 and self._pk is None:
+                    # Identity-codec fallback on a real mesh (ISSUE 18
+                    # satellite): the exchange shipped RAW lanes — hand
+                    # twins without domain declarations, or the
+                    # DSLABS_MESH_PACK=0 parity oracle.  Loud until
+                    # ROADMAP #1 deletes the hand twins.
+                    tel.event(
+                        "mesh_unpacked", engine="sharded",
+                        protocol=self.p.name,
+                        mesh_width=self.n_devices,
+                        reason=("knob" if not self.mesh_pack
+                                else "identity descriptor"),
+                        wire_lanes=self.lanes)
             if out.dropped and out.dropped >= _DROPPED_WARN():
                 # The BENCH_r03 shape (5.8M beam drops, one flag to
                 # show for it) must be LOUD — dropped_states is also a
@@ -1813,7 +2311,12 @@ class ShardedTensorSearch(TensorSearch):
                     # satellite): pressure is visible in bench JSON
                     # before the overflow contract can fire.
                     "load_factor": round(
-                        getattr(self, "_last_load", 0.0), 4)}
+                        getattr(self, "_last_load", 0.0), 4),
+                    # Wire/storage codec this level ran under (ISSUE
+                    # 18): 1.0 = raw exchange — the identity-fallback
+                    # gap the run()-level telemetry event makes loud.
+                    "pack_ratio": (round(self._pk.pack_ratio, 3)
+                                   if self._pk is not None else 1.0)}
                 # Mesh-scope lanes (ISSUE 8): the pre-psum per-device
                 # scalars the fused stats vector already carried, plus
                 # skew metrics — what the owner-hashed all_to_all
@@ -1932,6 +2435,11 @@ class ShardedTensorSearch(TensorSearch):
                 carry = self._dispatch(
                     "sharded.promote",
                     self._prog("promote", self._finish_level), carry)
+                # Boundary work stealing (ISSUE 18 leg (c)): root-fanout
+                # at depth 1 (split the lone root's successor set), the
+                # threshold-gated chunk-granular rebalance at deeper
+                # boundaries.  max_n stays the (safe, pre-steal) bound.
+                carry = self._maybe_steal(carry, depth)
                 if (self.checkpoint_every and self.checkpoint_path
                         and depth % self.checkpoint_every == 0):
                     self._save_checkpoint(carry, depth, time.time() - t0,

@@ -164,28 +164,33 @@ def pb_spec(ns: int = 2, n_clients: int = 1, w: int = 1) -> ProtocolSpec:
     DEAD = 2
     amo_fields = tuple(f"a{c}" for c in range(NC))
     # Declared domains (ISSUE 15): server/client ids, sync/acked bits,
-    # amo seqs, and rank are all tiny; view numbers and liveness ticks
-    # genuinely grow with depth and stay full int32 lanes (the packed
-    # encoding is per-lane — partial declarations still pay off).
+    # amo seqs, and rank are all tiny.  View numbers (vn/svn/cvn)
+    # genuinely grow with depth and defeat a static hi= — they carry
+    # the delta-from-level-base annotation instead (ISSUE 18 leg (b)):
+    # the mesh engine packs them as 8-bit offsets from the per-level
+    # minimum, the single-device engine keeps them as full int32
+    # lanes.  Liveness ticks stay raw: a dead server's ticks diverge
+    # from the level base without bound, so a delta window would
+    # overflow (loudly) on exactly the executions lab2 must explore.
     sid, cid, seq = (0, NS), (0, max(NC - 1, 0)), (0, w)
     amo_b = {f: seq for f in amo_fields}
     spec = ProtocolSpec(
         "pb-gen",
         nodes=[NodeKind("vs", 1, (
-                   Field("vn"), Field("prim", hi=NS),
+                   Field("vn", delta=8), Field("prim", hi=NS),
                    Field("back", hi=NS),
                    Field("acked", hi=1), Field("nextrank", hi=NS),
                    Field("rank", size=NS, hi=NS),
                    Field("ticks", size=NS))),
                NodeKind("server", NS, (
-                   Field("svn", init=-1), Field("sp", hi=NS),
+                   Field("svn", init=-1, delta=8), Field("sp", hi=NS),
                    Field("sb", hi=NS),
                    Field("sync", init=1, hi=1), Field("pc", hi=NC),
                    Field("ps", hi=w),
                    Field("amo", size=NC, hi=w))),
                NodeKind("client", NC, (
                    Field("k", init=1, hi=w + 1),
-                   Field("cvn", init=-1),
+                   Field("cvn", init=-1, delta=8),
                    Field("cp", hi=NS), Field("cb", hi=NS)))],
         messages=[MessageType("PING", ("vn",)),
                   MessageType("GETVIEW", ()),

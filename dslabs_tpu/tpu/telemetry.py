@@ -149,6 +149,12 @@ DISPATCH_SITES = {
                                   program=True),
     "sharded.spill_reinject": dict(hot=False, donated=True, multi=True,
                                    program=False),
+    # Boundary work stealing (ISSUE 18 leg (c)): one extra all_to_all
+    # at a level boundary moving packed frontier rows per a host-built
+    # donation plan — dispatched only when the skew gate trips (or at
+    # the depth-1 root fanout), never in the per-chunk hot loop.
+    "sharded.steal":         dict(hot=False, donated=True, multi=True,
+                                  program=True),
     "swarm.round":           dict(hot=True, donated=True, multi=True,
                                   program=True),
     "swarm.init":            dict(hot=False, donated=False, multi=True,
@@ -565,6 +571,13 @@ class Telemetry:
             # shows a degraded mesh the moment it shrinks.  Always
             # present (schema-pinned); None until the first feed.
             "mesh_width": None,
+            # Live skew aggregate (ISSUE 18 satellite): running
+            # imbalance_max/mean/cv over the per-level explored lanes
+            # — the rebalance health of the CURRENT run, visible in
+            # `telemetry watch` instead of only in bench phase JSON.
+            # Always present (schema-pinned); None until a sharded
+            # level reports per-device lanes.
+            "skew_agg": None,
             # Causal-trace identity (ISSUE 13): STATUS.json carries the
             # same trace context as the flight log, so a live monitor
             # frame is linkable to the submit that caused the run.
@@ -735,6 +748,21 @@ class Telemetry:
                 self._status["spill"] = {k: v for k, v in rec.items()
                                          if k not in ("t", "ts")}
                 self._write_status()
+            elif kind == "steal":
+                # Boundary work-stealing (ISSUE 18c) fires AFTER the
+                # level feed, so the running skew aggregate picks the
+                # rebalance up here rather than from on_level.
+                agg = self._status.get("skew_agg") or {
+                    "imbalance_max": 1.0, "imbalance_mean": 0.0,
+                    "cv_max": 0.0, "levels": 0}
+                agg["steal_events"] = agg.get("steal_events", 0) + 1
+                agg["stolen_rows"] = (agg.get("stolen_rows", 0)
+                                      + int(fields.get("moved", 0)))
+                if fields.get("imbalance_after") is not None:
+                    agg["imbalance_post_steal"] = float(
+                        fields["imbalance_after"])
+                self._status["skew_agg"] = agg
+                self._write_status(force=True)
             else:
                 self._write_status()
 
@@ -773,6 +801,24 @@ class Telemetry:
                 self.registry.histogram(
                     f"skew_imbalance.{engine}").observe(
                     float(work.get("imbalance", 1.0)))
+                # Running skew aggregate (ISSUE 18 satellite): the live
+                # monitor's one-glance answer to "is this run
+                # imbalanced" — worst and mean per-level imbalance over
+                # the explored lanes, plus the worst cv, schema-pinned
+                # as STATUS.json's ``skew_agg`` block.
+                agg = self._status.get("skew_agg") or {
+                    "imbalance_max": 1.0, "imbalance_mean": 0.0,
+                    "cv_max": 0.0, "levels": 0}
+                n = agg["levels"]
+                imb = float(work.get("imbalance", 1.0))
+                agg["imbalance_max"] = max(agg["imbalance_max"], imb)
+                agg["imbalance_mean"] = round(
+                    (agg["imbalance_mean"] * n + imb) / (n + 1), 3)
+                agg["cv_max"] = max(
+                    agg["cv_max"], round(float(work.get("cv", 0.0)), 3))
+                agg["imbalance_max"] = round(agg["imbalance_max"], 3)
+                agg["levels"] = n + 1
+                self._status["skew_agg"] = agg
             # Live monitor: cumulative rate over the whole run PLUS a
             # sliding-window rate over the last N level records (the
             # satellite fix: one number for billing-grade averages,
@@ -1306,6 +1352,9 @@ def render_watch(path: str, now: Optional[float] = None) -> str:
                      f"cv={m.get('cv', 0.0):.2f}"
                      for lane, m in sorted(sk.items())]
             out.append("skew: " + " | ".join(parts))
+        if st.get("skew_agg"):
+            out.append("skew agg: " + " ".join(
+                f"{k}={v}" for k, v in sorted(st["skew_agg"].items())))
         pd = st.get("per_device") or {}
         if pd.get("frontier") is not None:
             out.append("per-device frontier: "
@@ -1758,6 +1807,61 @@ def compare_ledger(records: List[dict],
             "latest": round(lv, 3), "best_prior": round(best, 3),
             "delta_pct": round((lv - best) / best * 100, 1)
             if best > 0 else 0.0}
+    # Packed-wire mesh guards (ISSUE 18): two invariants the wire
+    # refactor exists to hold.  wire_bytes_per_state is the ICI
+    # payload row width on the mesh phase vs the BEST (smallest)
+    # prior — a rise means the exchange fell back to raw rows (codec
+    # disabled, identity descriptor) even when states/min holds.
+    # imbalance_max is the worst post-steal per-level frontier
+    # imbalance vs the BEST (lowest) prior — a rise means the stealing
+    # pass stopped levelling the shards.  Both rc-1 on regression.
+    cmp["mesh"] = {}
+
+    def _wire(rec):
+        s = rec.get("mesh")
+        if not isinstance(s, dict):
+            return None
+        w = s.get("wire")
+        if not isinstance(w, dict):
+            return None
+        try:
+            v = float(w.get("wire_bytes_per_state"))
+        except (TypeError, ValueError):
+            return None
+        return v if v > 0 else None
+
+    lv = _wire(latest)
+    priors_w = [v for v in (_wire(r) for r in prior) if v is not None]
+    if lv is not None and priors_w:
+        best = min(priors_w)
+        entry = {"phase": "mesh:wire_bytes_per_state",
+                 "latest": round(lv, 1), "best_prior": round(best, 1),
+                 "delta_pct": round((lv - best) / best * 100, 1)}
+        cmp["mesh"]["wire_bytes_per_state"] = entry
+        if lv > best * (1.0 + threshold):
+            cmp["regressions"].append(entry)
+
+    def _imb(rec):
+        s = rec.get("mesh")
+        if not isinstance(s, dict):
+            return None
+        try:
+            v = float(s.get("imbalance_max"))
+        except (TypeError, ValueError):
+            return None
+        return v if v >= 1.0 else None
+
+    lv = _imb(latest)
+    priors_i = [v for v in (_imb(r) for r in prior) if v is not None]
+    if lv is not None and priors_i:
+        best = min(priors_i)
+        entry = {"phase": "mesh:imbalance_max",
+                 "latest": round(lv, 2), "best_prior": round(best, 2),
+                 "delta_pct": round((lv - best) / best * 100, 1)
+                 if best > 0 else 0.0}
+        cmp["mesh"]["imbalance_max"] = entry
+        if lv > best * (1.0 + threshold):
+            cmp["regressions"].append(entry)
     return cmp
 
 
@@ -1807,6 +1911,10 @@ def render_compare(cmp: dict, source: str = "") -> str:
                    f"({e['delta_pct']:+.1f}%)")
     for c, e in sorted(cmp.get("memo", {}).items()):
         out.append(f"memo {c:20s} latest={e['latest']} "
+                   f"prior_best={e['best_prior']} "
+                   f"({e['delta_pct']:+.1f}%)")
+    for c, e in sorted(cmp.get("mesh", {}).items()):
+        out.append(f"mesh {c:20s} latest={e['latest']} "
                    f"prior_best={e['best_prior']} "
                    f"({e['delta_pct']:+.1f}%)")
     for e in cmp["regressions"]:

@@ -1,0 +1,398 @@
+"""Packed-wire mesh exchange + skew-balanced owner shards (ISSUE 18):
+the sharded carry moves PACKED rows through the fused owner-hashed
+``all_to_all`` and levels what it moves —
+
+* the wire descriptor cuts bytes-per-state >= 8x on the generated lab1
+  and paxos specs (13.7x / 13.5x measured — asserted from the
+  descriptor the engine actually installs);
+* packed-vs-raw exchange (``mesh_pack=False`` = the parity oracle
+  behind DSLABS_MESH_PACK) is BIT-IDENTICAL
+  (unique/explored/verdict/depth/dropped) across widths {1, 2, 4, 8},
+  strict and beam, and across a cross-width resume chain 8 -> 4 -> 2
+  -> 1 through the packed checkpoint format;
+* delta-from-level-base lanes (``Field(delta=)``, the varint lane for
+  view-number-style unbounded fields) pack the pb spec and stay exact;
+* root-fanout seeding + chunk-granular boundary stealing strictly
+  improve the skewed fixture's frontier imbalance at width 8 with
+  exact count parity (visited shards never move, so dedup ownership —
+  and therefore every count — is untouched by construction AND by
+  assertion);
+* the spill spool rides the packed encoding: 1/8-capacity strict runs
+  keep exact parity with the full-table oracle;
+* the fused promote still lowers with ZERO collectives under packing
+  (raw-lane repack at the boundary is elementwise);
+* pack/decode/steal are first-class dispatch sites (DISPATCH_SITES +
+  ``dispatch_site_programs()``) and their jaxprs audit clean;
+* a mesh job that runs UNPACKED (hand twin -> identity codec, or the
+  parity-oracle knob) is loud: a ``mesh_unpacked`` telemetry event,
+  never silence.
+
+Marked ``mesh`` (``make mesh-smoke`` runs this suite too).
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from dslabs_tpu.tpu import packing as packing_mod  # noqa: E402
+from dslabs_tpu.tpu.engine import TensorSearch  # noqa: E402
+from dslabs_tpu.tpu.protocols.pingpong import \
+    make_pingpong_protocol  # noqa: E402
+from dslabs_tpu.tpu.sharded import (ShardedTensorSearch,  # noqa: E402
+                                    make_mesh)
+from dslabs_tpu.tpu.specs import (clientserver_spec,  # noqa: E402
+                                  paxos_spec, pb_spec, pingpong_spec)
+from dslabs_tpu.tpu.telemetry import Telemetry  # noqa: E402
+
+pytestmark = pytest.mark.mesh
+
+_COLLECTIVES = ("all-to-all", "all_to_all", "all-reduce", "all_reduce",
+                "all-gather", "all_gather", "collective-permute",
+                "collective_permute", "reduce-scatter", "reduce_scatter")
+
+
+def _pruned(p):
+    name = next(iter(p.goals))
+    return dataclasses.replace(p, goals={},
+                               prunes={name: p.goals[name]})
+
+
+def _pingpong():
+    return _pruned(pingpong_spec(2).compile())
+
+
+def _lab1_small():
+    return _pruned(clientserver_spec(1, 2).compile())
+
+
+def _build(proto, n_devices, **kw):
+    kw.setdefault("chunk_per_device", 16)
+    kw.setdefault("frontier_cap", 1 << 8)
+    kw.setdefault("visited_cap", 1 << 10)
+    kw.setdefault("row_exchange", True)
+    return ShardedTensorSearch(proto, make_mesh(n_devices), **kw)
+
+
+def _assert_exact(a, b):
+    assert a.end_condition == b.end_condition
+    assert a.unique_states == b.unique_states
+    assert a.states_explored == b.states_explored
+    assert a.depth == b.depth
+    assert a.dropped == b.dropped
+
+
+# ------------------------------------------------------ wire descriptor
+
+@pytest.mark.parametrize("spec_fn,floor", [
+    (lambda: clientserver_spec(3, 4).compile(), 8.0),
+    (lambda: paxos_spec(3).compile(), 8.0),
+])
+def test_wire_bytes_per_state_floor(spec_fn, floor):
+    """ACCEPTANCE: the mesh wire descriptor (same derivation the
+    sharded engine installs: delta=True) cuts bytes-per-state >= 8x on
+    the lab1 and packed-paxos specs."""
+    proto = dataclasses.replace(spec_fn(), goals={})
+    lanes = TensorSearch(proto, chunk=8).lanes
+    pk = packing_mod.derive_packing(proto, lanes, delta=True)
+    assert pk is not None and not pk.identity
+    assert pk.pack_ratio >= floor, pk.descriptor()
+    assert pk.words * 4 * floor <= lanes * 4
+
+
+def test_engine_installs_packed_wire_by_default():
+    """DSLABS_MESH_PACK defaults ON: a generated spec gets the
+    non-identity codec, the carry plane shrinks to the packed word
+    count, and the verdict stamps the ratio (satellite: pack_ratio on
+    SearchOutcome + levels)."""
+    search = _build(_pingpong(), 2)
+    assert search.mesh_pack and search._pk is not None
+    assert search.plane == search._pk.words < search.lanes
+    out = search.run()
+    assert out.pack_ratio == pytest.approx(
+        search.lanes * 4 / (search.plane * 4), rel=0.01)
+    assert out.levels
+    assert all(lv["pack_ratio"] > 1.0 for lv in out.levels)
+    raw = _build(_pingpong(), 2, mesh_pack=False)
+    assert raw._pk is None and raw.plane == raw.lanes
+
+
+# ------------------------------------------------------- parity matrix
+
+@pytest.mark.parametrize("width", [1, 2, 4, 8])
+def test_packed_vs_raw_parity_pingpong(width):
+    """ACCEPTANCE: bit-identical verdicts between the packed wire and
+    the raw parity oracle at every mesh width."""
+    proto = _pingpong()
+    packed = _build(proto, width).run()
+    raw = _build(proto, width, mesh_pack=False).run()
+    assert packed.end_condition == "SPACE_EXHAUSTED"
+    _assert_exact(packed, raw)
+
+
+@pytest.mark.parametrize("strict", [True, False])
+def test_packed_vs_raw_parity_lab1(strict):
+    """Lab1 (generated, 13.7x codec) at width 8, strict AND beam: the
+    beam run truncates at a deliberately small frontier cap and the
+    drop count must match bit-for-bit too."""
+    proto = _pruned(clientserver_spec(2, 2).compile())
+    width = 8 if strict else 2
+    kw = dict(frontier_cap=1 << 8 if strict else 4,
+              visited_cap=1 << 12, strict=strict, max_depth=8)
+    if not strict:
+        # f_cap floors at chunk_per_device; 4 rows/device truncates
+        # this fixture's levels (per-device occupancy peaks at 7).
+        kw["chunk_per_device"] = 4
+    packed = _build(proto, width, **kw).run()
+    raw = _build(proto, width, mesh_pack=False, **kw).run()
+    _assert_exact(packed, raw)
+    if not strict:
+        assert packed.dropped > 0   # the beam really truncated
+
+
+def test_delta_lane_parity_pb():
+    """The varint lane (ISSUE 18b): pb's view-number fields carry
+    ``Field(delta=)`` domains, so the wire codec packs them against a
+    per-level base instead of falling back to identity — and the
+    delta-packed run matches the raw oracle exactly."""
+    proto = _pruned(pb_spec(2, 1, 1).compile())
+    search = _build(proto, 2, max_depth=3, frontier_cap=1 << 9,
+                    visited_cap=1 << 12)
+    assert search._pk is not None and search._pk.has_delta
+    assert search._mesh_delta
+    assert {"pb_cur", "pb_nxt"} <= set(search._carry_names())
+    packed = search.run()
+    raw = _build(proto, 2, mesh_pack=False, max_depth=3,
+                 frontier_cap=1 << 9, visited_cap=1 << 12).run()
+    _assert_exact(packed, raw)
+    assert packed.states_explored > 0
+
+
+def test_cross_width_resume_packed_8_4_2_1(tmp_path):
+    """A packed-wire checkpoint re-shards exactly onto every narrower
+    width: the dump stores packed rows + the encoding marker + (for
+    delta specs) the pack base, and each resume re-hashes owners at
+    the new D."""
+    proto = _pingpong()
+    oracle = _build(proto, 8).run()
+    assert oracle.end_condition == "SPACE_EXHAUSTED"
+
+    path = str(tmp_path / "mesh-packed.ckpt")
+    out = _build(proto, 8, checkpoint_path=path,
+                 checkpoint_every=1, max_depth=2).run()
+    assert out.end_condition == "DEPTH_EXHAUSTED"
+    for width, depth in ((4, 3), (2, 4), (1, None)):
+        search = _build(proto, width, checkpoint_path=path,
+                        checkpoint_every=1, max_depth=depth)
+        assert search._pk is not None   # the packed wire, end to end
+        out = search.run(resume=True)
+    assert out.end_condition == oracle.end_condition
+    assert out.unique_states == oracle.unique_states
+    assert out.states_explored == oracle.states_explored
+    assert out.depth == oracle.depth
+
+
+# ------------------------------------------------------- work stealing
+
+def test_steal_plan_conserves_rows():
+    """Host planner unit contract: donations conserve rows, never
+    exceed one chunk per (donor, receiver) pair, only move whole
+    chunks past depth 1, and respect the threshold gate."""
+    search = _build(_pingpong(), 8, steal_threshold=1.25)
+    D, K = search.n_devices, search.cpd
+
+    occ = [800] + [0] * (D - 1)          # the skewed fixture
+    plan = search._steal_plan(occ, depth=5)
+    assert plan is not None and plan.shape == (D, D)
+    assert plan.max() <= K
+    assert (plan.sum(axis=1) <= np.asarray(occ)).all()
+    after = [int(o - plan[d].sum() + plan[:, d].sum())
+             for d, o in enumerate(occ)]
+    assert sum(after) == sum(occ)        # conservation
+    mean = sum(occ) / D
+    assert max(after) / mean < max(occ) / mean   # strictly better
+    assert (plan[plan > 0] % K == 0).all()       # whole chunks only
+
+    # Depth 1 = root fanout: unconditional and unrounded.
+    plan1 = search._steal_plan([5] + [0] * (D - 1), depth=1)
+    assert plan1 is not None and plan1.sum() > 0
+
+    # Balanced frontier under the threshold: no plan, no dispatch.
+    assert search._steal_plan([100] * D, depth=5) is None
+    assert _build(_pingpong(), 1,
+                  steal_threshold=1.25)._steal_plan([100], 5) is None
+
+
+def test_steal_parity_and_imbalance_improves():
+    """ACCEPTANCE: on the skewed fixture (a lone root hashes to ONE
+    owner, so level 1 starts at imbalance D) stealing at width 8
+    strictly improves imbalance_max with exact count parity."""
+    proto = _pruned(clientserver_spec(3, 4).compile())
+    kw = dict(chunk_per_device=4, frontier_cap=1 << 9,
+              visited_cap=1 << 13, max_depth=8)
+    base = _build(proto, 8, **kw).run()
+    search = _build(proto, 8, steal_threshold=1.05, **kw)
+    assert search._steal_on
+    out = search.run()
+    _assert_exact(base, out)             # counts bit-identical
+    steals = [lv["steal"] for lv in (out.levels or [])
+              if lv.get("steal")]
+    assert steals, "the skewed fixture must trigger at least one steal"
+    for s in steals:
+        assert s["moved"] > 0
+        assert s["imbalance_after"] <= s["imbalance_before"]
+    # The worst post-steal frontier imbalance strictly beats the worst
+    # pre-steal one — the number bench --mesh reports and the ledger
+    # guards (mesh:imbalance_max).
+    assert (max(s["imbalance_after"] for s in steals)
+            < max(s["imbalance_before"] for s in steals))
+    post = [lv["skew"]["frontier_post_steal"] for lv in out.levels
+            if lv.get("skew", {}).get("frontier_post_steal")]
+    assert post and all("imbalance" in m for m in post)
+
+
+def test_steal_off_by_default():
+    """DSLABS_MESH_STEAL_THRESHOLD unset = no stealing: the knob is
+    opt-in (bench --mesh opts in; parity oracles stay untouched)."""
+    assert "DSLABS_MESH_STEAL_THRESHOLD" not in os.environ
+    search = _build(_pingpong(), 8)
+    assert not search._steal_on
+    out = search.run()
+    assert not any(lv.get("steal") for lv in (out.levels or []))
+
+
+# ------------------------------------------------------- spill + promote
+
+def test_packed_spill_parity_eighth_capacity():
+    """ACCEPTANCE: the spill spool rides the packed encoding — a
+    strict run with the visited table capped at ~1/8 of the reachable
+    count keeps exact parity with the full-table oracle through
+    drain/evict/reinject of PACKED spool segments."""
+    proto = _lab1_small()
+    base = _build(proto, 2, frontier_cap=1 << 9,
+                  visited_cap=1 << 13, max_depth=8).run()
+    cap = 1 << max(3, int(np.floor(
+        np.log2(max(base.unique_states // 8, 8)))))
+    out = _build(proto, 2, frontier_cap=1 << 9, visited_cap=cap,
+                 max_depth=8, spill=True).run()
+    _assert_exact(base, out)
+    assert out.dropped_states == 0
+    assert out.spilled_keys > 0          # the tier really engaged
+
+
+@pytest.mark.parametrize("spec_fn", [_pingpong,
+                                     lambda: _pruned(
+                                         pb_spec(2, 1, 1).compile())])
+def test_fused_promote_zero_collectives_under_packing(spec_fn):
+    """ACCEPTANCE pin: the fused promote stays a LOCAL buffer swap
+    under the packed wire — including the delta repack (pb spec),
+    which re-bases rows elementwise against the replicated pb vector
+    and must not reintroduce a boundary collective."""
+    search = _build(spec_fn(), 8)
+    assert search._pk is not None
+    text = search._finish_level.lower(search._carry_sds()).as_text()
+    assert not any(c in text for c in _COLLECTIVES), (
+        "packed fused-exchange promote must stay collective-free")
+
+
+# ------------------------------------------------------- observability
+
+def test_dispatch_sites_cover_pack_decode_steal():
+    """CI satellite: pack/decode/steal are canonical dispatch sites —
+    registered in DISPATCH_SITES, emitted by the sharded engine's
+    dispatch_site_programs(), and their jaxprs audit clean (J1-J5)."""
+    from dslabs_tpu.analysis.jaxpr_audit import audit_sites
+    from dslabs_tpu.tpu.telemetry import DISPATCH_SITES
+
+    for site in ("packing.pack", "packing.unpack", "sharded.steal"):
+        assert site in DISPATCH_SITES
+    assert DISPATCH_SITES["sharded.steal"]["program"]
+    search = _build(_pingpong(), 2, steal_threshold=1.25)
+    sites = search.dispatch_site_programs()
+    picked = {k: v for k, v in sites.items()
+              if k in ("packing.pack", "packing.unpack",
+                       "sharded.steal")}
+    assert set(picked) == {"packing.pack", "packing.unpack",
+                           "sharded.steal"}
+    assert audit_sites(picked, "ShardedTensorSearch") == []
+
+
+def test_mesh_unpacked_event_is_loud():
+    """Satellite: a mesh job shipping RAW lanes is loud — the hand
+    twin (identity codec) and the parity-oracle knob both emit a
+    ``mesh_unpacked`` event; the packed default emits none."""
+    def run(proto, **kw):
+        tel = Telemetry()
+        _build(proto, 2, telemetry=tel, max_depth=4, **kw).run()
+        return [e for e in tel.events
+                if e.get("t") == "event"
+                and e.get("kind") == "mesh_unpacked"]
+
+    hand = dataclasses.replace(
+        make_pingpong_protocol(2), goals={})
+    ev = run(hand)
+    assert ev and ev[0]["reason"] == "identity descriptor"
+    ev = run(_pingpong(), mesh_pack=False)
+    assert ev and ev[0]["reason"] == "knob"
+    assert run(_pingpong()) == []
+
+
+def test_status_skew_agg_block_and_watch(tmp_path, capsys):
+    """Bugfix satellite: STATUS.json carries a schema-pinned skew
+    aggregate (imbalance_max/mean/cv live from the per-level lanes)
+    and ``telemetry watch`` renders it during a run."""
+    import json
+
+    from dslabs_tpu.tpu import telemetry as tel_mod
+
+    ck = str(tmp_path / "search.ckpt")
+    tel = Telemetry.for_checkpoint(ck)
+    search = _build(_pruned(clientserver_spec(3, 4).compile()), 8,
+                    steal_threshold=1.05, chunk_per_device=4,
+                    frontier_cap=1 << 9, visited_cap=1 << 13,
+                    max_depth=6, telemetry=tel)
+    search.run()
+    tel.close()
+
+    st = json.loads((tmp_path / "STATUS.json").read_text())
+    assert "skew_agg" in st              # schema-pinned
+    agg = st["skew_agg"]
+    for key in ("imbalance_max", "imbalance_mean", "cv_max", "levels"):
+        assert key in agg
+    assert agg["levels"] > 0
+    assert agg["imbalance_max"] >= agg["imbalance_mean"] > 0
+    assert agg["stolen_rows"] > 0        # the steal rode the feed
+
+    assert tel_mod.main(["watch", str(tmp_path), "--once"]) == 0
+    text = capsys.readouterr().out
+    assert "skew agg:" in text
+    assert "imbalance_max=" in text
+
+
+def test_compare_ledger_guards_mesh_wire_and_imbalance():
+    """Bench satellite: the ledger guards the two numbers this PR
+    exists to hold — wire bytes-per-state rising (codec fell back to
+    raw) or post-steal imbalance_max rising (stealing stopped
+    levelling) past the threshold is an rc-1 regression."""
+    from dslabs_tpu.tpu.telemetry import compare_ledger
+
+    def rec(wire_bps, imb):
+        return {"t": "bench", "value": 1000.0,
+                "mesh": {"value": 1000.0,
+                         "wire": {"wire_bytes_per_state": wire_bps,
+                                  "wire_bytes_per_state_raw": 264,
+                                  "key_bytes_per_state": 16},
+                         "imbalance_max": imb}}
+
+    cmp = compare_ledger([rec(16, 1.2), rec(264, 8.0)], threshold=0.1)
+    phases = {e["phase"] for e in cmp["regressions"]}
+    assert "mesh:wire_bytes_per_state" in phases
+    assert "mesh:imbalance_max" in phases
+    assert cmp["mesh"]["wire_bytes_per_state"]["best_prior"] == 16
+
+    cmp = compare_ledger([rec(16, 1.2), rec(16, 1.2)], threshold=0.1)
+    assert not [e for e in cmp["regressions"]
+                if str(e["phase"]).startswith("mesh:")]
