@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast lint analysis-smoke perf-smoke fault-smoke swarm-smoke capacity-smoke capacity2-smoke obs-smoke chaos-smoke service-smoke trace-smoke mesh-smoke lanes-smoke memo-smoke lab0 lab1 lab2 lab3 lab4 bench dryrun handout clean
+.PHONY: test test-fast lint analysis-smoke perf-smoke fault-smoke swarm-smoke capacity-smoke capacity2-smoke obs-smoke chaos-smoke service-smoke trace-smoke mesh-smoke lanes-smoke memo-smoke scenario-smoke lab0 lab1 lab2 lab3 lab4 bench dryrun handout clean
 
 test:            ## full acceptance + parity suite
 	$(PY) -m pytest tests/ -q
@@ -211,6 +211,20 @@ lanes-smoke:     ## batched job lanes: parity matrix + continuous batching + res
 memo-smoke:      ## cross-job memoization: verdict cache + warm start + incremental re-check parity on CPU
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m memo -p no:cacheprovider
 	JAX_PLATFORMS=cpu $(PY) tools/obs_smoke.py
+
+# scenario-smoke = the checkable-fault-scenario suite
+# (tests/test_scenarios.py, ISSUE 19): fault-free parity / overhead
+# guard on both engines (zero-budget FaultModel == plain spec,
+# exactly), the paxos partition-then-heal safety pins and the
+# broken-quorum witness that NAMES its HEAL event, crash
+# durable-vs-volatile semantics on _step_one, fault lanes through
+# packing/symmetry/spill/checkpoint (incl. SIGKILL-mid-scenario
+# resume and the fault-signature fingerprint refusal), the C6
+# conformance fixtures, telemetry/warden counter wiring, and the
+# partitioned-scenario chaos-soak leg.  docs/scenarios.md is the
+# field guide.
+scenario-smoke:  ## checkable fault scenarios: partition/crash/drop-dup model events + witness replay on CPU
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m scenario -p no:cacheprovider
 
 dryrun:          ## multi-chip sharding dry run on a virtual CPU mesh
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -91,6 +91,7 @@ SERVICE_CAP_SECS = 120.0     # multi-tenant service phase (ISSUE 11)
 MESH_CAP_SECS = 150.0        # 8-device mesh headline phase (ISSUE 12)
 LANES_CAP_SECS = 150.0       # batched-job-lanes phase (ISSUE 14)
 MEMO_CAP_SECS = 150.0        # cross-job memoization phase (ISSUE 16)
+SCENARIOS_CAP_SECS = 120.0   # fault-scenario phase (ISSUE 19)
 # Parent backstop beyond the child's budget.  Generous on purpose: the
 # child's time checks are level-granular (a slow level can overrun
 # max_secs by ~30 s, sharded.py round-3 note), the strict child floors
@@ -1169,6 +1170,71 @@ def _run_memo(budget_secs: float) -> dict:
     }
 
 
+def _run_scenarios(budget_secs: float) -> dict:
+    """Fault-scenario phase (ISSUE 19, tpu/faults.py): on the generated
+    single-decree paxos spec — states/min with the partition fault
+    lanes ON (paxos_partition_spec: cut/heal as model events) vs the
+    plain fault-free spec OFF, the fault-event share of the explored
+    space, and the ``verdict_parity`` flag the ledger's
+    ``scenarios:verdict_parity`` guard pins: a ZERO-BUDGET FaultModel
+    (constant controller lanes, no valid fault events) must land the
+    exact fault-free verdict/explored/unique — the overhead-guard
+    invariant every scenario rides on.  Same always-reports guarantees
+    as every phase."""
+    import dataclasses
+
+    _persistent_cache()
+
+    from dslabs_tpu.tpu.engine import TensorSearch
+    from dslabs_tpu.tpu.faults import FaultModel, Partition
+    from dslabs_tpu.tpu.specs import paxos_partition_spec, paxos_spec
+
+    t_phase = time.time()
+    tel = _phase_telemetry("scenarios")
+
+    def _pruned(p):
+        return dataclasses.replace(
+            p, goals={}, prunes=dict(p.goals),
+            invariants=dict(p.invariants))
+
+    def run_one(proto):
+        search = TensorSearch(proto, chunk=256, frontier_cap=1 << 14,
+                              visited_cap=1 << 17, telemetry=tel)
+        search.run()          # warm-up: compile outside the window
+        t0 = time.time()
+        out = search.run()
+        return out, max(time.time() - t0, 1e-9)
+
+    _hb("scenarios: fault-free baseline (plain paxos)")
+    base, dt_b = run_one(_pruned(paxos_spec(3).compile()))
+    _hb("scenarios: zero-budget FaultModel (overhead guard)")
+    fm0 = FaultModel(partition=Partition(
+        blocks=(("proposer",), ("acceptor",)), max_eras=0))
+    zb, _dt_z = run_one(_pruned(paxos_spec(3, fault=fm0).compile()))
+    parity = (zb.end_condition == base.end_condition
+              and zb.states_explored == base.states_explored
+              and zb.unique_states == base.unique_states)
+    _hb("scenarios: partition cut/heal scenario (fault lanes on)")
+    sc, dt_s = run_one(_pruned(paxos_partition_spec(3).compile()))
+    share = (round(sc.fault_events / sc.states_explored, 4)
+             if sc.states_explored else 0.0)
+    return {
+        "value": round(sc.states_explored / dt_s * 60.0, 1),
+        "rate_off": round(base.states_explored / dt_b * 60.0, 1),
+        "verdict_parity": int(parity),
+        "fault_event_share": share,
+        "end": sc.end_condition, "depth": sc.depth,
+        "unique": sc.unique_states, "explored": sc.states_explored,
+        "fault_events": sc.fault_events,
+        "partition_events": sc.partition_events,
+        "base": {"end": base.end_condition,
+                 "unique": base.unique_states,
+                 "explored": base.states_explored},
+        "total_secs": round(time.time() - t_phase, 1),
+        "telemetry": tel.summary(),
+    }
+
+
 # ----------------------------------------------------------------- parent
 
 _CURRENT_CHILD = None     # live phase Popen, killed by the signal handler
@@ -1542,6 +1608,13 @@ def main() -> None:
                 silence=PHASE_SILENCE_SECS)
             if memo_res is not None:
                 result["memo"] = memo_res
+        if _remaining() > 75:
+            scen_res, _scen_err = _sub(
+                ["--scenarios", str(min(90.0, _remaining() - 15))],
+                min(90.0, _remaining() - 10), "scenarios-cpu",
+                silence=PHASE_SILENCE_SECS)
+            if scen_res is not None:
+                result["scenarios"] = scen_res
         _emit(result)
         return
 
@@ -1717,6 +1790,24 @@ def main() -> None:
     else:
         result["memo_error"] = "skipped: deadline nearly exhausted"
 
+    # ---- phase 5.8: fault scenarios (ISSUE 19) — states/min with the
+    # partition fault lanes on vs off, the fault-event share, and the
+    # zero-budget verdict_parity flag the ledger's
+    # ``scenarios:verdict_parity`` guard pins (0 = rc 1 regardless of
+    # threshold).  Never the headline; skipped rather than raced near
+    # the deadline.
+    budget = min(SCENARIOS_CAP_SECS, _remaining() - KILL_SLACK_SECS - 10)
+    if budget > 45:
+        scen_res, scen_err = _sub(["--scenarios", str(budget)], budget,
+                                  "scenarios",
+                                  silence=PHASE_SILENCE_SECS)
+        if scen_res is not None:
+            result["scenarios"] = scen_res
+        else:
+            result["scenarios_error"] = scen_err
+    else:
+        result["scenarios_error"] = "skipped: deadline nearly exhausted"
+
     # ---- phase 6: the soundness sanitizer (ISSUE 10) — findings per
     # leg + waived count off `python -m dslabs_tpu.analysis all` in a
     # CPU-pinned child (static: lowers, never compiles or dispatches).
@@ -1784,6 +1875,11 @@ if __name__ == "__main__":
         budget = (float(sys.argv[2]) if len(sys.argv) > 2
                   else MEMO_CAP_SECS)
         print(json.dumps(_run_memo(budget)))
+        sys.exit(0)
+    if len(sys.argv) >= 2 and sys.argv[1] == "--scenarios":
+        budget = (float(sys.argv[2]) if len(sys.argv) > 2
+                  else SCENARIOS_CAP_SECS)
+        print(json.dumps(_run_scenarios(budget)))
         sys.exit(0)
     if len(sys.argv) >= 2 and sys.argv[1] == "--mesh":
         # The 8-wide mesh needs 8 devices SOMEWHERE: force the host

@@ -50,6 +50,11 @@ RULES = {
           "compared against a constant) — breaks member "
           "interchangeability, so the canonicalize pass would merge "
           "states with DIFFERENT behavior",
+    "C6": "fault-model opacity: a handler reads or branches on fault "
+          "controller internals (the '$fault' kind or its "
+          "pcut/eras/crashes/drops/dups/down_* lanes) — protocols "
+          "must observe faults only through message loss and timer "
+          "silence, or the scenario stops modeling a real network",
     "J0": "site-registry coverage: dispatch site missing from "
           "telemetry.DISPATCH_SITES, or its program failed to lower",
     "J1": "host callback inside a lowered device program",

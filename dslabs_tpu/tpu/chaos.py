@@ -316,9 +316,20 @@ def _protocol(name: str):
             make_clientserver_protocol
 
         p = make_clientserver_protocol(n_clients=1, w=2)
+    elif name == "paxos-partition":
+        # Scenario-protocol leg (ISSUE 19): a job whose MODEL carries
+        # fault events (partition cut/heal lanes) soaked under the
+        # supervisor's own orthogonal fault injection — the scenario's
+        # search-level faults and the infrastructure's chaos faults
+        # compose without disturbing the verdict.
+        from dslabs_tpu.tpu.specs import paxos_partition_spec
+
+        p = paxos_partition_spec().compile()
+        return _dc.replace(p, goals={},
+                           prunes={"DECIDED": p.goals["DECIDED"]})
     else:
         raise SystemExit(f"unknown --protocol {name!r} "
-                         "(pingpong | lab1)")
+                         "(pingpong | lab1 | paxos-partition)")
     # Exhaustive shape: the goal becomes a prune so the soak measures
     # full-space parity, not a first-goal race.
     return _dc.replace(p, goals={},
@@ -335,7 +346,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="seeded chaos soak: strict search under sustained "
                     "fault injection, exact parity asserted")
     ap.add_argument("--protocol", default="lab1",
-                    choices=("pingpong", "lab1"))
+                    choices=("pingpong", "lab1", "paxos-partition"))
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--faults", type=int, default=24)
     ap.add_argument("--mesh", type=int, default=None,

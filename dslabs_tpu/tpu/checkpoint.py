@@ -212,7 +212,15 @@ def config_fingerprint(protocol, strict: bool,
             protocol.timer_width, protocol.net_cap,
             protocol.timer_cap, bool(strict), bool(record_trace))
     if symmetry:
-        return repr(base + (f"sym{symmetry}",))
+        base = base + (f"sym{symmetry}",)
+    # Fault scenarios (ISSUE 19) change the event grid and the reachable
+    # space: a scenario dump must never resume into a fault-free search
+    # (or a differently-parameterised scenario) and vice versa.  The
+    # signature is derived here, not at call sites, so every producer
+    # (engine, sharded, swarm seed loader) gets it for free.
+    fl = getattr(protocol, "fault", None)
+    if fl is not None:
+        base = base + (fl.signature(),)
     return repr(base)
 
 

@@ -273,9 +273,21 @@ class ProtocolSpec:
                  timers: Sequence[TimerType],
                  net_cap: int = 16,
                  timer_cap: int = 4,
-                 symmetry: Sequence[str] = ()):
+                 symmetry: Sequence[str] = (),
+                 fault: Optional[object] = None):
         self.name = name
         self.nodes = list(nodes)
+        # Declarative fault model (ISSUE 19, tpu/faults.py): when set,
+        # a hidden controller node kind ("$fault") is appended LAST so
+        # partition/crash/drop/dup budgets live in ordinary bounded
+        # Fields — packing, symmetry, spill and checkpoints carry them
+        # with zero special cases.  compile() attaches the lowered
+        # FaultLanes descriptor to TensorProtocol.fault; fault=None
+        # specs lower byte-identically to the pre-fault program.
+        self.fault = fault
+        if fault is not None:
+            from dslabs_tpu.tpu.faults import controller_kind
+            self.nodes.append(controller_kind(fault, self.nodes))
         self.messages = list(messages)
         self.timers = list(timers)
         self.net_cap = net_cap
@@ -392,6 +404,24 @@ class ProtocolSpec:
         top of :meth:`compile`; the conformance linter
         (dslabs_tpu/analysis/conformance.py) reports the same failures
         as findings without raising."""
+        from dslabs_tpu.tpu.faults import FAULT_KIND, validate_fault
+        n_ctrl = sum(1 for k in self.nodes if k.name == FAULT_KIND)
+        if n_ctrl != (1 if self.fault is not None else 0):
+            raise SpecError(
+                f"node kind name {FAULT_KIND!r} is reserved for the "
+                "fault controller (declare faults via fault=FaultModel"
+                "(...), not as a node kind)",
+                spec=self.name, kind=FAULT_KIND, code="C6")
+        if self.fault is not None:
+            for (kind, _msg) in list(self.handlers) + \
+                    list(self.timer_handlers):
+                if kind == FAULT_KIND:
+                    raise SpecError(
+                        "handlers may not be registered on the fault "
+                        "controller kind — protocols observe faults "
+                        "only through message loss and timer silence",
+                        spec=self.name, kind=FAULT_KIND, code="C6")
+            validate_fault(self)
         kinds = {k.name for k in self.nodes}
         for (kind, msg), fn in self.handlers.items():
             name, line = self._handler_id(fn)
@@ -741,12 +771,19 @@ class ProtocolSpec:
                 return fn(_View(spec, table, state["nodes"]))
             return wrapped
 
+        fault_lanes = None
+        if self.fault is not None:
+            from dslabs_tpu.tpu.faults import compile_fault_lanes
+            fault_lanes = compile_fault_lanes(self, table, nw,
+                                              init_nodes())
+
         return TensorProtocol(
             name=self.name,
             n_nodes=n_nodes,
             node_width=nw,
             lane_domains=self._lane_domains(),
             symmetry=self._symmetry_spec(table),
+            fault=fault_lanes,
             msg_width=self._mw,
             timer_width=self._tw,
             net_cap=self.net_cap,

@@ -195,6 +195,13 @@ class TensorProtocol:
     # permutation tables over node ids/lanes for the opt-in
     # canonicalize-before-fingerprint pass.  None = no groups.
     symmetry: Optional[object] = None
+    # Checkable fault scenarios (ISSUE 19, tpu/faults.py FaultLanes):
+    # the compiled fault-model descriptor — partition/crash/drop/dup
+    # event segment layout, controller lane offsets, deliverability
+    # tables.  None = no fault model; every engine addition is gated
+    # at trace time on this, so fault-free specs lower to the
+    # byte-identical pre-fault program.
+    fault: Optional[object] = None
 
 
 @dataclasses.dataclass
@@ -322,6 +329,16 @@ class SearchOutcome:
     # the gap is drain work overlapped with device compute.
     spill_drain_ms: int = 0
     spill_wait_ms: int = 0
+    # Checkable fault scenarios (ISSUE 19, tpu/faults.py): valid fault
+    # events EXPLORED (counted over successor states, like
+    # states_explored) split by family — partition cut/heal, crash +
+    # restart, message drops, dup tags — and their total.  All zero
+    # when the spec declares no fault model.
+    fault_events: int = 0
+    partition_events: int = 0
+    crash_events: int = 0
+    drop_events: int = 0
+    dup_events: int = 0
 
     @property
     def dropped_states(self) -> int:
@@ -869,7 +886,14 @@ class TensorSearch:
             bm, bt = ev_budget, tgrid
         self._ev_msg = min(bm, protocol.net_cap)
         self._ev_tmr = min(bt, tgrid)
-        self._ev_slots = self._ev_msg + self._ev_tmr
+        # Fault event segment (ISSUE 19, tpu/faults.py): always the FULL
+        # fault grid — fault grids are small (2 + 2*crashable + the
+        # drop/dup slots), never budget-windowed, so re-step spill
+        # passes (ev_pass > 0) see an empty fault table via the
+        # _compact_ids offset logic rather than a shifted window.
+        self._ev_flt = (protocol.fault.n_events
+                        if protocol.fault is not None else 0)
+        self._ev_slots = self._ev_msg + self._ev_tmr + self._ev_flt
         # When False, _expand_chunk marks every valid successor unique and
         # dedup is entirely the caller's job — only meaningful for drivers
         # with their own dedup authority (the sharded engine's owner-side
@@ -931,6 +955,11 @@ class TensorSearch:
         # (SURVEY §8.1; SearchState.java:361-474). Populated by run() when
         # record_trace is set; consumed by tpu/trace.py.
         self._levels: List[dict] = []
+        # Fault-event counters accumulated per run (ISSUE 19): numpy
+        # [4] = partition / crash / drop / dup valid successor events
+        # (counted like states_explored); stamped onto the outcome by
+        # _stamp_faults.  Always zeros when protocol.fault is None.
+        self._fault_counts = np.zeros((4,), np.int64)
         self._expand = jax.jit(self._expand_chunk)
         # Terminal-flag order = checkState order (Search.java:162-231):
         # exception strictly first, then invariants, then goals.  Shared
@@ -1311,6 +1340,209 @@ class TensorSearch:
                               if self._canon is not None else 0)
         return out
 
+    # -------------------------------------------- fault plane (ISSUE 19)
+    #
+    # Every method below is reached only under a trace-time
+    # ``p.fault is not None`` guard: a fault-free spec lowers to the
+    # byte-identical pre-fault program.  All picks are one-hot /
+    # static-index, matching the step kinds' discipline.
+
+    def _fault_down_vec(self, nodes: jnp.ndarray) -> jnp.ndarray:
+        """[NN] int32 down flags of ONE state's node vector (0 for
+        non-crashable nodes) — a static gather over the controller's
+        ``down_*`` lanes."""
+        fl = self.p.fault
+        z = jnp.zeros((), jnp.int32)
+        return jnp.stack([nodes[int(off)] if int(off) >= 0 else z
+                          for off in fl.down_off])
+
+    def _fault_msg_ok(self, nodes: jnp.ndarray,
+                      msg: jnp.ndarray) -> jnp.ndarray:
+        """Deliverability of ONE message row under ONE state's fault
+        lanes: blocked while a cut separates frm/to's partition blocks,
+        or while the DESTINATION is down (in-flight messages from a
+        node that later crashed stay deliverable — they already left).
+        Blocked messages stay in the network set, deliverable again
+        after HEAL/RESTART; only the DROP event removes them."""
+        fl = self.p.fault
+        ok = jnp.asarray(True)
+        nid = jnp.arange(fl.n_nodes)
+        oh_f = nid == msg[1]
+        oh_t = nid == msg[2]
+        if fl.has_partition:
+            blk = jnp.asarray(fl.block_id)
+            bf = jnp.sum(oh_f * blk)
+            bt = jnp.sum(oh_t * blk)
+            cross = (bf >= 0) & (bt >= 0) & (bf != bt)
+            ok = ok & ~((nodes[fl.pcut_off] > 0) & cross)
+        if fl.n_crashable:
+            ok = ok & (jnp.sum(oh_t * self._fault_down_vec(nodes)) == 0)
+        return ok
+
+    def _flt_step(self, row: jnp.ndarray, f_idx: jnp.ndarray):
+        """Expand ONE state row by ONE fault event (index into the
+        fault segment of the grid) -> (successor row, valid, over).
+        Fault steps run no handlers and send nothing — they flip
+        controller lanes, wipe volatile fields (CRASH) or remove one
+        network row (DROP); ``over`` is always 0."""
+        p = self.p
+        fl = p.fault
+        s = self._slice_state(row)
+        nodes, net = s["nodes"], s["net"]
+        ok = jnp.asarray(False)
+        nodes2 = nodes
+        net2 = net
+        if fl.has_partition:
+            is_cut = f_idx == fl.seg_cut
+            is_heal = f_idx == fl.seg_heal
+            pcut, eras = nodes[fl.pcut_off], nodes[fl.eras_off]
+            ok = ok | (is_cut & (pcut == 0)
+                       & (eras < fl.model.partition.max_eras)) \
+                    | (is_heal & (pcut > 0))
+            nodes2 = nodes2.at[fl.pcut_off].set(
+                jnp.where(is_cut, 1,
+                          jnp.where(is_heal, 0, nodes2[fl.pcut_off])))
+            nodes2 = nodes2.at[fl.eras_off].add(
+                jnp.where(is_cut, 1, 0))
+        for k in range(fl.n_crashable):
+            n = int(fl.crash_nodes[k])
+            off = int(fl.down_off[n])
+            is_c = f_idx == fl.seg_crash + k
+            is_r = f_idx == fl.seg_restart + k
+            down_n = nodes[off]
+            ok = ok | (is_c & (down_n == 0)
+                       & (nodes[fl.crashes_off]
+                          < fl.model.crash.max_crashes)) \
+                    | (is_r & (down_n > 0))
+            # Volatile wipe back to declared inits; durable lanes (and
+            # every other node's lanes) keep their values.
+            nodes2 = jnp.where(is_c & jnp.asarray(fl.wipe[k]),
+                               jnp.asarray(fl.init_vec), nodes2)
+            nodes2 = nodes2.at[off].set(
+                jnp.where(is_c, 1, jnp.where(is_r, 0, nodes2[off])))
+            nodes2 = nodes2.at[fl.crashes_off].add(
+                jnp.where(is_c, 1, 0))
+        if fl.model.max_drops > 0:
+            in_drop = (f_idx >= fl.seg_drop) \
+                & (f_idx < fl.seg_drop + p.net_cap)
+            slot = (f_idx - fl.seg_drop).clip(0, p.net_cap - 1)
+            s_oh = jnp.arange(p.net_cap) == slot
+            occ = jnp.sum(s_oh * (net[:, 0] != SENTINEL)) > 0
+            ok = ok | (in_drop & occ
+                       & (nodes[fl.drops_off] < fl.model.max_drops))
+            # Static shift-left removal keeps the network set's
+            # canonical sorted prefix (same pattern as remove_timer).
+            net2 = jnp.where(in_drop, remove_timer(net, slot), net2)
+            nodes2 = nodes2.at[fl.drops_off].add(
+                jnp.where(in_drop, 1, 0))
+        if fl.model.max_dups > 0:
+            in_dup = f_idx >= fl.seg_dup
+            slot = (f_idx - fl.seg_dup).clip(0, p.net_cap - 1)
+            s_oh = jnp.arange(p.net_cap) == slot
+            occ = jnp.sum(s_oh * (net[:, 0] != SENTINEL)) > 0
+            # Set-semantics delivery never consumes, so a duplicate is
+            # behaviorally subsumed; the explicit event binds the dup
+            # budget and names the slot in witness traces.
+            ok = ok | (in_dup & occ
+                       & (nodes[fl.dups_off] < fl.model.max_dups))
+            nodes2 = nodes2.at[fl.dups_off].add(
+                jnp.where(in_dup, 1, 0))
+        row2 = jnp.concatenate([
+            nodes2.astype(jnp.int32), net2.reshape(-1),
+            s["timers"].reshape(-1), jnp.zeros((1,), jnp.int32)])
+        return row2, ok, jnp.int32(0)
+
+    def _fault_event_grid(self, chunk_state: dict) -> jnp.ndarray:
+        """[C, n_fault_events] validity grid over the fault segment —
+        the fault-side analog of the msg/timer tables in
+        :meth:`_event_tables`; validity conditions mirror
+        :meth:`_flt_step`'s ``ok`` exactly."""
+        p = self.p
+        fl = p.fault
+        nodesC = chunk_state["nodes"]
+        c = nodesC.shape[0]
+        cols = []
+        if fl.has_partition:
+            pcut = nodesC[:, fl.pcut_off]
+            eras = nodesC[:, fl.eras_off]
+            cols.append(((pcut == 0)
+                         & (eras < fl.model.partition.max_eras))[:, None])
+            cols.append((pcut > 0)[:, None])
+        if fl.n_crashable:
+            downs = jnp.stack(
+                [nodesC[:, int(fl.down_off[int(n)])] > 0
+                 for n in fl.crash_nodes], axis=1)       # [C, nc]
+            budget = (nodesC[:, fl.crashes_off]
+                      < fl.model.crash.max_crashes)[:, None]
+            cols.append(~downs & budget)
+            cols.append(downs)
+        occ = chunk_state["net"][:, :, 0] != SENTINEL    # [C, net_cap]
+        if fl.model.max_drops > 0:
+            cols.append(occ & (nodesC[:, fl.drops_off]
+                               < fl.model.max_drops)[:, None])
+        if fl.model.max_dups > 0:
+            cols.append(occ & (nodesC[:, fl.dups_off]
+                               < fl.model.max_dups)[:, None])
+        return (jnp.concatenate(cols, axis=1) if cols
+                else jnp.zeros((c, 0), bool))
+
+    def _fault_chunk_counts(self, event_ids, valids) -> jnp.ndarray:
+        """[4] int32 partition/crash/drop/dup VALID successor events in
+        one expanded chunk (traced; the device wave loop sums it into
+        the carry).  ``event_ids`` [C, B] grid ids, ``valids`` [C*B]."""
+        fl = self.p.fault
+        base = self.p.net_cap + self.p.n_nodes * self.p.timer_cap
+        ev = event_ids.reshape(-1)
+        ok = valids & (ev >= base)
+        f = ev - base
+
+        def cnt(m):
+            return jnp.sum(ok & m).astype(jnp.int32)
+
+        return jnp.stack([
+            cnt(f < fl.seg_crash),
+            cnt((f >= fl.seg_crash) & (f < fl.seg_drop)),
+            cnt((f >= fl.seg_drop) & (f < fl.seg_dup)),
+            cnt(f >= fl.seg_dup)])
+
+    def _accum_fault_counts(self, event_ids, valids) -> None:
+        """Host-loop twin of :meth:`_fault_chunk_counts`: accumulate
+        one chunk's fault-family counts into ``self._fault_counts``
+        (numpy, no device work)."""
+        fl = self.p.fault
+        base = self.p.net_cap + self.p.n_nodes * self.p.timer_cap
+        ev = np.asarray(event_ids).reshape(-1)
+        ok = np.asarray(valids).reshape(-1) & (ev >= base)
+        f = ev - base
+        self._fault_counts[0] += int(np.sum(ok & (f < fl.seg_crash)))
+        self._fault_counts[1] += int(np.sum(
+            ok & (f >= fl.seg_crash) & (f < fl.seg_drop)))
+        self._fault_counts[2] += int(np.sum(
+            ok & (f >= fl.seg_drop) & (f < fl.seg_dup)))
+        self._fault_counts[3] += int(np.sum(ok & (f >= fl.seg_dup)))
+
+    def _stamp_faults(self, out: "SearchOutcome") -> "SearchOutcome":
+        """Stamp the run's accumulated fault-event counters onto the
+        outcome (zeros when no fault model is declared)."""
+        fc = self._fault_counts
+        out.partition_events = int(fc[0])
+        out.crash_events = int(fc[1])
+        out.drop_events = int(fc[2])
+        out.dup_events = int(fc[3])
+        out.fault_events = int(fc.sum())
+        return out
+
+    def _fault_block(self) -> dict:
+        """The schema-pinned ``faults`` telemetry block (STATUS.json /
+        level records — docs/scenarios.md): cumulative fault-event
+        counts by family for the current run."""
+        fc = self._fault_counts
+        return {"partition_events": int(fc[0]),
+                "crash_events": int(fc[1]),
+                "drop_events": int(fc[2]),
+                "dup_events": int(fc[3]),
+                "fault_events": int(fc.sum())}
+
     def _msg_step_raw(self, row: jnp.ndarray, net_slot: jnp.ndarray):
         """Handler half of a message step (no network merge): ONE state
         row + net slot -> (nodes', sends, timers', exc, ok, t_over).
@@ -1325,6 +1557,8 @@ class TensorSearch:
         ok = msg[0] != SENTINEL
         if p.deliver_message is not None:
             ok = ok & p.deliver_message(msg)
+        if p.fault is not None:
+            ok = ok & self._fault_msg_ok(nodes, msg)
         nodes2, sends, new_t, exc = _normalize_step(
             p.step_message(nodes, msg))
         timers2, t_over = append_timers(timers, new_t)
@@ -1344,6 +1578,10 @@ class TensorSearch:
         ok = jnp.sum(timer_deliverable_mask(queue) * s_oh) > 0
         if p.deliver_timer is not None:
             ok = ok & p.deliver_timer(t_node)
+        if p.fault is not None and p.fault.n_crashable:
+            # A down node's timers are masked, not cleared — they fire
+            # only after restart (a recovered node's stale timers).
+            ok = ok & (jnp.sum(n_oh * self._fault_down_vec(nodes)) == 0)
         timer = jnp.sum(s_oh[:, None] * queue, axis=0)
         nodes2, sends, new_t, exc = _normalize_step(
             p.step_timer(nodes, t_node, timer))
@@ -1435,7 +1673,15 @@ class TensorSearch:
         is_msg = event_idx < p.net_cap
         m = self._msg_step(row, event_idx)
         t = self._tmr_step(row, jnp.maximum(event_idx - p.net_cap, 0))
-        return jax.tree.map(lambda a, b: jnp.where(is_msg, a, b), m, t)
+        out = jax.tree.map(lambda a, b: jnp.where(is_msg, a, b), m, t)
+        if p.fault is not None and self._ev_flt:
+            tgrid = p.n_nodes * p.timer_cap
+            is_flt = event_idx >= p.net_cap + tgrid
+            f = self._flt_step(
+                row, jnp.maximum(event_idx - p.net_cap - tgrid, 0))
+            out = jax.tree.map(
+                lambda a, b: jnp.where(is_flt, b, a), out, f)
+        return out
 
     @staticmethod
     def _compact_ids(valid_ev: jnp.ndarray, budget: int, offset=0):
@@ -1482,15 +1728,20 @@ class TensorSearch:
     def _event_tables(self, chunk_rows: jnp.ndarray,
                       chunk_valid: jnp.ndarray, ev_pass=0, masks=None):
         """[C, lanes] chunk -> (msg_ids [C, Bm] net-slot indices, tmr_ids
-        [C, Bt] timer grid indices, ev_remaining): each state's VALID
-        events (occupied network rows + deliverable timers, masked by the
-        protocol's deliver_* settings — exactly the predicates the step
-        kinds re-check) packed into per-kind pair slots.  ``ev_pass``
-        selects the budget WINDOW (pass w covers valid-event ranks
-        [w*budget, (w+1)*budget) of each kind); ``ev_remaining`` counts
-        valid events past the current window — spill drivers re-step the
-        chunk at the next window until it reaches zero, so a finite
-        budget never truncates coverage."""
+        [C, Bt] timer grid indices, flt_ids [C, Bf] fault-segment
+        indices (``None`` when no fault model), ev_remaining): each
+        state's VALID events (occupied network rows + deliverable
+        timers, masked by the protocol's deliver_* settings AND the
+        fault deliverability mask — exactly the predicates the step
+        kinds re-check — plus enabled fault events) packed into
+        per-kind pair slots.  ``ev_pass`` selects the budget WINDOW
+        (pass w covers valid-event ranks [w*budget, (w+1)*budget) of
+        each kind); ``ev_remaining`` counts valid events past the
+        current window — spill drivers re-step the chunk at the next
+        window until it reaches zero, so a finite budget never
+        truncates coverage.  The fault segment is never windowed
+        (budget = its full grid), so pass 0 covers it entirely and
+        later passes present an empty fault table."""
         p = self.p
         c = chunk_valid.shape[0]
         chunk_state = self.unflatten_rows(chunk_rows)
@@ -1513,13 +1764,43 @@ class TensorSearch:
             dt = jax.vmap(lambda nd: p.deliver_timer_rt(nd, tarr))(
                 jnp.arange(p.n_nodes))
             tmask = tmask & dt[None, :, None]
+        flt_ids = None
+        if p.fault is not None:
+            fl = p.fault
+            nodesC = chunk_state["nodes"]
+            nid = jnp.arange(fl.n_nodes)
+            net = chunk_state["net"]
+            if fl.has_partition:
+                # Cross-block messages are blocked while the cut is up
+                # (block ids resolved by one-hot over the static table;
+                # -1 = unpartitioned node, never blocked).
+                blk = jnp.asarray(fl.block_id)
+                bf_ = jnp.sum((net[:, :, 1, None] == nid) * blk, axis=2)
+                bt_ = jnp.sum((net[:, :, 2, None] == nid) * blk, axis=2)
+                cross = (bf_ >= 0) & (bt_ >= 0) & (bf_ != bt_)
+                pcut = nodesC[:, fl.pcut_off] > 0
+                msg_ok = msg_ok & ~(pcut[:, None] & cross)
+            if fl.n_crashable:
+                z = jnp.zeros((c,), jnp.int32)
+                down = jnp.stack(
+                    [nodesC[:, int(off)] if int(off) >= 0 else z
+                     for off in fl.down_off], axis=1)     # [C, NN]
+                dest_down = jnp.sum(
+                    (net[:, :, 2, None] == nid) * down[:, None, :],
+                    axis=2)
+                msg_ok = msg_ok & (dest_down == 0)
+                tmask = tmask & (down == 0)[:, :, None]
+            flt_ids, _f_rem = self._compact_ids(
+                self._fault_event_grid(chunk_state)
+                & chunk_valid[:, None], self._ev_flt,
+                ev_pass * self._ev_flt)
         msg_ids, m_rem = self._compact_ids(
             msg_ok & chunk_valid[:, None], self._ev_msg,
             ev_pass * self._ev_msg)
         tmr_ids, t_rem = self._compact_ids(
             tmask.reshape(c, -1) & chunk_valid[:, None], self._ev_tmr,
             ev_pass * self._ev_tmr)
-        return msg_ids, tmr_ids, m_rem + t_rem
+        return msg_ids, tmr_ids, flt_ids, m_rem + t_rem
 
     def _expand_chunk(self, chunk_rows: jnp.ndarray,
                       chunk_valid: jnp.ndarray, ev_pass=0, masks=None,
@@ -1536,6 +1817,8 @@ class TensorSearch:
         arithmetic run()/_reconstruct and the sharded driver use)."""
         p = self.p
         bm, bt = self._ev_msg, self._ev_tmr
+        bf = self._ev_flt
+        has_flt = p.fault is not None and bf > 0
         c = chunk_valid.shape[0]
         # Dev bisect hook (tools/profile_sharded2.py): expand-internal
         # stages.  Each truncation returns dummy outputs whose shapes
@@ -1544,7 +1827,7 @@ class TensorSearch:
         stop = getattr(self, "_stop_after", None)
 
         def _cut(*live):
-            b = bm + bt
+            b = bm + bt + bf
             acc = jnp.int32(0)
             for x in live:
                 acc = acc + jnp.sum(x).astype(jnp.int32)
@@ -1559,9 +1842,8 @@ class TensorSearch:
                                          ("prune", p.prunes))
                      for name in preds})
 
-        msg_ids, tmr_ids, ev_drops = self._event_tables(chunk_rows,
-                                                        chunk_valid,
-                                                        ev_pass, masks)
+        msg_ids, tmr_ids, flt_ids, ev_drops = self._event_tables(
+            chunk_rows, chunk_valid, ev_pass, masks)
         if stop == "events":
             return _cut(msg_ids, tmr_ids)
         # TWO flat vmaps — one per event kind, each running only its own
@@ -1595,21 +1877,41 @@ class TensorSearch:
         val_t = ok_t & (tmr_ids >= 0).reshape(-1)
         if stop == "tail":
             return _cut(rows_m, rows_t)
+        # Fault segment (ISSUE 19): no handlers, no sends — _flt_step
+        # returns full successor rows directly, so the pairs skip the
+        # batched merge tail entirely.
+        if has_flt:
+            rep_f = jnp.repeat(chunk_rows, bf, axis=0)
+            rows_f, ok_f, over_f = jax.vmap(self._flt_step)(
+                rep_f, jnp.maximum(flt_ids, 0).reshape(-1))
+            val_f = ok_f & (flt_ids >= 0).reshape(-1)
 
-        def _inter(a, b):
+        widths = [bm, bt] + ([bf] if has_flt else [])
+
+        def _inter(*parts):
             return jnp.concatenate(
-                [a.reshape((c, bm) + a.shape[1:]),
-                 b.reshape((c, bt) + b.shape[1:])],
-                axis=1).reshape((c * (bm + bt),) + a.shape[1:])
+                [x.reshape((c, w) + x.shape[1:])
+                 for x, w in zip(parts, widths)],
+                axis=1).reshape((c * sum(widths),) + parts[0].shape[1:])
 
-        rows = _inter(rows_m, rows_t)
-        valids = _inter(val_m, val_t)
-        overs = _inter(over_m, over_t)
+        if has_flt:
+            rows = _inter(rows_m, rows_t, rows_f)
+            valids = _inter(val_m, val_t, val_f)
+            overs = _inter(over_m, over_t, over_f)
+        else:
+            rows = _inter(rows_m, rows_t)
+            valids = _inter(val_m, val_t)
+            overs = _inter(over_m, over_t)
         # Grid event ids for trace spills: timer table entries are
-        # net_cap + t_idx in the flat grid numbering.
-        event_ids = jnp.concatenate(
-            [msg_ids, jnp.where(tmr_ids >= 0, p.net_cap + tmr_ids, -1)],
-            axis=1)                                        # [C, Bm+Bt]
+        # net_cap + t_idx in the flat grid numbering; fault entries
+        # follow at net_cap + NN*T_CAP + f_idx.
+        ev_segs = [msg_ids,
+                   jnp.where(tmr_ids >= 0, p.net_cap + tmr_ids, -1)]
+        if has_flt:
+            tgrid = p.n_nodes * p.timer_cap
+            ev_segs.append(jnp.where(flt_ids >= 0,
+                                     p.net_cap + tgrid + flt_ids, -1))
+        event_ids = jnp.concatenate(ev_segs, axis=1)       # [C, B]
         overflow = jnp.sum(overs * valids.astype(jnp.int32))
         # Symmetry hash step (ISSUE 15b): fingerprints — and through
         # them the sharded owner-hash — key on the canonical orbit
@@ -1808,6 +2110,7 @@ class TensorSearch:
                                    resume=resume)
             eng = "device"
         self._stamp_capacity(out)
+        self._stamp_faults(out)
         if tel is not None:
             # Trace stamp at span emission (ISSUE 13): the verdict
             # carries the recorder's causal-trace identity — a host
@@ -1841,6 +2144,7 @@ class TensorSearch:
                 "checkpoint); rerun without record_trace")
         self._levels = []
         self._host_prev_explored = 0
+        self._fault_counts[:] = 0
         if ck is not None:
             # Resume at the checkpointed level boundary: the visited SET
             # comes back from the dumped 128-bit keys, the frontier from
@@ -1934,6 +2238,8 @@ class TensorSearch:
                         np.asarray(event_ids))
                 np_valids = np.asarray(valids)
                 explored += int(np_valids.sum())
+                if self.p.fault is not None:
+                    self._accum_fault_counts(event_ids, np_valids)
                 np_exc = np.asarray(rows_d[:, -1])
                 out = self._terminal_outcome(
                     rows_d, np_valids, np_exc, flags, explored,
@@ -2000,7 +2306,7 @@ class TensorSearch:
                 delta = [explored - getattr(self, "_host_prev_explored",
                                             0)]
                 self._host_prev_explored = explored
-                tel.on_level("host", {
+                lvl_rec = {
                     "depth": depth,
                     "wall": round(time.time() - t_lvl, 4),
                     "explored": explored,
@@ -2010,7 +2316,10 @@ class TensorSearch:
                         "explored": delta,
                         "frontier": [int(len(keep_idx))],
                         "load_factor": [0.0], "drops": [0]},
-                    "skew": {"explored": tel_mod.skew_metrics(delta)}})
+                    "skew": {"explored": tel_mod.skew_metrics(delta)}}
+                if self.p.fault is not None:
+                    lvl_rec["faults"] = self._fault_block()
+                tel.on_level("host", lvl_rec)
             # lvl_states rows align 1:1 with h1/h2/rows concatenation.
             all_rows = (np.concatenate(lvl_states, axis=0)
                         if len(lvl_states) > 1 else lvl_states[0])
@@ -2161,6 +2470,14 @@ class TensorSearch:
                 "flag_cnt": carry["flag_cnt"] + cnts,
                 "flag_rows": flag_rows,
             }
+            has_flt = p.fault is not None and self._ev_flt > 0
+            if has_flt:
+                # Fault-family event counters (ISSUE 19): cumulative
+                # like "explored", computed from the event-id table the
+                # fault-free program discards — no extra readback, one
+                # extra stats lane per family.
+                out["fault_cnt"] = carry["fault_cnt"] \
+                    + self._fault_chunk_counts(_event_ids, valids)
             if spill_on:
                 tbl_full = jnp.any(unresolved)
                 front_full = (nxt_n + jnp.sum(sel).astype(jnp.int32)
@@ -2170,22 +2487,25 @@ class TensorSearch:
                         + 2 * tbl_full.astype(jnp.int32))
                 for k in ("j", "evp", "nxt", "nxt_n", "visited",
                           "vis_n", "explored", "overflow", "vis_over",
-                          "flag_cnt", "flag_rows"):
+                          "flag_cnt", "flag_rows") \
+                        + (("fault_cnt",) if has_flt else ()):
                     out[k] = jnp.where(abort, carry[k], out[k])
                 out["f_drop"] = jnp.where(abort, code[None],
                                           out["f_drop"])
             # The per-wave scalar stats ride along with every step (the
             # ONLY recurring device->host transfer of the device loop:
             # [explored, overflow, vis_over, f_drop, vis_n, nxt_n, j] ++
-            # flag counts) — computed in-program so the sync needs no
-            # separate dispatch, and only the LAST chunk's vector of a
-            # wave is actually pulled to the host.
+            # flag counts ++ (fault model only) fault-family counts) —
+            # computed in-program so the sync needs no separate
+            # dispatch, and only the LAST chunk's vector of a wave is
+            # actually pulled to the host.
             stats = jnp.concatenate([
                 jnp.asarray([out["explored"][0], out["overflow"][0],
                              out["vis_over"][0], out["f_drop"][0],
                              out["vis_n"][0], out["nxt_n"][0],
                              out["j"][0]], jnp.int32),
-                out["flag_cnt"].astype(jnp.int32)])
+                out["flag_cnt"].astype(jnp.int32)]
+                + ([out["fault_cnt"]] if has_flt else []))
             return out, stats
 
         return step
@@ -2224,7 +2544,7 @@ class TensorSearch:
             row0s = self._pack_rows(row0)
             table, _, _ = visited_mod.insert(
                 visited_mod.empty_table(V), fp0, jnp.ones((1,), bool))
-            return {
+            out = {
                 "cur": jnp.zeros((cap, plane), jnp.int32).at[0].set(
                     row0s[0]),
                 "cur_n": jnp.ones((1,), jnp.int32),
@@ -2241,6 +2561,9 @@ class TensorSearch:
                 "flag_cnt": jnp.zeros((nf,), jnp.int32),
                 "flag_rows": jnp.zeros((nf, lanes), jnp.int32),
             }
+            if self.p.fault is not None and self._ev_flt > 0:
+                out["fault_cnt"] = jnp.zeros((4,), jnp.int32)
+            return out
 
         return build
 
@@ -2301,6 +2624,7 @@ class TensorSearch:
         state = (jax.tree.map(jnp.asarray, initial) if initial is not None
                  else self.initial_state())
         self._trace_root = jax.tree.map(np.asarray, state)
+        self._fault_counts[:] = 0
         ck = self._load_ckpt() if resume else None
         if ck is not None:
             t0 = time.time() - ck.elapsed
@@ -2374,7 +2698,7 @@ class TensorSearch:
                 f"the checkpoint's visited set ({n_unres} of "
                 f"{len(ck.visited_keys)} keys unresolved); raise "
                 "visited_cap")
-        return {
+        carry = {
             "cur": jnp.asarray(cur),
             "cur_n": jnp.asarray([n], jnp.int32),
             "j": jnp.zeros((1,), jnp.int32),
@@ -2390,6 +2714,11 @@ class TensorSearch:
             "flag_cnt": jnp.zeros((nf,), jnp.int32),
             "flag_rows": jnp.zeros((nf, lanes), jnp.int32),
         }
+        if self.p.fault is not None and self._ev_flt > 0:
+            # Fault counters are per-PROCESS accounting (like retries):
+            # a resumed run counts fault events from the resume point.
+            carry["fault_cnt"] = jnp.zeros((4,), jnp.int32)
+        return carry
 
     def _write_dev_ckpt(self, carry, depth: int, explored: int,
                         vis_over: int, nxt_n: int,
@@ -2503,7 +2832,12 @@ class TensorSearch:
                 s = self._dispatch("device.sync", device_get, wave_stats)
             (explored, overflow, vis_over, f_drop, vis_n,
              nxt_n) = (int(x) for x in s[:6])
-            flag_counts = np.asarray(s[7:])
+            nf = len(self._flag_names)
+            flag_counts = np.asarray(s[7:7 + nf])
+            if self.p.fault is not None and self._ev_flt > 0:
+                # Cumulative from the carry — overwrite, never add.
+                self._fault_counts[:] = np.asarray(
+                    s[7 + nf:7 + nf + 4])
             if overflow:
                 raise CapacityOverflow(
                     f"{p.name}: net_cap={p.net_cap}, timer_cap="
@@ -2549,7 +2883,7 @@ class TensorSearch:
                 # engine but keep the mesh-scope record shape uniform
                 # (report heatmap / STATUS.json / skew gauges).
                 delta = [explored - prev_explored]
-                tel.on_level("device", {
+                lvl_rec = {
                     "depth": depth,
                     "wall": round(time.time() - t_wave, 4),
                     "explored": explored, "unique": vis_n,
@@ -2560,7 +2894,10 @@ class TensorSearch:
                         "load_factor": [round(vis_n / self.visited_cap,
                                               4)],
                         "drops": [0]},
-                    "skew": {"explored": tel_mod.skew_metrics(delta)}})
+                    "skew": {"explored": tel_mod.skew_metrics(delta)}}
+                if self.p.fault is not None:
+                    lvl_rec["faults"] = self._fault_block()
+                tel.on_level("device", lvl_rec)
             self._last_dev_carry = carry
             if flag_counts.any():
                 return self._dev_terminal(carry, flag_counts, explored,
@@ -2865,6 +3202,8 @@ class TensorSearch:
             "flag_cnt": jnp.zeros((nf,), jnp.int32),
             "flag_rows": jnp.zeros((nf, lanes), jnp.int32),
         }
+        if self.p.fault is not None and self._ev_flt > 0:
+            carry["fault_cnt"] = jnp.zeros((4,), jnp.int32)
         seg = sp.spool_cur.pop()
         return self._spill_inject(carry, seg, cap)
 
@@ -2926,7 +3265,11 @@ class TensorSearch:
                 carry, s = self._spill_wave(carry, step, rt, cap, n_cur)
                 explored, overflow = int(s[0]), int(s[1])
                 vis_over, vis_n, nxt_n = int(s[2]), int(s[4]), int(s[5])
-                flag_counts = np.asarray(s[7:])
+                nf = len(self._flag_names)
+                flag_counts = np.asarray(s[7:7 + nf])
+                if self.p.fault is not None and self._ev_flt > 0:
+                    self._fault_counts[:] = np.asarray(
+                        s[7 + nf:7 + nf + 4])
                 if overflow:
                     raise CapacityOverflow(
                         f"{p.name}: net_cap={p.net_cap}, timer_cap="
@@ -2974,7 +3317,7 @@ class TensorSearch:
                 delta = [explored - getattr(self, "_spill_prev_explored",
                                             0)]
                 self._spill_prev_explored = explored
-                tel.on_level("device", {
+                lvl_rec = {
                     "depth": depth,
                     "wall": round(time.time() - t_lvl, 4),
                     "explored": explored, "unique": unique,
@@ -2986,7 +3329,10 @@ class TensorSearch:
                         "load_factor": [round(vis_n / self.visited_cap,
                                               4)],
                         "drops": [0]},
-                    "skew": {"explored": tel_mod.skew_metrics(delta)}})
+                    "skew": {"explored": tel_mod.skew_metrics(delta)}}
+                if self.p.fault is not None:
+                    lvl_rec["faults"] = self._fault_block()
+                tel.on_level("device", lvl_rec)
             # ---- level boundary.  Fast path until the tier/spool is
             # live: the plain on-device promote.
             if not (sp.active

@@ -26,7 +26,7 @@ from dslabs_tpu.tpu.compiler import (Field, MessageType, NodeKind,
                                      ProtocolSpec, TimerType)
 
 __all__ = ["pingpong_spec", "clientserver_spec", "pb_spec",
-           "paxos_spec"]
+           "paxos_spec", "paxos_partition_spec", "pb_crash_spec"]
 
 
 def pingpong_spec(workload_size: int = 2,
@@ -149,7 +149,8 @@ def clientserver_spec(n_clients: int = 1, w: int = 1) -> ProtocolSpec:
     return spec
 
 
-def pb_spec(ns: int = 2, n_clients: int = 1, w: int = 1) -> ProtocolSpec:
+def pb_spec(ns: int = 2, n_clients: int = 1, w: int = 1,
+            fault=None) -> ProtocolSpec:
     """Lab 2 primary-backup: ViewServer + PBServers + clients — the
     first STATEFUL multi-role protocol through the compiler (round-4
     verdict item 7: "a new protocol becomes searchable without
@@ -213,7 +214,7 @@ def pb_spec(ns: int = 2, n_clients: int = 1, w: int = 1) -> ProtocolSpec:
                 TimerType("PING", (), 25, 25),
                 TimerType("CLIENT", ("s",), 100, 100,
                           bounds={"s": seq})],
-        net_cap=32, timer_cap=4)
+        net_cap=32, timer_cap=4, fault=fault)
 
     # ------------------------------------------------ ViewServer helpers
 
@@ -458,7 +459,8 @@ def pb_spec(ns: int = 2, n_clients: int = 1, w: int = 1) -> ProtocolSpec:
 
 
 def paxos_spec(n_acceptors: int = 3, quorum: int = 0,
-               never_decided: bool = False) -> ProtocolSpec:
+               never_decided: bool = False,
+               fault=None) -> ProtocolSpec:
     """Single-decree Paxos (one ballot, one proposer, ``n_acceptors``
     INTERCHANGEABLE acceptors) — the symmetry-reduction flagship
     (ISSUE 15, tpu/symmetry.py): the acceptors are declared a
@@ -498,7 +500,7 @@ def paxos_spec(n_acceptors: int = 3, quorum: int = 0,
                   MessageType("ACCEPTED", ())],
         timers=[],
         net_cap=4 * NA + 2, timer_cap=2,
-        symmetry=("acceptor",))
+        symmetry=("acceptor",), fault=fault)
 
     @spec.on("acceptor", "PREPARE")
     def acc_prepare(ctx, m):
@@ -546,4 +548,70 @@ def paxos_spec(n_acceptors: int = 3, quorum: int = 0,
         spec.invariants["NONE_DECIDED"] = none_decided
     else:
         spec.goals["DECIDED"] = decided
+    return spec
+
+
+def paxos_partition_spec(n_acceptors: int = 3,
+                         broken: bool = False) -> ProtocolSpec:
+    """Single-decree Paxos under a checkable partition scenario
+    (ISSUE 19 acceptance workload): the proposer and the acceptors sit
+    in separate partition blocks, and the fault controller may CUT the
+    link between them once (``max_eras=1``) and HEAL it again — the
+    search explores every interleaving of the cut with the protocol's
+    own messages.
+
+    Two modes share one invariant, DECIDE_HAS_QUORUM (``dec == 1``
+    implies a true majority of ACCEPTED bits):
+
+    * ``broken=False`` — honest majority quorum.  The invariant holds
+      on every reachable state: a decision needs ``NA//2+1`` ACCEPTED
+      messages through the (possibly cut-then-healed) link, and each
+      carries a real acceptor bit.  Exhaustive search (goal pruned to
+      a prune by the scenario tests) proves safety with exact counts.
+
+    * ``broken=True`` — quorum deliberately lowered to 1 AND the
+      partition starts cut (``initial_cut=True``): the initial
+      PREPAREs are frozen in flight until the controller fires HEAL,
+      so every path to the (unsafe, single-vote) decision contains the
+      HEAL fault event — the violation witness must name it.  The
+      DECIDED goal is removed so the search runs to the violation."""
+    from dslabs_tpu.tpu.faults import FaultModel, Partition
+
+    NA = n_acceptors
+    maj = NA // 2 + 1
+    fm = FaultModel(partition=Partition(
+        blocks=(("proposer",), ("acceptor",)),
+        max_eras=1, initial_cut=broken))
+    spec = paxos_spec(n_acceptors=NA, quorum=1 if broken else 0,
+                      fault=fm)
+    spec.name = "paxos-part-broken" if broken else "paxos-part"
+    if broken:
+        del spec.goals["DECIDED"]
+
+    def decide_has_quorum(v):
+        import jax.numpy as jnp
+
+        return ((v.get("proposer", 0, "dec") == 0)
+                | (jnp.sum(v.get("proposer", 0, "accs")) >= maj))
+
+    spec.invariants["DECIDE_HAS_QUORUM"] = decide_has_quorum
+    return spec
+
+
+def pb_crash_spec(ns: int = 2, n_clients: int = 1,
+                  w: int = 1) -> ProtocolSpec:
+    """Primary-backup under a crash-recovery scenario (ISSUE 19): any
+    server may crash once and restart.  The per-client ``amo``
+    (at-most-once) table is declared DURABLE — it survives the crash —
+    while the rest of the server state (view number, sync/primary
+    bits, pending op) is volatile and resets to field inits on
+    restart, forcing re-sync through the view service.  The protocol
+    observes the crash only as message loss and timer silence; the
+    exactly-once obligation must hold across it."""
+    from dslabs_tpu.tpu.faults import Crash, FaultModel
+
+    fm = FaultModel(crash=Crash(durable={"server": ("amo",)},
+                                max_crashes=1))
+    spec = pb_spec(ns=ns, n_clients=n_clients, w=w, fault=fm)
+    spec.name = "pb-crash"
     return spec

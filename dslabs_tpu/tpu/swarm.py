@@ -500,11 +500,15 @@ class SwarmSearch(TensorSearch):
         def walk(c, masks=None):
             rows, depths, hists = c["rows"], c["depths"], c["hists"]
             key, sub, sub2 = jax.random.split(c["key"][0], 3)
-            msg_ids, tmr_ids, _rem = self._event_tables(
+            msg_ids, tmr_ids, flt_ids, _rem = self._event_tables(
                 rows, jnp.ones((K,), bool), masks=masks)
-            ids = jnp.concatenate(
-                [msg_ids, jnp.where(tmr_ids >= 0, tmr_ids + p.net_cap,
-                                    -1)], axis=1)            # [K, B]
+            segs = [msg_ids,
+                    jnp.where(tmr_ids >= 0, tmr_ids + p.net_cap, -1)]
+            if flt_ids is not None:
+                tgrid = p.n_nodes * p.timer_cap
+                segs.append(jnp.where(
+                    flt_ids >= 0, flt_ids + p.net_cap + tgrid, -1))
+            ids = jnp.concatenate(segs, axis=1)              # [K, B]
             ok = ids >= 0
             # Diversified pick: kind-affinity bias over valid events,
             # scaled by each walker's temperature (cold = committed to

@@ -853,6 +853,13 @@ class Telemetry:
                 # shows how much of the spill detour is hidden behind
                 # device compute.
                 self._status["drain"] = record["spill"]
+            if record.get("faults") is not None:
+                # Fault-scenario block (ISSUE 19): cumulative fault
+                # events by family, schema-pinned so `telemetry watch`
+                # shows how much of the run is fault interleavings.
+                self._status["faults"] = record["faults"]
+                for k, v in record["faults"].items():
+                    self.registry.gauge(f"faults.{k}").set(int(v))
             self._status.update({
                 "engine": engine,
                 "depth": record.get("depth", 0),
@@ -897,7 +904,9 @@ class Telemetry:
         "dropped", "spilled_keys", "host_tier_hits",
         "respilled_frontier", "walker_restarts", "swarm_overflow",
         "child_restarts", "killed_dispatches", "abandoned_threads",
-        "mesh_width", "mesh_shrinks", "knob_retries")
+        "mesh_width", "mesh_shrinks", "knob_retries",
+        "fault_events", "partition_events", "crash_events",
+        "drop_events", "dup_events")
 
     def on_outcome(self, out, engine: Optional[str] = None) -> None:
         """Ingest a SearchOutcome's accounting: one ``outcome`` record
@@ -941,6 +950,18 @@ class Telemetry:
                     self.registry.gauge(
                         "capacity.symmetry_perms").set(
                         cap_block["symmetry_perms"])
+            if int(getattr(out, "fault_events", 0) or 0):
+                # Fault-scenario block (ISSUE 19): same schema as the
+                # engines' per-level ``faults`` record.
+                flt_block = {
+                    k: int(getattr(out, k, 0) or 0)
+                    for k in ("partition_events", "crash_events",
+                              "drop_events", "dup_events",
+                              "fault_events")}
+                rec["faults"] = flt_block
+                self._status["faults"] = flt_block
+                for k, v in flt_block.items():
+                    self.registry.gauge(f"faults.{k}").set(v)
             self._write(rec)
             self.events.append(rec)
             self._status["end_condition"] = out.end_condition
@@ -1089,6 +1110,9 @@ def build_report(records: List[dict]) -> dict:
     # symmetry block, plus the summed per-level drain-overlap walls.
     capacity = next((o["capacity"] for o in reversed(outcomes)
                      if o.get("capacity")), None)
+    # Fault scenarios (ISSUE 19): the last outcome's fault-family block.
+    faults = next((o["faults"] for o in reversed(outcomes)
+                   if o.get("faults")), None)
     drain = {}
     for lv in levels:
         sp = lv.get("spill")
@@ -1102,7 +1126,8 @@ def build_report(records: List[dict]) -> dict:
             "sites": {t: h.snapshot() for t, h in sites.items()},
             "series": series, "timeline": timeline,
             "outcomes": outcomes, "counts": counts,
-            "capacity": capacity, "drain": drain or None,
+            "capacity": capacity, "faults": faults,
+            "drain": drain or None,
             "total_wall": round(total_wall, 3),
             "compile_wall": round(compile_wall, 3),
             "in_flight": open_dispatch}
@@ -1212,6 +1237,9 @@ def render_report(report: dict, source: str = "") -> str:
     if report.get("capacity"):
         out.append("capacity: " + " ".join(
             f"{k}={v}" for k, v in sorted(report["capacity"].items())))
+    if report.get("faults"):
+        out.append("faults: " + " ".join(
+            f"{k}={v}" for k, v in sorted(report["faults"].items())))
     if report.get("drain"):
         out.append("drain overlap: " + " ".join(
             f"{k}={v}" for k, v in sorted(report["drain"].items())))
@@ -1370,6 +1398,9 @@ def render_watch(path: str, now: Optional[float] = None) -> str:
         if st.get("capacity"):
             out.append("capacity: " + " ".join(
                 f"{k}={v}" for k, v in sorted(st["capacity"].items())))
+        if st.get("faults"):
+            out.append("faults: " + " ".join(
+                f"{k}={v}" for k, v in sorted(st["faults"].items())))
         if st.get("rung"):
             out.append("rung: " + " ".join(
                 f"{k}={v}" for k, v in sorted(st["rung"].items())))
@@ -1442,7 +1473,7 @@ def read_ledger(path: str) -> List[dict]:
 # JSON's top-level value — the number the BENCH_r0N trajectory tracks).
 _LEDGER_PHASES = ("headline", "mesh", "strict", "beam", "swarm",
                   "spill", "capacity2", "service", "lanes", "memo",
-                  "cpu_fallback")
+                  "scenarios", "cpu_fallback")
 
 # Resilience counters the ledger tracks beside the rates (ISSUE 9):
 # a bench run that suddenly needs mesh shrinks / knob re-levels /
@@ -1862,6 +1893,31 @@ def compare_ledger(records: List[dict],
         cmp["mesh"]["imbalance_max"] = entry
         if lv > best * (1.0 + threshold):
             cmp["regressions"].append(entry)
+    # Fault-scenario parity guard (ISSUE 19, bench --scenarios):
+    # verdict_parity is BINARY — 1 means the zero-budget FaultModel
+    # landed the exact fault-free verdict/explored/unique on both
+    # engines (the overhead-guard invariant scenarios ride on); 0 is a
+    # soundness break, flagged regardless of threshold or priors.
+    cmp["scenarios"] = {}
+
+    def _parity(rec):
+        s = rec.get("scenarios")
+        if not isinstance(s, dict) or "verdict_parity" not in s:
+            return None
+        try:
+            return int(s["verdict_parity"])
+        except (TypeError, ValueError):
+            return None
+
+    lv = _parity(latest)
+    priors_p = [v for v in (_parity(r) for r in prior) if v is not None]
+    if lv is not None:
+        best = max(priors_p) if priors_p else 1
+        entry = {"phase": "scenarios:verdict_parity", "latest": lv,
+                 "best_prior": best, "delta_pct": 0.0}
+        cmp["scenarios"]["verdict_parity"] = entry
+        if lv < 1:
+            cmp["regressions"].append(entry)
     return cmp
 
 
@@ -1917,6 +1973,9 @@ def render_compare(cmp: dict, source: str = "") -> str:
         out.append(f"mesh {c:20s} latest={e['latest']} "
                    f"prior_best={e['best_prior']} "
                    f"({e['delta_pct']:+.1f}%)")
+    for c, e in sorted(cmp.get("scenarios", {}).items()):
+        out.append(f"scenarios {c:15s} latest={e['latest']} "
+                   f"prior_best={e['best_prior']}")
     for e in cmp["regressions"]:
         out.append(f"REGRESSION: phase={e['phase']} "
                    f"latest={e['latest']} vs best={e['best_prior']} "

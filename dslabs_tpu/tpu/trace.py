@@ -92,16 +92,23 @@ def decode_trace(search: TensorSearch,
         jax.tree.map(jax.numpy.asarray, root)))[0]
     step = jax.jit(search._step_one)
     records: List[Tuple[str, tuple]] = []
+    tgrid = p.n_nodes * p.timer_cap
     for ev in outcome.trace:
         state = search._slice_state(row)       # numpy views
         if ev < p.net_cap:
             rec = np.asarray(state["net"][ev]).copy()
             records.append(("message", (rec,)))
-        else:
+        elif ev < p.net_cap + tgrid:
             t_idx = ev - p.net_cap
             node, slot = t_idx // p.timer_cap, t_idx % p.timer_cap
             rec = np.asarray(state["timers"][node, slot]).copy()
             records.append(("timer", (node, rec)))
+        else:
+            # Fault-segment event (ISSUE 19): record the controller's
+            # human-readable label (CUT / HEAL / CRASH(kind[i]) / ...)
+            # so witness traces NAME the fault that enabled them.
+            f_idx = ev - p.net_cap - tgrid
+            records.append(("fault", (p.fault.event_label(f_idx),)))
         succ_row, valid, _ = step(jax.numpy.asarray(row),
                                   jax.numpy.asarray(ev))
         assert bool(valid), (
@@ -121,6 +128,15 @@ def replay_on_object(search: TensorSearch, outcome: SearchOutcome,
         raise ValueError(f"{p.name}: protocol has no object-twin decoders")
     state = initial_object_state
     for kind, payload in decode_trace(search, outcome):
+        if kind == "fault":
+            # The object twin has no fault controller — a scenario
+            # witness replays in tensor space only (decode_trace's
+            # per-step validity asserts are the replay verification).
+            raise NotImplementedError(
+                f"{p.name}: trace contains fault event "
+                f"{payload[0]!r}; object-twin replay does not model "
+                "fault scenarios — verify the witness with "
+                "decode_trace instead")
         if kind == "message":
             frm, to, msg = p.decode_message(payload[0])
             if isinstance(msg, MessageTemplate):

@@ -118,7 +118,9 @@ _SCALAR_FIELDS = (
     "resumed_from_depth", "engine", "levels", "compile_secs",
     "child_restarts", "killed_dispatches", "abandoned_threads",
     "mesh_width", "mesh_shrinks", "knob_retries", "trace_id",
-    "lane", "lane_width", "lane_share")
+    "lane", "lane_width", "lane_share",
+    "fault_events", "partition_events", "crash_events",
+    "drop_events", "dup_events")
 
 
 def outcome_to_dict(out) -> dict:
