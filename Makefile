@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast lint analysis-smoke perf-smoke fault-smoke swarm-smoke capacity-smoke capacity2-smoke obs-smoke chaos-smoke service-smoke trace-smoke mesh-smoke lanes-smoke memo-smoke scenario-smoke lab0 lab1 lab2 lab3 lab4 bench dryrun handout clean
+.PHONY: test test-fast lint analysis-smoke perf-smoke fault-smoke swarm-smoke capacity-smoke capacity2-smoke obs-smoke chaos-smoke service-smoke trace-smoke mesh-smoke lanes-smoke memo-smoke scenario-smoke spec-smoke lab0 lab1 lab2 lab3 lab4 bench dryrun handout clean
 
 test:            ## full acceptance + parity suite
 	$(PY) -m pytest tests/ -q
@@ -225,6 +225,13 @@ memo-smoke:      ## cross-job memoization: verdict cache + warm start + incremen
 # field guide.
 scenario-smoke:  ## checkable fault scenarios: partition/crash/drop-dup model events + witness replay on CPU
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m scenario -p no:cacheprovider
+
+# spec-smoke = the replicated-protocol spec layer (ISSUE 20): the
+# generated lab3/lab4 twins vs the retired hand twins
+# (tests/fixtures/hand_twins/) as parity oracles, the slot/quorum
+# compile gates, and the packed slot-lane roundtrips.
+spec-smoke:      ## replicated-protocol spec layer: generated-vs-hand parity matrix + slot/quorum gates on CPU
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m spec -p no:cacheprovider
 
 dryrun:          ## multi-chip sharding dry run on a virtual CPU mesh
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

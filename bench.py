@@ -92,6 +92,7 @@ MESH_CAP_SECS = 150.0        # 8-device mesh headline phase (ISSUE 12)
 LANES_CAP_SECS = 150.0       # batched-job-lanes phase (ISSUE 14)
 MEMO_CAP_SECS = 150.0        # cross-job memoization phase (ISSUE 16)
 SCENARIOS_CAP_SECS = 120.0   # fault-scenario phase (ISSUE 19)
+LABS_CAP_SECS = 120.0        # generated-labs packing phase (ISSUE 20)
 # Parent backstop beyond the child's budget.  Generous on purpose: the
 # child's time checks are level-granular (a slow level can overrun
 # max_secs by ~30 s, sharded.py round-3 note), the strict child floors
@@ -212,7 +213,7 @@ def _hb(msg: str) -> None:
 def _bench_protocol():
     import dataclasses
 
-    from dslabs_tpu.tpu.protocols.paxos import make_paxos_protocol
+    from dslabs_tpu.tpu.specs_lab3 import make_paxos_protocol
 
     # Two clients widen the space enough to sustain large frontiers.
     # Goals are stripped: the bench measures sustained exploration
@@ -1235,6 +1236,78 @@ def _run_scenarios(budget_secs: float) -> dict:
     }
 
 
+def _run_labs(budget_secs: float) -> dict:
+    """Generated-labs packing phase (ISSUE 20, tpu/specs_lab3.py +
+    tpu/specs_lab4.py): the shipped lab3/lab4 protocols are COMPILED
+    from ProtocolSpec now, so their Field/Slots domain declarations
+    reach the bit-packer (tpu/packing.py) — the hand twins declared
+    nothing and derived identity.  Reports packed bytes-per-state for
+    each generated lab spec plus the summed ``bytes_per_state`` the
+    ledger's ``labs:bytes_per_state`` guard pins (a rise = domains
+    stopped reaching the packer), the minimum pack ratio across the
+    set (acceptance floor: >= 2x), and states/min on a short search of
+    the generated paxos spec as the phase value."""
+    import dataclasses
+
+    _persistent_cache()
+
+    from dslabs_tpu.tpu.engine import TensorSearch
+    from dslabs_tpu.tpu.packing import derive_packing
+    from dslabs_tpu.tpu.specs_lab3 import make_paxos_protocol
+    from dslabs_tpu.tpu.specs_lab4 import (make_join_protocol,
+                                           make_shardstore_multi_protocol,
+                                           make_shardstore_protocol,
+                                           make_shardstore_tx_protocol)
+
+    t_phase = time.time()
+    tel = _phase_telemetry("labs")
+    specs = [
+        ("lab3_paxos", make_paxos_protocol()),
+        ("lab4_join", make_join_protocol(1)),
+        ("lab4_shardstore", make_shardstore_protocol([1, 1])),
+        ("lab4_tx", make_shardstore_tx_protocol(1)),
+        ("lab4_multi", make_shardstore_multi_protocol()),
+    ]
+    per_lab, total_packed, total_raw, min_ratio = {}, 0, 0, None
+    for label, proto in specs:
+        _hb(f"labs: derive packing for {label} ({proto.name})")
+        eng = TensorSearch(dataclasses.replace(proto, goals={}),
+                           chunk=64)
+        pk = eng._pk or derive_packing(eng.p, eng.lanes)
+        per_lab[label] = {
+            "bytes_per_state": pk.bytes_per_state,
+            "bytes_per_state_unpacked": pk.bytes_per_state_unpacked,
+            "pack_ratio": round(pk.pack_ratio, 2),
+        }
+        total_packed += pk.bytes_per_state
+        total_raw += pk.bytes_per_state_unpacked
+        r = pk.pack_ratio
+        min_ratio = r if min_ratio is None else min(min_ratio, r)
+    _hb("labs: states/min on the generated paxos spec")
+    # Depth 6 keeps compile + two runs (warm-up, timed) inside the
+    # phase cap on the CPU fallback; the rate, not the space, is the
+    # phase value.
+    proto = dataclasses.replace(make_paxos_protocol(), goals={})
+    search = TensorSearch(proto, chunk=256, frontier_cap=1 << 12,
+                          visited_cap=1 << 16, max_depth=6,
+                          telemetry=tel)
+    search.run()              # warm-up: compile outside the window
+    t0 = time.time()
+    out = search.run()
+    dt = max(time.time() - t0, 1e-9)
+    return {
+        "value": round(out.states_explored / dt * 60.0, 1),
+        "bytes_per_state": total_packed,
+        "bytes_per_state_unpacked": total_raw,
+        "min_pack_ratio": round(min_ratio, 2),
+        "labs": per_lab,
+        "end": out.end_condition, "depth": out.depth,
+        "unique": out.unique_states, "explored": out.states_explored,
+        "total_secs": round(time.time() - t_phase, 1),
+        "telemetry": tel.summary(),
+    }
+
+
 # ----------------------------------------------------------------- parent
 
 _CURRENT_CHILD = None     # live phase Popen, killed by the signal handler
@@ -1615,6 +1688,13 @@ def main() -> None:
                 silence=PHASE_SILENCE_SECS)
             if scen_res is not None:
                 result["scenarios"] = scen_res
+        if _remaining() > 75:
+            labs_res, _labs_err = _sub(
+                ["--labs", str(min(90.0, _remaining() - 15))],
+                min(90.0, _remaining() - 10), "labs-cpu",
+                silence=PHASE_SILENCE_SECS)
+            if labs_res is not None:
+                result["labs"] = labs_res
         _emit(result)
         return
 
@@ -1808,6 +1888,22 @@ def main() -> None:
     else:
         result["scenarios_error"] = "skipped: deadline nearly exhausted"
 
+    # ---- phase 5.9: generated-labs packing (ISSUE 20) — packed
+    # bytes-per-state across the ProtocolSpec-compiled lab3/lab4
+    # protocols (the ``labs:bytes_per_state`` ledger guard) plus the
+    # >= 2x minimum pack-ratio floor.  Never the headline; skipped
+    # rather than raced near the deadline.
+    budget = min(LABS_CAP_SECS, _remaining() - KILL_SLACK_SECS - 10)
+    if budget > 45:
+        labs_res, labs_err = _sub(["--labs", str(budget)], budget,
+                                  "labs", silence=PHASE_SILENCE_SECS)
+        if labs_res is not None:
+            result["labs"] = labs_res
+        else:
+            result["labs_error"] = labs_err
+    else:
+        result["labs_error"] = "skipped: deadline nearly exhausted"
+
     # ---- phase 6: the soundness sanitizer (ISSUE 10) — findings per
     # leg + waived count off `python -m dslabs_tpu.analysis all` in a
     # CPU-pinned child (static: lowers, never compiles or dispatches).
@@ -1880,6 +1976,11 @@ if __name__ == "__main__":
         budget = (float(sys.argv[2]) if len(sys.argv) > 2
                   else SCENARIOS_CAP_SECS)
         print(json.dumps(_run_scenarios(budget)))
+        sys.exit(0)
+    if len(sys.argv) >= 2 and sys.argv[1] == "--labs":
+        budget = (float(sys.argv[2]) if len(sys.argv) > 2
+                  else LABS_CAP_SECS)
+        print(json.dumps(_run_labs(budget)))
         sys.exit(0)
     if len(sys.argv) >= 2 and sys.argv[1] == "--mesh":
         # The 8-wide mesh needs 8 devices SOMEWHERE: force the host

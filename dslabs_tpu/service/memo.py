@@ -241,10 +241,46 @@ def _field_init(f, acc: _HashAcc):
             else repr(f.init))
 
 
+def _slot_decls(spec, acc: _HashAcc) -> list:
+    """Slots declarations, fingerprinted from ``spec.slot_blocks`` —
+    the EXPANDED node fields carry each record field's lanes and
+    domain, but not the ``clear`` value ``slot_clear_upto`` writes or
+    the block's logical base, which are read off the declaration at
+    trace time.  A declaration missing the expected shape (a duck-typed
+    block from a partially-spec'd protocol) marks the fingerprint weak
+    so the store refuses to memoize on it."""
+    out = []
+    for (kind, _bn), b in sorted(getattr(spec, "slot_blocks", {}).items()):
+        try:
+            out.append([kind, b.name, b.n, b.base,
+                        [[sf.name, _field_init(sf, acc), sf.lo,
+                          repr(sf.hi), repr(sf.delta), sf.clear]
+                         for sf in b.fields]])
+        except AttributeError:
+            acc.weak = True
+            out.append([kind, repr(type(b))])
+    return out
+
+
+def _quorum_decls(spec, acc: _HashAcc) -> list:
+    """Quorum declarations: the threshold participates — ``ctx.quorum``
+    reads resolve through it, so "majority" -> 2 is a semantic change
+    invisible to handler ASTs."""
+    out = []
+    for q in getattr(spec, "quorums", ()) or ():
+        try:
+            out.append([q.name, q.over, repr(q.threshold)])
+        except AttributeError:
+            acc.weak = True
+            out.append([repr(type(q))])
+    return sorted(out)
+
+
 def _spec_base(spec, acc: Optional[_HashAcc] = None) -> dict:
     """The structure of a declarative spec MINUS its handlers and
-    display name: kinds, fields+domains, message/timer types, caps,
-    symmetry groups, initial events."""
+    display name: kinds, fields+domains, slot blocks, quorums, fragment
+    composition, message/timer types, caps, symmetry groups, initial
+    events."""
     acc = acc or _HashAcc()
     return {
         "fmt": MEMO_FORMAT, "kind": "spec",
@@ -252,6 +288,9 @@ def _spec_base(spec, acc: Optional[_HashAcc] = None) -> dict:
                    [[f.name, f.size, _field_init(f, acc), f.lo,
                      repr(f.hi), repr(getattr(f, "index_group", None))]
                     for f in k.fields]] for k in spec.nodes],
+        "slots": _slot_decls(spec, acc),
+        "quorums": _quorum_decls(spec, acc),
+        "fragments": sorted(list(getattr(spec, "fragments", []) or [])),
         "messages": [[m.name, list(m.fields),
                       sorted((k, list(v)) for k, v in
                              (m.bounds or {}).items())]

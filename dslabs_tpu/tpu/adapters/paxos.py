@@ -64,7 +64,7 @@ def _num_suffix(name: str, prefix: str) -> Optional[int]:
 class PaxosBinding(TwinBinding):
 
     def __init__(self, state):
-        from dslabs_tpu.tpu.protocols.paxos import paxos_layout
+        from dslabs_tpu.tpu.specs_lab3 import paxos_layout
 
         servers = sorted(state.servers,
                          key=lambda a: _num_suffix(str(a), "server") or 0)
@@ -113,7 +113,7 @@ class PaxosBinding(TwinBinding):
     def build_protocol(self, net_cap, timer_cap):
         import dataclasses
 
-        from dslabs_tpu.tpu.protocols.paxos import make_paxos_protocol
+        from dslabs_tpu.tpu.specs_lab3 import make_paxos_protocol
 
         p = make_paxos_protocol(n=self.n, n_clients=self.nc, w=self.w,
                                 max_slots=self.S, net_cap=net_cap,
@@ -146,7 +146,7 @@ class PaxosBinding(TwinBinding):
     def _decode_message(self, rec):
         from dslabs_tpu.labs.clientserver.amo import AMOResult
         from dslabs_tpu.labs.paxos import paxos as P
-        from dslabs_tpu.tpu.protocols.paxos import (CREP, CREQ, HB, HBR,
+        from dslabs_tpu.tpu.specs_lab3 import (CREP, CREQ, HB, HBR,
                                                     P1A, P1B, P2A, P2B,
                                                     REPLY, REQ)
         from dslabs_tpu.tpu.trace import MessageTemplate
@@ -195,7 +195,7 @@ class PaxosBinding(TwinBinding):
 
     def _decode_timer(self, node_idx, rec):
         from dslabs_tpu.labs.paxos import paxos as P
-        from dslabs_tpu.tpu.protocols.paxos import (CLIENT_MS,
+        from dslabs_tpu.tpu.specs_lab3 import (CLIENT_MS,
                                                     ELECTION_MAX,
                                                     ELECTION_MIN,
                                                     HEARTBEAT_MS,
@@ -219,8 +219,10 @@ class PaxosBinding(TwinBinding):
         return s["nodes"][i * self.L["SW"] + off]
 
     def _log(self, s, i, slot, j):
-        return s["nodes"][i * self.L["SW"] + self.L["LOG"]
-                          + 4 * (slot - 1) + j]
+        # The compiled layout is field-major: each log field owns S
+        # consecutive lanes (j: 0=ex, 1=lb, 2=cmd, 3=ch).
+        key = ("log.ex", "log.lb", "log.cmd", "log.ch")[j]
+        return s["nodes"][i * self.L["SW"] + self.L[key] + (slot - 1)]
 
     def _k(self, s, c):
         return s["nodes"][self.n * self.L["SW"] + c]
@@ -367,8 +369,8 @@ class PaxosBinding(TwinBinding):
 
 
 def _unpack(packed: int):
-    """Inverse of the twin's _pack_entry bit layout
-    (tpu/protocols/paxos.py _unpack_entry, kept in lockstep)."""
+    """Inverse of the twin's packed log-entry bit layout (the
+    tpu/specs_lab3.py Slots lowering, kept in lockstep)."""
     v = int(packed)
     return v & 1, (v >> 2) & 0xFFF, v >> 14, (v >> 1) & 1
 

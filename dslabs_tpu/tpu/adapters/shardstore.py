@@ -5,12 +5,13 @@ tests/test_lab4_shardstore.py):
 
 1. The JOIN phase: the config controller (a PaxosClient ClientWorker)
    drives G Join commands through the shard master, with every store
-   server cut off.  :class:`JoinBinding` runs it on the join twin
-   (tpu/protocols/shardmaster_join.py).
+   server cut off.  :class:`JoinBinding` runs it on the generated
+   join twin (tpu/specs_lab4.py make_join_protocol).
 2. The MAIN phase: staged from the join goal state, a ShardStoreClient
    worker drives a KV workload through the store groups.
-   :class:`ShardStoreBinding` runs it on the shardstore twin
-   (tpu/protocols/shardstore.py), whose initial state BAKES IN the
+   :class:`ShardStoreBinding` runs it on the generated shardstore
+   twin (tpu/specs_lab4.py make_shardstore_protocol), whose initial
+   state BAKES IN the
    staged joins — so ``derive_root`` VALIDATES that the staged object
    state is the canonical joined root (every deviation is a loud
    NoTensorTwin) instead of replaying provenance.  This also lets
@@ -115,7 +116,7 @@ def _validate_joined_root(state, master_name, server_names,
 
 class JoinBinding(TwinBinding):
     """Join-phase binding: one shard master + the config controller,
-    store servers cut off (tpu/protocols/shardmaster_join.py)."""
+    store servers cut off (tpu/specs_lab4.py make_join_protocol)."""
 
     def __init__(self, state, master_addr, worker_addr, store_addrs):
         from dslabs_tpu.labs.shardedstore.shardmaster import Join, Ok
@@ -156,8 +157,7 @@ class JoinBinding(TwinBinding):
                     "(settings.deliver_timers(addr, False))")
 
     def build_protocol(self, net_cap, timer_cap):
-        from dslabs_tpu.tpu.protocols.shardmaster_join import \
-            make_join_protocol
+        from dslabs_tpu.tpu.specs_lab4 import make_join_protocol
 
         # net_cap passes through unchanged so the capacity ladder's
         # doubling (net_cap << attempt) actually escalates this twin.
@@ -173,10 +173,11 @@ class JoinBinding(TwinBinding):
         from dslabs_tpu.core.address import LocalAddress
         from dslabs_tpu.labs.clientserver.amo import AMOCommand, AMOResult
         from dslabs_tpu.labs.paxos.paxos import PaxosReply, PaxosRequest
-        from dslabs_tpu.tpu.protocols.shardmaster_join import REQ
+        from dslabs_tpu.tpu.specs_lab4 import JOIN_REQ as REQ
         from dslabs_tpu.tpu.trace import MessageTemplate
 
-        tag, seq = int(rec[0]), int(rec[1])
+        # Compiled rows are [tag, frm, to, payload...].
+        tag, seq = int(rec[0]), int(rec[3])
         master = LocalAddress(self.master_name)
         client = LocalAddress(self.client_name)
         if tag == REQ:
@@ -193,9 +194,10 @@ class JoinBinding(TwinBinding):
     def _decode_timer(self, node_idx, rec):
         from dslabs_tpu.core.address import LocalAddress
         from dslabs_tpu.labs.paxos import paxos as P
-        from dslabs_tpu.tpu.protocols.shardmaster_join import (
+        from dslabs_tpu.tpu.specs_lab4 import (
             CLIENT_MS, ELECTION_MAX, ELECTION_MIN, HEARTBEAT_MS,
-            T_CLIENT, T_ELECTION, T_HEARTBEAT)
+            JOIN_T_CLIENT as T_CLIENT, JOIN_T_ELECTION as T_ELECTION,
+            JOIN_T_HEARTBEAT as T_HEARTBEAT)
 
         tag, p0 = int(rec[0]), int(rec[3])
         if tag == T_ELECTION:
@@ -213,14 +215,11 @@ class JoinBinding(TwinBinding):
     # ---------------------------------------------------------------- masks
 
     def msg_mask_fn(self):
-        from dslabs_tpu.tpu.protocols.shardmaster_join import REQ
-
         def fn(msg, marr):
             import jax.numpy as jnp
 
-            # [tag, seq]: REQ rides client(1) -> master(0) = flat 2,
-            # REP the reverse = flat 1.
-            k = jnp.where(msg[0] == REQ, 2, 1)
+            # Compiled rows carry frm/to lanes: flat = frm * 2 + to.
+            k = msg[1] * 2 + msg[2]
             return jnp.sum(jnp.where(jnp.arange(4) == k, marr, False))
         return fn
 
@@ -259,7 +258,8 @@ class JoinBinding(TwinBinding):
 class ShardStoreBinding(TwinBinding):
     """Main-phase binding: G one-server groups + one shard master + one
     ShardStoreClient worker over a KV workload (the ShardStorePart1Test
-    test10/test11 shapes; tpu/protocols/shardstore.py)."""
+    test10/test11 shapes; tpu/specs_lab4.py
+    make_shardstore_protocol)."""
 
     def __init__(self, state, master_addr, kv_addrs, ctl_addrs):
         from dslabs_tpu.labs.shardedstore.shardmaster import ShardConfig
@@ -480,7 +480,7 @@ class ShardStoreBinding(TwinBinding):
     # ------------------------------------------------------------- protocol
 
     def build_protocol(self, net_cap, timer_cap):
-        from dslabs_tpu.tpu.protocols.shardstore import \
+        from dslabs_tpu.tpu.specs_lab4 import \
             make_shardstore_protocol
 
         p = make_shardstore_protocol(
@@ -507,14 +507,15 @@ class ShardStoreBinding(TwinBinding):
         from dslabs_tpu.labs.shardedstore.shardstore import (
             ShardMove, ShardMoveAck, ShardStoreReply, ShardStoreRequest,
             WrongGroup)
-        from dslabs_tpu.tpu.protocols.shardstore import (JREP, JREQ,
-                                                         QREP, QRY, SM,
-                                                         SMACK, SSREP,
-                                                         SSREQ, WG)
+        from dslabs_tpu.tpu.specs_lab4 import (JREP, JREQ, QREP, QRY,
+                                               SM, SMACK, SSREP, SSREQ,
+                                               WG)
         from dslabs_tpu.tpu.trace import MessageTemplate
 
+        # Compiled rows are [tag, frm, to, payload...]; the payload
+        # field orders below mirror the spec's MessageType tuples.
         r = [int(x) for x in rec]
-        tag, a, b, c = r[0], r[1], r[2], r[3]
+        tag, a, b, c = r[0], r[3], r[4], (r[5] if len(r) > 5 else 0)
         master = self._addr(self.master_name)
         NC = self.NC
         final_num = self.configs[-1].config_num
@@ -584,7 +585,7 @@ class ShardStoreBinding(TwinBinding):
         from dslabs_tpu.labs.paxos import paxos as P
         from dslabs_tpu.labs.shardedstore.shardstore import (ClientTimer,
                                                              QueryTimer)
-        from dslabs_tpu.tpu.protocols.shardstore import (CLIENT_MS,
+        from dslabs_tpu.tpu.specs_lab4 import (CLIENT_MS,
                                                          ELECTION_MAX,
                                                          ELECTION_MIN,
                                                          HEARTBEAT_MS,
@@ -627,50 +628,15 @@ class ShardStoreBinding(TwinBinding):
     # ---------------------------------------------------------------- masks
 
     def msg_mask_fn(self):
-        from dslabs_tpu.tpu.protocols.shardstore import (JREP, JREQ,
-                                                         QREP, QRY, SM,
-                                                         SMACK, SSREP,
-                                                         SSREQ, WG)
-
         nn = len(self.addr_index)
-        G, NC = self.G, self.NC
-        groups_of = [list(g) for g in self.groups_of]
 
         def fn(msg, marr):
             import jax.numpy as jnp
 
-            tag, a, b = msg[0], msg[1], msg[2]
-
-            def grp(c, k):
-                out = jnp.asarray(groups_of[0][0], jnp.int32)
-                for cs in range(NC):
-                    for kk in range(1, len(groups_of[cs]) + 1):
-                        if (cs, kk) == (0, 1):
-                            continue
-                        out = jnp.where((c == cs) & (k == kk),
-                                        groups_of[cs][kk - 1], out)
-                return out
-
-            # source/dest coding: c in [0, NC) = client node G+1+c,
-            # NC+g-1 = server node g (tpu/protocols/shardstore.py).
-            src = jnp.where(a < NC, G + 1 + a, a - NC + 1)
-            cnode = G + 1 + a                              # a = client id
-            frm = jnp.asarray(0, jnp.int32)
-            to = jnp.asarray(0, jnp.int32)
-            frm = jnp.where(tag == QRY, src, frm)
-            to = jnp.where(tag == QREP, src, to)           # master -> dst
-            frm = jnp.where(tag == SSREQ, cnode, frm)
-            to = jnp.where(tag == SSREQ, grp(a, b), to)
-            frm = jnp.where((tag == SSREP) | (tag == WG), grp(a, b), frm)
-            to = jnp.where((tag == SSREP) | (tag == WG), cnode, to)
-            frm = jnp.where(tag == SM, 1, frm)
-            to = jnp.where(tag == SM, 2, to)
-            frm = jnp.where(tag == SMACK, 2, frm)
-            to = jnp.where(tag == SMACK, 1, to)
-            cca = G + 1 + NC
-            frm = jnp.where(tag == JREQ, cca, frm)       # ctl -> master
-            to = jnp.where(tag == JREP, cca, to)         # master -> ctl
-            k = frm * nn + to
+            # Compiled rows carry frm/to lanes directly, and the
+            # spec's node order matches addr_index (master 0, servers
+            # 1..G, clients G+1.., controller last).
+            k = msg[1] * nn + msg[2]
             return jnp.sum(jnp.where(jnp.arange(nn * nn) == k, marr,
                                      False))
         return fn
@@ -798,8 +764,10 @@ class ShardStoreTxBinding(TwinBinding):
                     tuple(self.server_names),
                     tuple(repr(c) for c, _ in pairs))
         # Client workload-index lane (tx twin layout: master 2+G, then
-        # per-server blocks 9 + 3W, then the g1 coordinator block 7W).
-        self._ck = (2 + 2) + (9 + 3 * self.W) * 2 + 7 * self.W
+        # per-server blocks 9 + 3W + 7W — the coordinator slot block
+        # rides on BOTH servers in the uniform compiled layout, zero
+        # on g2).
+        self._ck = (2 + 2) + (9 + 10 * self.W) * 2
 
     def initial_caps(self):
         return 48, 6
@@ -833,7 +801,7 @@ class ShardStoreTxBinding(TwinBinding):
         return None, []
 
     def build_protocol(self, net_cap, timer_cap):
-        from dslabs_tpu.tpu.protocols.shardstore_tx import             make_shardstore_tx_protocol
+        from dslabs_tpu.tpu.specs_lab4 import             make_shardstore_tx_protocol
 
         p = make_shardstore_tx_protocol(
             n_tx=self.W, net_cap=max(net_cap, 48),
@@ -863,7 +831,7 @@ class ShardStoreTxBinding(TwinBinding):
         from dslabs_tpu.labs.shardedstore.shardstore import (
             ShardMove, ShardMoveAck, ShardStoreReply, ShardStoreRequest,
             TxAck, TxDecision, TxPrepare, TxVote, WrongGroup)
-        from dslabs_tpu.tpu.protocols.shardstore_tx import (QREP, QRY,
+        from dslabs_tpu.tpu.specs_lab4 import (QREP, QRY,
                                                             SM, SMACK,
                                                             SSREP,
                                                             SSREQ, TXA,
@@ -872,7 +840,8 @@ class ShardStoreTxBinding(TwinBinding):
         from dslabs_tpu.tpu.trace import MessageTemplate
 
         r = [int(x) for x in rec]
-        tag, a, b, c = r[0], r[1], r[2], r[3]
+        # Compiled rows are [tag, frm, to, payload...].
+        tag, a, b, c = r[0], r[3], r[4], r[5]
         master = self._addr(self.master_name)
         client = self._addr(self.client_name)
         s1 = self._addr(self.server_names[0])
@@ -938,7 +907,7 @@ class ShardStoreTxBinding(TwinBinding):
         from dslabs_tpu.labs.paxos import paxos as P
         from dslabs_tpu.labs.shardedstore.shardstore import (ClientTimer,
                                                              QueryTimer)
-        from dslabs_tpu.tpu.protocols.shardstore_tx import (CLIENT_MS,
+        from dslabs_tpu.tpu.specs_lab4 import (CLIENT_MS,
                                                             ELECTION_MAX,
                                                             ELECTION_MIN,
                                                             HEARTBEAT_MS,
@@ -967,42 +936,14 @@ class ShardStoreTxBinding(TwinBinding):
     # ---------------------------------------------------------------- masks
 
     def msg_mask_fn(self):
-        from dslabs_tpu.tpu.protocols.shardstore_tx import (QREP, QRY,
-                                                            SM, SMACK,
-                                                            SSREP,
-                                                            SSREQ, TXA,
-                                                            TXD, TXP,
-                                                            TXV, WG)
-
         nn = len(self.addr_index)
 
         def fn(msg, marr):
             import jax.numpy as jnp
 
-            tag, a, c = msg[0], msg[1], msg[3]
-            CL = 3
-            src = jnp.where(a == 0, CL, a)
-            frm = jnp.asarray(0, jnp.int32)
-            to = jnp.asarray(0, jnp.int32)
-            frm = jnp.where(tag == QRY, src, frm)
-            to = jnp.where(tag == QREP, src, to)
-            frm = jnp.where(tag == SSREQ, CL, frm)
-            to = jnp.where(tag == SSREQ, 1, to)
-            frm = jnp.where((tag == SSREP) | (tag == WG), 1, frm)
-            to = jnp.where((tag == SSREP) | (tag == WG), CL, to)
-            frm = jnp.where(tag == SM, 1, frm)
-            to = jnp.where(tag == SM, 2, to)
-            frm = jnp.where(tag == SMACK, 2, frm)
-            to = jnp.where(tag == SMACK, 1, to)
-            frm = jnp.where(tag == TXP, 1, frm)
-            to = jnp.where(tag == TXP, c, to)
-            frm = jnp.where(tag == TXV, c // 2, frm)
-            to = jnp.where(tag == TXV, 1, to)
-            frm = jnp.where(tag == TXD, 1, frm)
-            to = jnp.where(tag == TXD, c // 2, to)
-            frm = jnp.where(tag == TXA, c, frm)
-            to = jnp.where(tag == TXA, 1, to)
-            k = frm * nn + to
+            # Compiled rows carry real frm/to lanes at msg[1]/msg[2]
+            # (node order matches addr_index: master, s1, s2, client).
+            k = msg[1] * nn + msg[2]
             return jnp.sum(jnp.where(jnp.arange(nn * nn) == k, marr,
                                      False))
         return fn

@@ -41,7 +41,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 __all__ = ["Field", "MessageType", "TimerType", "NodeKind",
-           "ProtocolSpec", "Ctx", "SpecError"]
+           "ProtocolSpec", "Ctx", "SpecError", "Fragment"]
 
 
 class SpecError(Exception):
@@ -138,11 +138,52 @@ class TimerType:
 @dataclasses.dataclass(frozen=True)
 class NodeKind:
     """``count`` instances of a node kind, each with the same fields.
-    Twin node indices are assigned kind-by-kind in declaration order."""
+    Twin node indices are assigned kind-by-kind in declaration order.
+    ``fields`` may mix plain :class:`Field`s with
+    :class:`~dslabs_tpu.tpu.slots.Slots` blocks (ISSUE 20) — the spec
+    expands each block to its struct-of-arrays lanes at construction
+    and remembers the declaration for the Ctx slot ops."""
 
     name: str
     count: int
     fields: Tuple[Field, ...]
+
+
+class Fragment:
+    """A composable sub-state-machine (ISSUE 20): a named bundle of
+    fields (plain or :class:`~dslabs_tpu.tpu.slots.Slots`), message and
+    timer types, and handlers, attached to a node kind with
+    :meth:`ProtocolSpec.include`.  This is how lab4's shardstore spec
+    states its shape — a per-group Paxos fragment + a reconfiguration-
+    epoch fragment + a 2PC vote fragment composed onto the server kind
+    — instead of one monolithic handler set.  Inclusion is structural:
+    fields append to the kind's layout, types merge into the spec's
+    enums (same-name re-declarations must be identical), handlers
+    register under the including kind, and the (kind, fragment) pair is
+    recorded on ``spec.fragments`` so the memo fingerprint and the
+    conformance linter see the composition."""
+
+    def __init__(self, name: str, fields: Sequence[object] = (),
+                 messages: Sequence[MessageType] = (),
+                 timers: Sequence[TimerType] = ()):
+        self.name = name
+        self.fields = tuple(fields)
+        self.messages = tuple(messages)
+        self.timers = tuple(timers)
+        self.handlers: Dict[str, Callable] = {}
+        self.timer_handlers: Dict[str, Callable] = {}
+
+    def on(self, msg: str):
+        def reg(fn):
+            self.handlers[msg] = fn
+            return fn
+        return reg
+
+    def on_timer(self, timer: str):
+        def reg(fn):
+            self.timer_handlers[timer] = fn
+            return fn
+        return reg
 
 
 class Ctx:
@@ -152,7 +193,7 @@ class Ctx:
     jnp.where, exactly the hand-twin discipline."""
 
     def __init__(self, spec, st, kind, idx, cond, sends, sets,
-                 handler=None):
+                 handler=None, excs=None):
         self._spec = spec
         self._st = st
         self._kind = kind
@@ -160,6 +201,7 @@ class Ctx:
         self._cond = cond
         self._sends = sends
         self._sets = sets
+        self._excs = excs if excs is not None else []
         self._handler = handler        # (name, firstlineno) or None
 
     def _err(self, message: str, field: Optional[str] = None):
@@ -193,6 +235,17 @@ class Ctx:
         self._st[key] = jnp.where(self._cond & when, val, cur).astype(
             jnp.int32)
 
+    def _check_static_index(self, field: str, i, size: int, op: str):
+        """A STATIC index outside the declared range is a loud
+        compile-gate error (ISSUE 20): the one-hot mux would otherwise
+        return a silent 0 / drop the write — exactly the class of bug
+        the slot layer exists to retire.  Traced indices pass through
+        (the mux masks them, matching the hand twins)."""
+        if isinstance(i, (int, np.integer)) and not 0 <= int(i) < size:
+            raise self._err(
+                f"{op} of field {field!r}: static index {int(i)} "
+                f"outside declared range [0, {size})", field=field)
+
     def get_at(self, field: str, i):
         """Dynamic element read of an array field — one-hot select, the
         engine's static-indexing rule (traced-index gathers are the
@@ -201,6 +254,7 @@ class Ctx:
         import jax.numpy as jnp
 
         vec = jnp.atleast_1d(self._st[self._key(field, "get_at")])
+        self._check_static_index(field, i, vec.shape[0], "get_at")
         oh = jnp.arange(vec.shape[0]) == i
         return jnp.sum(jnp.where(oh, vec, 0))
 
@@ -210,6 +264,7 @@ class Ctx:
         key = self._key(field, "put_at")
         cur = self._st[key]
         vec = jnp.atleast_1d(cur)
+        self._check_static_index(field, i, vec.shape[0], "put_at")
         oh = (jnp.arange(vec.shape[0]) == i) & self._cond & when
         out = jnp.where(oh, jnp.asarray(value, jnp.int32), vec).astype(
             jnp.int32)
@@ -219,7 +274,87 @@ class Ctx:
         """A refined child context (guard & extra) for nested logic."""
         return Ctx(self._spec, self._st, self._kind, self._idx,
                    self._cond & extra, self._sends, self._sets,
-                   handler=self._handler)
+                   handler=self._handler, excs=self._excs)
+
+    # ------------------------------------------------------------- slots
+
+    def _slot_block(self, block: str, op: str):
+        decl = self._spec.slot_blocks.get((self._kind, block))
+        if decl is None:
+            declared = sorted(b for k, b in self._spec.slot_blocks
+                              if k == self._kind)
+            raise self._err(
+                f"{op} of undeclared Slots block {block!r} on kind "
+                f"{self._kind!r} (declared: {declared})", field=block)
+        touched = getattr(self._spec, "_touched_slots", None)
+        if touched is not None:
+            touched.add((self._kind, block))
+        return decl
+
+    def slot_get(self, block: str, field: str, i):
+        """Read one record field of LOGICAL slot ``i`` (the block's
+        ``base`` offset is spec data, not handler arithmetic)."""
+        decl = self._slot_block(block, "slot_get")
+        if isinstance(i, (int, np.integer)) and not (
+                decl.base <= int(i) < decl.base + decl.n):
+            raise self._err(
+                f"slot_get of block {block!r}: static slot index "
+                f"{int(i)} outside declared range "
+                f"[{decl.base}, {decl.base + decl.n})", field=field)
+        return self.get_at(decl.lane(field), i - decl.base)
+
+    def slot_put(self, block: str, field: str, i, value, when=True):
+        decl = self._slot_block(block, "slot_put")
+        if isinstance(i, (int, np.integer)) and not (
+                decl.base <= int(i) < decl.base + decl.n):
+            raise self._err(
+                f"slot_put of block {block!r}: static slot index "
+                f"{int(i)} outside declared range "
+                f"[{decl.base}, {decl.base + decl.n})", field=field)
+        self.put_at(decl.lane(field), i - decl.base, value, when=when)
+
+    def slot_clear_upto(self, block: str, upto, when=True):
+        """Slot-windowed garbage bound: every slot with logical index
+        STRICTLY below ``upto`` resets to its declared ``clear`` value
+        (all record fields) — the lab3 log-GC pattern as one lowering.
+        ``upto`` may be traced; the window mask rides the guard."""
+        import jax.numpy as jnp
+
+        decl = self._slot_block(block, "slot_clear_upto")
+        idx = jnp.arange(decl.n) + decl.base
+        win = (idx < upto) & self._cond & when
+        for sf in decl.fields:
+            key = self._key(decl.lane(sf.name), "slot_clear_upto")
+            cur = jnp.atleast_1d(self._st[key])
+            self._st[key] = jnp.where(win, sf.clear, cur).astype(
+                jnp.int32)
+
+    # ------------------------------------------------------------ quorum
+
+    def quorum(self, name: str):
+        """The spec-declared quorum ``name`` in resolved form
+        (tpu/quorum.py Quorum: group size, vote threshold, reducers)."""
+        q = self._spec.resolved_quorums().get(name)
+        if q is None:
+            raise self._err(
+                f"read of undeclared quorum {name!r} (declared: "
+                f"{sorted(self._spec.resolved_quorums())})", field=name)
+        touched = getattr(self._spec, "_touched_quorums", None)
+        if touched is not None:
+            touched.add(name)
+        return q
+
+    def fail(self, code: int, when=True):
+        """Raise the tensor analog of a handler exception: the step's
+        ``exc`` lane becomes ``code`` when the guard (and ``when``)
+        holds — the hand twins' pack-width guard discipline, now a
+        combinator.  ``code`` must be a static positive int so the
+        packed exc lane's domain is known at compile time."""
+        if not isinstance(code, (int, np.integer)) or int(code) <= 0:
+            raise self._err(
+                f"fail() code must be a static positive int, got "
+                f"{code!r}")
+        self._excs.append((int(code), self._cond & when))
 
     # ------------------------------------------------------------ effects
 
@@ -229,6 +364,9 @@ class Ctx:
             raise self._err(
                 f"send of undeclared message {msg!r} (declared: "
                 f"{sorted(self._spec._mspec)})", field=msg)
+        sent = getattr(self._spec, "_touched_sends", None)
+        if sent is not None:
+            sent.add(msg)
         unknown = sorted(set(fields) - set(m.fields))
         missing = sorted(set(m.fields) - set(fields))
         if unknown or missing:
@@ -274,9 +412,26 @@ class ProtocolSpec:
                  net_cap: int = 16,
                  timer_cap: int = 4,
                  symmetry: Sequence[str] = (),
-                 fault: Optional[object] = None):
+                 fault: Optional[object] = None,
+                 quorums: Sequence[object] = (),
+                 max_live_sends: Optional[int] = None):
         self.name = name
-        self.nodes = list(nodes)
+        # Multi-instance slot blocks (ISSUE 20, tpu/slots.py): each
+        # Slots declaration inside NodeKind.fields expands to its
+        # struct-of-arrays lanes here; the declaration itself is kept
+        # for Ctx slot ops, fingerprinting, and conformance.
+        self.slot_blocks: Dict[Tuple[str, str], object] = {}
+        self.nodes = [self._expand_kind(k) for k in nodes]
+        # Quorum declarations (ISSUE 20, tpu/quorum.py): resolved (and
+        # refused when empty/unknown) at validate(); handlers reach
+        # them via ctx.quorum(name).
+        self.quorums = tuple(quorums)
+        self._quorums_resolved: Optional[Dict[str, object]] = None
+        # Composed sub-state machines: (kind, fragment name) pairs in
+        # inclusion order — structural identity for the memo
+        # fingerprint (service/memo.py).
+        self.fragments: List[Tuple[str, str]] = []
+        self.max_live_sends = max_live_sends
         # Declarative fault model (ISSUE 19, tpu/faults.py): when set,
         # a hidden controller node kind ("$fault") is appended LAST so
         # partition/crash/drop/dup budgets live in ordinary bounded
@@ -308,6 +463,11 @@ class ProtocolSpec:
         self.invariants: Dict[str, Callable] = {}
         self.decode_message: Optional[Callable] = None
         self.decode_timer: Optional[Callable] = None
+        self._reindex_types()
+
+    def _reindex_types(self) -> None:
+        """(Re)build the tag/spec/width tables — called at construction
+        and after a :meth:`include` merges fragment types in."""
         self._mtag = {m.name: i for i, m in enumerate(self.messages)}
         self._mspec = {m.name: m for m in self.messages}
         # Timer tag 0 is reserved (SENTINEL-adjacent "no tag") to keep
@@ -318,6 +478,99 @@ class ProtocolSpec:
                            default=0)
         self._tw = 3 + max((len(t.fields) for t in self.timers),
                            default=0)       # [tag, min, max, fields...]
+
+    def _expand_kind(self, kind: NodeKind) -> NodeKind:
+        """Expand Slots blocks inside a kind's fields to their lowered
+        array Fields, recording each declaration for the Ctx slot
+        ops."""
+        from dslabs_tpu.tpu.slots import Slots, expand_slots
+
+        if not any(isinstance(f, Slots) for f in kind.fields):
+            return kind
+        out: List[Field] = []
+        for f in kind.fields:
+            if isinstance(f, Slots):
+                if (kind.name, f.name) in self.slot_blocks:
+                    raise SpecError(
+                        f"duplicate Slots block {f.name!r} on kind "
+                        f"{kind.name!r}", spec=self.name,
+                        kind=kind.name, field=f.name)
+                self.slot_blocks[(kind.name, f.name)] = f
+                out.extend(expand_slots(f, Field))
+            else:
+                out.append(f)
+        return dataclasses.replace(kind, fields=tuple(out))
+
+    def include(self, kind: str, fragment: "Fragment") -> None:
+        """Compose a :class:`Fragment` onto a declared node kind: its
+        fields append to the kind's layout, its message/timer types
+        merge into the spec enums (identical re-declaration tolerated,
+        conflicting redefinition refused), and its handlers register
+        under the kind.  Must run before :meth:`compile`."""
+        for pos, k in enumerate(self.nodes):
+            if k.name == kind:
+                break
+        else:
+            raise SpecError(
+                f"include of fragment {fragment.name!r} on unknown "
+                f"node kind {kind!r} (declared: "
+                f"{sorted(x.name for x in self.nodes)})",
+                spec=self.name, kind=kind, field=fragment.name)
+        if (kind, fragment.name) in self.fragments:
+            raise SpecError(
+                f"fragment {fragment.name!r} included twice on kind "
+                f"{kind!r}", spec=self.name, kind=kind,
+                field=fragment.name)
+        ext = self._expand_kind(dataclasses.replace(
+            self.nodes[pos],
+            fields=self.nodes[pos].fields + tuple(fragment.fields)))
+        self.nodes[pos] = ext
+        for m in fragment.messages:
+            cur = next((x for x in self.messages if x.name == m.name),
+                       None)
+            if cur is None:
+                self.messages.append(m)
+            elif cur != m:
+                raise SpecError(
+                    f"fragment {fragment.name!r} redeclares message "
+                    f"{m.name!r} with a different shape",
+                    spec=self.name, kind=kind, field=m.name)
+        for t in fragment.timers:
+            cur = next((x for x in self.timers if x.name == t.name),
+                       None)
+            if cur is None:
+                self.timers.append(t)
+            elif cur != t:
+                raise SpecError(
+                    f"fragment {fragment.name!r} redeclares timer "
+                    f"{t.name!r} with a different shape",
+                    spec=self.name, kind=kind, field=t.name)
+        for msg, fn in fragment.handlers.items():
+            if (kind, msg) in self.handlers:
+                raise SpecError(
+                    f"fragment {fragment.name!r} handler for "
+                    f"{msg!r} collides with an existing handler on "
+                    f"kind {kind!r}", spec=self.name, kind=kind,
+                    field=msg)
+            self.handlers[(kind, msg)] = fn
+        for tmr, fn in fragment.timer_handlers.items():
+            if (kind, tmr) in self.timer_handlers:
+                raise SpecError(
+                    f"fragment {fragment.name!r} timer handler for "
+                    f"{tmr!r} collides with an existing handler on "
+                    f"kind {kind!r}", spec=self.name, kind=kind,
+                    field=tmr)
+            self.timer_handlers[(kind, tmr)] = fn
+        self.fragments.append((kind, fragment.name))
+        self._reindex_types()
+
+    def resolved_quorums(self) -> Dict[str, object]:
+        """Declared quorums resolved against the node kinds (cached);
+        raises the structured refusal for empty/unknown groups."""
+        if self._quorums_resolved is None:
+            from dslabs_tpu.tpu.quorum import resolve_quorums
+            self._quorums_resolved = resolve_quorums(self)
+        return self._quorums_resolved
 
     # ------------------------------------------------------------- layout
 
@@ -422,6 +675,10 @@ class ProtocolSpec:
                         "only through message loss and timer silence",
                         spec=self.name, kind=FAULT_KIND, code="C6")
             validate_fault(self)
+        # Quorum declarations resolve (and refuse empty/unknown
+        # groups) at the same gate (ISSUE 20, tpu/quorum.py).
+        self._quorums_resolved = None
+        self.resolved_quorums()
         kinds = {k.name for k in self.nodes}
         for (kind, msg), fn in self.handlers.items():
             name, line = self._handler_id(fn)
@@ -567,10 +824,11 @@ class ProtocolSpec:
                 else:
                     entries.append((0, 0))
             tmr.append(_merge(entries))
-        # Compiled handlers never set an exception code
-        # (_normalize_step pads exc=0), so the lane is a constant.
+        # The exc lane spans the declared ctx.fail codes; without any
+        # the compiled steps never set it (_normalize_step pads exc=0)
+        # and the lane is a constant.
         return {"nodes": nodes, "msg": msg, "timer": tmr,
-                "exc": (0, 0)}
+                "exc": (0, getattr(self, "_exc_hi", 0))}
 
     def _symmetry_spec(self, table):
         """Build the canonical-relabeling permutation tables for the
@@ -683,20 +941,35 @@ class ProtocolSpec:
         # discipline of the hand twins, without the hand counting).
         max_sends, max_sets = self._count_budgets()
 
-        def _finalize(rows, budget, width):
+        uses_exc = self._exc_hi > 0
+
+        def _finalize(groups, budget, width):
+            """Merge per-invocation row groups into one [budget, width]
+            block.  Invocations are pairwise mutually exclusive (see
+            _count_budgets), so row j of the step is jnp.minimum over
+            every group's SENTINEL-blanked row j: at most one group
+            contributes live rows, SENTINEL (int32 max) loses every
+            minimum, and an all-false step yields an all-blank block —
+            exactly the hand twins' jnp.minimum merge discipline."""
             blank = jnp.full((width,), SENTINEL, jnp.int32)
-            out = []
-            for rec, cond in rows:
-                out.append(jnp.where(cond, rec, blank))
-            assert len(out) <= budget, (len(out), budget)
-            while len(out) < budget:
-                out.append(blank)
-            return jnp.stack(out) if out else jnp.zeros((0, width),
-                                                        jnp.int32)
+            merged = [blank] * budget
+            for rows in groups:
+                assert len(rows) <= budget, (len(rows), budget)
+                for j, (rec, cond) in enumerate(rows):
+                    merged[j] = jnp.minimum(
+                        merged[j], jnp.where(cond, rec, blank))
+            return (jnp.stack(merged) if merged
+                    else jnp.zeros((0, width), jnp.int32))
+
+        def _exc_lane(excs):
+            out = jnp.zeros((), jnp.int32)
+            for code, cond in excs:
+                out = jnp.maximum(out, jnp.where(cond, code, 0))
+            return out
 
         def step_message(nodes, msg):
             st = unpack(nodes)
-            sends, sets = [], []
+            send_groups, set_groups, excs = [], [], []
             tag, frm, to = msg[0], msg[1], msg[2]
             for kind, i in spec._instances():
                 here = to == spec._node_index(kind.name, i)
@@ -708,15 +981,20 @@ class ProtocolSpec:
                     payload = {f: msg[3 + j]
                                for j, f in enumerate(m.fields)}
                     payload["_from"] = frm
+                    sends, sets = [], []
                     ctx = Ctx(spec, st, kind.name, i, cond, sends, sets,
-                              handler=spec._handler_id(fn))
+                              handler=spec._handler_id(fn), excs=excs)
                     spec._invoke(fn, ctx, payload, m.name)
-            return (repack(st), _finalize(sends, max_sends, spec._mw),
-                    _finalize(sets, max_sets, 1 + spec._tw))
+                    send_groups.append(sends)
+                    set_groups.append(sets)
+            out = (repack(st),
+                   _finalize(send_groups, max_sends, spec._mw),
+                   _finalize(set_groups, max_sets, 1 + spec._tw))
+            return out + ((_exc_lane(excs),) if uses_exc else ())
 
         def step_timer(nodes, node_idx, timer):
             st = unpack(nodes)
-            sends, sets = [], []
+            send_groups, set_groups, excs = [], [], []
             tag = timer[0]
             for kind, i in spec._instances():
                 here = node_idx == spec._node_index(kind.name, i)
@@ -727,11 +1005,16 @@ class ProtocolSpec:
                     cond = here & (tag == spec._ttag[t.name])
                     payload = {f: timer[3 + j]
                                for j, f in enumerate(t.fields)}
+                    sends, sets = [], []
                     ctx = Ctx(spec, st, kind.name, i, cond, sends, sets,
-                              handler=spec._handler_id(fn))
+                              handler=spec._handler_id(fn), excs=excs)
                     spec._invoke(fn, ctx, payload, t.name)
-            return (repack(st), _finalize(sends, max_sends, spec._mw),
-                    _finalize(sets, max_sets, 1 + spec._tw))
+                    send_groups.append(sends)
+                    set_groups.append(sets)
+            out = (repack(st),
+                   _finalize(send_groups, max_sends, spec._mw),
+                   _finalize(set_groups, max_sets, 1 + spec._tw))
+            return out + ((_exc_lane(excs),) if uses_exc else ())
 
         def init_nodes():
             out = np.zeros((nw,), np.int32)
@@ -790,6 +1073,7 @@ class ProtocolSpec:
             timer_cap=self.timer_cap,
             max_sends=max(max_sends, 1),
             max_sets=max(max_sets, 1),
+            max_live_sends=self.max_live_sends,
             init_nodes=init_nodes,
             init_messages=init_messages,
             init_timers=init_timers,
@@ -822,10 +1106,22 @@ class ProtocolSpec:
     def _count_budgets(self) -> Tuple[int, int]:
         """Count worst-case send/set rows by running every handler once
         with a counting context (handlers are straight-line over the
-        combinators, so one run = its static row count).  The compiled
-        step accumulates ALL handlers' rows into one block per step
-        kind, so the budget is the larger of the message-step and
-        timer-step TOTALS."""
+        combinators, so one run = its static row count).
+
+        Handler invocations within one step are pairwise mutually
+        exclusive — each is guarded by ``(to == node_idx) & (tag ==
+        mtag)`` and at most one (node, type) pair matches a delivered
+        record — so the compiled step MERGES their row groups
+        (jnp.minimum over SENTINEL-blanked rows) instead of
+        concatenating them.  The budget is therefore the MAX single
+        invocation's row count, not the sum: this is what keeps
+        MAX_SENDS at hand-twin scale for lab3/lab4, where summing
+        across ~40 handler instances would explode the send block
+        (ISSUE 20).
+
+        Also records exc-lane usage for :meth:`_lane_domains`:
+        ``self._exc_hi`` is the largest static ``ctx.fail`` code (0
+        when no handler fails)."""
         import jax.numpy as jnp
 
         table, _ = self._layout()
@@ -836,35 +1132,47 @@ class ProtocolSpec:
                     for key, (_, size) in table.items()}
 
         false = jnp.asarray(False)
-        msg_sends = msg_sets = tmr_sends = tmr_sets = 0
+        max_sends = max_sets = 0
+        self._exc_hi = 0
+        # Coverage record for the conformance linter's soft C4 half:
+        # which Slots blocks and quorums the dry-run actually touched.
+        self._touched_slots = set()
+        self._touched_quorums = set()
+        self._touched_sends = set()
         for kind, i in self._instances():
             for m in self.messages:
                 fn = self.handlers.get((kind.name, m.name))
                 if fn is None:
                     continue
-                sends, sets = [], []
+                sends, sets, excs = [], [], []
                 ctx = Ctx(self, dummy_state(), kind.name, i, false,
-                          sends, sets, handler=self._handler_id(fn))
+                          sends, sets, handler=self._handler_id(fn),
+                          excs=excs)
                 self._invoke(
                     fn, ctx, {f: jnp.zeros((), jnp.int32)
                               for f in m.fields} | {"_from": jnp.zeros(
                                   (), jnp.int32)}, m.name)
-                msg_sends += len(sends)
-                msg_sets += len(sets)
+                max_sends = max(max_sends, len(sends))
+                max_sets = max(max_sets, len(sets))
+                for code, _c in excs:
+                    self._exc_hi = max(self._exc_hi, code)
             for t in self.timers:
                 fn = self.timer_handlers.get((kind.name, t.name))
                 if fn is None:
                     continue
-                sends, sets = [], []
+                sends, sets, excs = [], [], []
                 ctx = Ctx(self, dummy_state(), kind.name, i, false,
-                          sends, sets, handler=self._handler_id(fn))
+                          sends, sets, handler=self._handler_id(fn),
+                          excs=excs)
                 self._invoke(
                     fn, ctx,
                     {f: jnp.zeros((), jnp.int32) for f in t.fields},
                     t.name)
-                tmr_sends += len(sends)
-                tmr_sets += len(sets)
-        return (max(msg_sends, tmr_sends), max(msg_sets, tmr_sets))
+                max_sends = max(max_sends, len(sends))
+                max_sets = max(max_sets, len(sets))
+                for code, _c in excs:
+                    self._exc_hi = max(self._exc_hi, code)
+        return (max_sends, max_sets)
 
 
 class _View:

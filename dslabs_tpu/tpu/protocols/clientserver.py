@@ -5,7 +5,8 @@ SimpleServer = AMOApplication(KVStore), SimpleClient with a 100 ms retry
 timer; reference spec ClientServerPart2Test.java:175-281): ``n_clients``
 ClientWorker-wrapped clients each Put their own key W times.
 
-State collapse (same discipline as the paxos twin, tpu/protocols/paxos.py):
+State collapse (same discipline as the generated paxos twin,
+tpu/specs_lab3.py):
 under this workload every object-state component is determined by two
 small integers per client —
 

@@ -1,0 +1,166 @@
+"""Quorum aggregation combinators for the protocol spec layer
+(ISSUE 20, ROADMAP #1).
+
+The hand-written lab3/lab4 twins all contain the same expert pattern:
+a per-instance VOTE BITMAP lane (bit ``i`` = member ``i`` voted), a
+bit-twiddling popcount, and a ``2*count > n`` majority test.  This
+module lifts that pattern into a declaration — :class:`QuorumCount`
+names the node kind (or ``index_group``) being counted over and the
+threshold rule — plus the reducers handlers and invariant predicates
+use on the lowered lanes:
+
+* ``popcount(bits, n)`` — the hand twins' SWAR popcount, restricted to
+  the low ``n`` bits (a vote bitmap over ``n`` members),
+* ``count_true(vec)`` / ``majority(vec, n)`` / ``all_of`` / ``any_of``
+  — reducers over an ``index_group`` array field (one lane per member).
+
+Declarations live on the spec (``ProtocolSpec(quorums=...)``) so the
+compile gate can refuse a quorum over an empty or unknown group
+(``SpecError``, the ISSUE 20 edge-case satellite) and so the memo
+fingerprint (service/memo.py) distinguishes two protocols differing
+only in a threshold.  Handlers reach the RESOLVED form through
+``ctx.quorum(name)`` -> :class:`Quorum`: the threshold arithmetic is
+spec data, never a handler-local constant, which is what keeps the C5
+symmetry argument intact (a popcount is permutation-invariant, a
+member-specific bit test is not — see analysis/conformance.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Union
+
+__all__ = ["QuorumCount", "Quorum", "popcount", "count_true",
+           "majority", "all_of", "any_of", "resolve_quorums"]
+
+
+@dataclasses.dataclass(frozen=True)
+class QuorumCount:
+    """A declared quorum: count votes ``over`` the instances of one
+    node kind (equivalently: over the lanes of any array field whose
+    ``index_group`` names that kind) and compare against ``threshold``
+    — an int, or one of ``"majority"`` (n//2 + 1), ``"all"`` (n),
+    ``"any"`` (1)."""
+
+    name: str
+    over: str
+    threshold: Union[int, str] = "majority"
+
+
+@dataclasses.dataclass(frozen=True)
+class Quorum:
+    """A :class:`QuorumCount` resolved against its spec: ``n`` members,
+    ``need`` votes.  The methods are plain jnp reducers usable inside
+    handlers (on traced lanes) and predicates (on state views)."""
+
+    name: str
+    over: str
+    n: int
+    need: int
+
+    # ------------------------------------------------------ bitmap form
+
+    def count_bits(self, bits):
+        """Popcount of a vote BITMAP lane (bit i = member i voted)."""
+        return popcount(bits, self.n)
+
+    def met_bits(self, bits):
+        return self.count_bits(bits) >= self.need
+
+    # ------------------------------------------------------- array form
+
+    def count(self, vec):
+        """Count of non-zero votes in an ``index_group`` array field."""
+        return count_true(vec)
+
+    def met(self, vec):
+        return self.count(vec) >= self.need
+
+
+def popcount(bits, n: int):
+    """Bit-population count of the low ``n`` bits of ``bits`` — the
+    hand paxos twin's ``_popcount`` SWAR ladder, here as the ONE shared
+    lowering every quorum declaration compiles to.  ``n`` is static
+    (the group size), so the mask folds at trace time."""
+    import jax.numpy as jnp
+
+    v = jnp.asarray(bits, jnp.int32) & ((1 << n) - 1)
+    v = v - ((v >> 1) & 0x55555555)
+    v = (v & 0x33333333) + ((v >> 2) & 0x33333333)
+    v = (v + (v >> 4)) & 0x0F0F0F0F
+    return (v * 0x01010101) >> 24
+
+
+def count_true(vec):
+    """Number of non-zero lanes of a per-member array field."""
+    import jax.numpy as jnp
+
+    v = jnp.asarray(vec)
+    return jnp.sum((v != 0).astype(jnp.int32))
+
+
+def majority(vec, n: Optional[int] = None):
+    """True when a strict majority of the ``n`` members voted."""
+    import jax.numpy as jnp
+
+    v = jnp.atleast_1d(jnp.asarray(vec))
+    total = n if n is not None else v.shape[0]
+    return 2 * count_true(v) > total
+
+
+def all_of(vec, n: Optional[int] = None):
+    import jax.numpy as jnp
+
+    v = jnp.atleast_1d(jnp.asarray(vec))
+    total = n if n is not None else v.shape[0]
+    return count_true(v) >= total
+
+
+def any_of(vec):
+    return count_true(vec) >= 1
+
+
+def resolve_quorums(spec) -> dict:
+    """Validate + resolve a spec's declared quorums against its node
+    kinds.  Raises the structured compile-gate error for a quorum over
+    an unknown or EMPTY group (ISSUE 20 satellite: refused loudly, not
+    a vacuously-met threshold deep in a search)."""
+    from dslabs_tpu.tpu.compiler import SpecError
+
+    counts = {k.name: k.count for k in spec.nodes}
+    out = {}
+    for q in getattr(spec, "quorums", ()) or ():
+        if q.name in out:
+            raise SpecError(
+                f"duplicate quorum declaration {q.name!r}",
+                spec=spec.name, field=q.name, code="C4")
+        n = counts.get(q.over)
+        if n is None:
+            raise SpecError(
+                f"quorum {q.name!r} counts over unknown node kind "
+                f"{q.over!r} (declared: {sorted(counts)})",
+                spec=spec.name, kind=q.over, field=q.name, code="C4")
+        if n <= 0:
+            raise SpecError(
+                f"quorum {q.name!r} counts over EMPTY group {q.over!r} "
+                f"(0 instances) — every threshold is vacuous; declare "
+                f"the group with instances or drop the quorum",
+                spec=spec.name, kind=q.over, field=q.name, code="C4")
+        if isinstance(q.threshold, str):
+            need = {"majority": n // 2 + 1, "all": n, "any": 1}.get(
+                q.threshold)
+            if need is None:
+                raise SpecError(
+                    f"quorum {q.name!r} has unknown threshold rule "
+                    f"{q.threshold!r} (use an int, 'majority', 'all' "
+                    f"or 'any')", spec=spec.name, field=q.name,
+                    code="C4")
+        else:
+            need = int(q.threshold)
+            if not 1 <= need <= n:
+                raise SpecError(
+                    f"quorum {q.name!r} threshold {need} outside "
+                    f"[1, {n}] for group {q.over!r}",
+                    spec=spec.name, field=q.name, code="C4")
+        out[q.name] = Quorum(q.name, q.over, n, need)
+    return out

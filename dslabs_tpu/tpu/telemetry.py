@@ -1473,7 +1473,7 @@ def read_ledger(path: str) -> List[dict]:
 # JSON's top-level value — the number the BENCH_r0N trajectory tracks).
 _LEDGER_PHASES = ("headline", "mesh", "strict", "beam", "swarm",
                   "spill", "capacity2", "service", "lanes", "memo",
-                  "scenarios", "cpu_fallback")
+                  "scenarios", "labs", "cpu_fallback")
 
 # Resilience counters the ledger tracks beside the rates (ISSUE 9):
 # a bench run that suddenly needs mesh shrinks / knob re-levels /
@@ -1918,6 +1918,37 @@ def compare_ledger(records: List[dict],
         cmp["scenarios"]["verdict_parity"] = entry
         if lv < 1:
             cmp["regressions"].append(entry)
+    # Generated-labs packing guard (ISSUE 20, bench --labs): summed
+    # packed bytes per stored state across the ProtocolSpec-compiled
+    # lab3/lab4 protocols vs the BEST (smallest) prior — a rise past
+    # the threshold means the spec-declared Field/Slots domains
+    # stopped reaching the bit-packer (declarations dropped in a
+    # refactor, identity descriptor re-derived), silently shrinking
+    # frontier capacity at fixed HBM.  Same rc-1 severity as a rate
+    # regression.
+    cmp["labs"] = {}
+
+    def _labs_bps(rec):
+        s = rec.get("labs")
+        if not isinstance(s, dict):
+            return None
+        try:
+            v = float(s.get("bytes_per_state"))
+        except (TypeError, ValueError):
+            return None
+        return v if v > 0 else None
+
+    lv = _labs_bps(latest)
+    priors_lb = [v for v in (_labs_bps(r) for r in prior)
+                 if v is not None]
+    if lv is not None and priors_lb:
+        best = min(priors_lb)
+        entry = {"phase": "labs:bytes_per_state",
+                 "latest": round(lv, 1), "best_prior": round(best, 1),
+                 "delta_pct": round((lv - best) / best * 100, 1)}
+        cmp["labs"]["bytes_per_state"] = entry
+        if lv > best * (1.0 + threshold):
+            cmp["regressions"].append(entry)
     return cmp
 
 
@@ -1976,6 +2007,10 @@ def render_compare(cmp: dict, source: str = "") -> str:
     for c, e in sorted(cmp.get("scenarios", {}).items()):
         out.append(f"scenarios {c:15s} latest={e['latest']} "
                    f"prior_best={e['best_prior']}")
+    for c, e in sorted(cmp.get("labs", {}).items()):
+        out.append(f"labs {c:20s} latest={e['latest']} "
+                   f"prior_best={e['best_prior']} "
+                   f"({e['delta_pct']:+.1f}%)")
     for e in cmp["regressions"]:
         out.append(f"REGRESSION: phase={e['phase']} "
                    f"latest={e['latest']} vs best={e['best_prior']} "

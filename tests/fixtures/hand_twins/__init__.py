@@ -1,0 +1,4 @@
+"""Hand-written tensor twins, retired from the shipped tree in favor
+of the compiled specs (tpu/specs_lab3.py, tpu/specs_lab4.py).  They
+stay here as parity ORACLES: tests/test_spec_parity.py checks the
+generated protocols reproduce their state counts exactly."""

@@ -293,6 +293,124 @@ def test_c5_compile_gate_guards_group_declarations():
         sp2.compile()
 
 
+# ------------------- red fixtures: C5 slot/quorum reads (ISSUE 20)
+
+_C5_REP_RED = textwrap.dedent("""
+    from dslabs_tpu.tpu.compiler import (Field, MessageType, NodeKind,
+                                         ProtocolSpec)
+    from dslabs_tpu.tpu.quorum import QuorumCount
+
+    spec = ProtocolSpec(
+        "rep",
+        nodes=[NodeKind("proposer", 1,
+                        (Field("seen", size=3,
+                               index_group="acceptor"),
+                         Field("bv", hi=7))),
+               NodeKind("acceptor", 3, (Field("b"),))],
+        messages=[MessageType("M", ())], timers=[],
+        symmetry=("acceptor",),
+        quorums=(QuorumCount("q", over="acceptor",
+                             threshold="majority"),))
+
+    @spec.on("proposer", "M")
+    def h(ctx, m):
+        ctx.put_at("seen", 2, 1)          # fixed member's element
+""")
+
+
+def test_c5_constant_index_into_symmetric_group_array():
+    """ISSUE 20 red fixture: get_at/put_at of an index_group array
+    over a symmetric kind at an integer-constant index is
+    member-specific — flagged C5 even though the handler's own kind
+    is outside the symmetry group."""
+    c5 = [f for f in lint_source(_C5_REP_RED, "fixture.py")
+          if f.code == "C5"]
+    assert len(c5) == 1
+    assert c5[0].obj == "h"
+    assert "index_group" in c5[0].message
+    assert "'acceptor'" in c5[0].message
+
+
+def test_c5_quorum_constant_bitmask():
+    """ISSUE 20 red fixture: met_bits/count_bits of a quorum over a
+    symmetric kind fed a constant bitmask names members by bit."""
+    src = _C5_REP_RED.replace(
+        'ctx.put_at("seen", 2, 1)          # fixed member\'s element',
+        'ctx.put("bv", ctx.quorum("q").met_bits(5))')
+    c5 = [f for f in lint_source(src, "fixture.py") if f.code == "C5"]
+    assert len(c5) == 1
+    assert "bitmask" in c5[0].message and "'q'" in c5[0].message
+
+
+def test_c5_slot_quorum_clean_counterparts():
+    """The symmetric-safe styles stay clean: indexing the group array
+    by the sender, feeding the quorum reducer the protocol's own
+    vote-bit field, a constant index into a NON-group array, and the
+    same red bodies with the symmetry declaration removed."""
+    by_from = _C5_REP_RED.replace(
+        'ctx.put_at("seen", 2, 1)', 'ctx.put_at("seen", m["_from"], 1)')
+    assert [f.code for f in lint_source(by_from, "f.py")] == []
+    own_bits = _C5_REP_RED.replace(
+        'ctx.put_at("seen", 2, 1)',
+        'ctx.put("bv", ctx.quorum("q").met_bits(ctx.get("bv")))')
+    assert [f.code for f in lint_source(own_bits, "f.py")] == []
+    non_group = _C5_REP_RED.replace(
+        'ctx.put_at("seen", 2, 1)', 'ctx.put_at("bv", 0, 1)')
+    assert [f.code for f in lint_source(non_group, "f.py")] == []
+    asym = _C5_REP_RED.replace('symmetry=("acceptor",),', "")
+    assert [f.code for f in lint_source(asym, "f.py")] == []
+
+
+def test_c4_check_spec_flags_untouched_slots_and_quorums():
+    """ISSUE 20 soft C4: the budget dry-run records which Slots blocks
+    and quorums handlers touch; declared-but-unreached ones are dead
+    lanes in every packed row.  Touching both clears the findings."""
+    from dslabs_tpu.tpu.quorum import QuorumCount
+    from dslabs_tpu.tpu.slots import SlotField, Slots
+
+    def build(touch):
+        sp = ProtocolSpec(
+            "dead", nodes=[NodeKind("n", 3, (
+                Field("x", hi=3),
+                Slots("log", 2, base=1,
+                      fields=(SlotField("cmd", hi=3),))))],
+            messages=[MessageType("M", ())], timers=[],
+            quorums=(QuorumCount("q", over="n",
+                                 threshold="majority"),))
+
+        @sp.on("n", "M")
+        def h(ctx, m):
+            if touch:
+                ctx.slot_put("log", "cmd", 1, 2)
+                ctx.put("x", ctx.quorum("q").met_bits(ctx.get("x")))
+            else:
+                ctx.put("x", 1)
+        return sp
+
+    found = check_spec(build(False), origin="fixture")
+    assert _codes(found) == ["C4"]
+    msgs = " ".join(f.message for f in found)
+    assert "Slots block 'log'" in msgs and "dead lanes" in msgs
+    assert "quorum 'q'" in msgs and "never read" in msgs
+    assert check_spec(build(True), origin="fixture") == []
+
+
+def test_c4_unhandled_but_sent_message_is_dead_letter_clean():
+    """The dead-letter idiom (a message some handler sends to an
+    address that ignores it — the lab4 reconfig-debris rows) is NOT
+    an unhandled-message finding; an unsent+unhandled one still is
+    (see test_c4_check_spec_reports_unhandled_declared_types)."""
+    sp = ProtocolSpec(
+        "dl", nodes=[NodeKind("n", 2, (Field("x"),))],
+        messages=[MessageType("M", ()), MessageType("DEBRIS", ())],
+        timers=[])
+
+    @sp.on("n", "M")
+    def h(ctx, m):
+        ctx.send("DEBRIS", to=1)
+    assert check_spec(sp, origin="fixture") == []
+
+
 # ------------------------------------------- red fixtures: jaxpr J0-J5
 
 def _entry(fn, args, donate=(), multi=False, builder=None):

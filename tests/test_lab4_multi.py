@@ -33,7 +33,7 @@ import pytest
 jax = pytest.importorskip("jax")
 
 from dslabs_tpu.tpu.engine import TensorSearch
-from dslabs_tpu.tpu.protocols.shardstore_multi import \
+from dslabs_tpu.tpu.specs_lab4 import \
     make_shardstore_multi_protocol
 
 SLOW = not os.environ.get("DSLABS_SLOW_TESTS")

@@ -194,6 +194,118 @@ def test_rename_only_same_fingerprint(tmp_path):
     assert diff == ["m:proc:S3"]
 
 
+REP_MODULE = textwrap.dedent("""
+    from dslabs_tpu.tpu.compiler import (Field, MessageType, NodeKind,
+                                         ProtocolSpec, TimerType)
+    from dslabs_tpu.tpu.quorum import QuorumCount
+    from dslabs_tpu.tpu.slots import SlotField, Slots
+
+
+    def {factory}():
+        spec = ProtocolSpec(
+            "memo-rep",
+            nodes=[NodeKind("proc", 3, (
+                Field("x", hi=7),
+                Slots("log", {n}, (SlotField("cmd", hi=7,
+                                             clear={clear}),),
+                      base=1),
+            ))],
+            messages=[MessageType("GO", ())],
+            timers=[TimerType("TICK", (), 10, 10)],
+            net_cap=4, timer_cap=1,
+            quorums=(QuorumCount("q", over="proc",
+                                 threshold={threshold!r}),))
+
+        @spec.on("proc", "GO")
+        def go(ctx, m):
+            met = ctx.quorum("q").met_bits(ctx.get("x"))
+            ctx.slot_put("log", "cmd", 1, 2, when=met)
+            ctx.slot_clear_upto("log", 2, when=~met)
+
+        spec.initial_messages.append(("GO", 0, 0, {{}}))
+        spec.invariants["OK"] = lambda v: True
+        return spec.compile()
+""")
+
+
+def _write_rep(tmp_path, name, factory="make_rep", n=2, clear=0,
+               threshold="majority"):
+    (tmp_path / f"{name}.py").write_text(REP_MODULE.format(
+        factory=factory, n=n, clear=clear, threshold=threshold))
+    return f"{name}:{factory}"
+
+
+def test_slot_quorum_rename_vs_resize_fingerprints(tmp_path):
+    """ISSUE 20 satellite: the Slots/Quorum declarations participate in
+    the structural fingerprint.  A factory rename is cosmetic (same
+    fp); resizing the slot block, changing a SlotField ``clear``, or
+    moving the quorum threshold — all invisible to the expanded node
+    fields and the handler ASTs — each change the base fingerprint."""
+    extra = [str(tmp_path)]
+
+    def introspect(ref):
+        out = memo_mod.introspect_child(ref, {}, None,
+                                        extra_sys_path=extra)
+        assert out["ok"] and not out["weak"], out
+        return out
+
+    base = introspect(_write_rep(tmp_path, "rep_a"))
+    renamed = introspect(_write_rep(tmp_path, "rep_b",
+                                    factory="build_replicated"))
+    assert renamed["spec_fp"] == base["spec_fp"]
+    assert renamed["base_fp"] == base["base_fp"]
+    resized = introspect(_write_rep(tmp_path, "rep_c", n=3))
+    cleared = introspect(_write_rep(tmp_path, "rep_d", clear=1))
+    rethresh = introspect(_write_rep(tmp_path, "rep_e",
+                                     threshold="all"))
+    fps = {v["base_fp"] for v in (base, resized, cleared, rethresh)}
+    assert len(fps) == 4, fps
+    # The handler ASTs never changed — only the declarations did.
+    assert resized["handlers"] == base["handlers"]
+    assert cleared["handlers"] == base["handlers"]
+    assert rethresh["handlers"] == base["handlers"]
+
+
+def test_duck_typed_slot_block_marks_weak():
+    """A partially-spec'd protocol (a slot declaration that is not a
+    real Slots block) fingerprints WEAK, so the store refuses to
+    memoize it rather than guess at its identity."""
+    from dslabs_tpu.tpu.compiler import (Field, MessageType, NodeKind,
+                                         ProtocolSpec, TimerType)
+    from dslabs_tpu.tpu.slots import SlotField, Slots
+
+    spec = ProtocolSpec(
+        "memo-duck",
+        nodes=[NodeKind("proc", 1, (
+            Field("x", hi=4),
+            Slots("log", 2, (SlotField("cmd", hi=7),), base=1)))],
+        messages=[MessageType("GO", ())],
+        timers=[TimerType("TICK", (), 10, 10)],
+        net_cap=4, timer_cap=1)
+
+    @spec.on("proc", "GO")
+    def go(ctx, m):
+        ctx.put("x", ctx.slot_get("log", "cmd", 1))
+
+    spec.initial_messages.append(("GO", 0, 0, {}))
+    spec.invariants["OK"] = lambda v: True
+    proto = spec.compile()
+    info = memo_mod.introspect_protocol(proto)
+    assert not info["weak"]
+
+    class DuckBlock:
+        # Enough surface for the Ctx slot ops (the effect-table trace
+        # still runs), but no ``name``/``fields`` — the declaration
+        # fingerprint cannot see inside it.
+        base, n = 1, 2
+
+        def lane(self, field):
+            return f"log.{field}"
+
+    spec.slot_blocks[("proc", "log")] = DuckBlock()
+    assert memo_mod.introspect_protocol(proto)["weak"]
+
+
 def test_divergence_bound_chain(tmp_path):
     """Tag-reachability lower-bounds the first level a changed handler
     can fire: editing S3 in the 3-stage chain shares levels 0..2."""

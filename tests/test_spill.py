@@ -474,7 +474,7 @@ def test_async_drain_abort_revert_chaos(lab1_base):
 def test_spill_parity_paxos_d5():
     """Third protocol family at depth 5 (the perf-smoke paxos rung)
     through the capacity ladder: exact parity at ~1/8 table capacity."""
-    from dslabs_tpu.tpu.protocols.paxos import make_paxos_protocol
+    from dslabs_tpu.tpu.specs_lab3 import make_paxos_protocol
 
     proto = make_paxos_protocol(n=3, n_clients=1, w=1, max_slots=2,
                                 net_cap=16, timer_cap=4)

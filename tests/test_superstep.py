@@ -77,7 +77,7 @@ def test_superstep_vs_legacy_parity_pingpong(strict):
 def test_superstep_vs_legacy_parity_paxos_d5():
     """The dry-run 8-device paxos rung of the perf-smoke parity gate
     (acceptance: exact verdict/unique/explored match at depth 5)."""
-    from dslabs_tpu.tpu.protocols.paxos import make_paxos_protocol
+    from dslabs_tpu.tpu.specs_lab3 import make_paxos_protocol
 
     proto = make_paxos_protocol(n=3, n_clients=1, w=1, max_slots=2,
                                 net_cap=16, timer_cap=4)
@@ -93,7 +93,7 @@ def test_superstep_vs_legacy_parity_paxos_d5():
 def test_superstep_vs_legacy_parity_shardstore_d4():
     """Second protocol family (lab 4 shardstore lane layout) through
     the same superstep machinery."""
-    from dslabs_tpu.tpu.protocols.shardstore import \
+    from dslabs_tpu.tpu.specs_lab4 import \
         make_shardstore_protocol
 
     proto = make_shardstore_protocol([[1], [2]])

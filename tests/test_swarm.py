@@ -401,7 +401,7 @@ def test_portfolio_deep_narrow_paxos():
     sits far deeper than a seconds-budget BFS clears, but the
     portfolio's swarm lane lands it with a verified witness (`make
     swarm-smoke`)."""
-    from dslabs_tpu.tpu.protocols.paxos import make_paxos_protocol
+    from dslabs_tpu.tpu.specs_lab3 import make_paxos_protocol
 
     proto = _violating(make_paxos_protocol(n=3, n_clients=1, w=2,
                                            max_slots=3))
@@ -426,7 +426,7 @@ def test_portfolio_deep_narrow_paxos():
 def test_deep_narrow_lab4_shardstore_swarm():
     """Deep-narrow on the lab 4 shardstore twin: the swarm reaches the
     deep completion state a bounded BFS cannot (`make swarm-smoke`)."""
-    from dslabs_tpu.tpu.protocols.shardstore import \
+    from dslabs_tpu.tpu.specs_lab4 import \
         make_shardstore_protocol
 
     base = make_shardstore_protocol(groups_of=[1, 2])

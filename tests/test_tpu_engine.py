@@ -207,7 +207,7 @@ def test_paxos_depth_parity():
     from dslabs_tpu.labs.clientserver.kvstore import KVStore
     from dslabs_tpu.labs.paxos.paxos import PaxosClient, PaxosServer
     from dslabs_tpu.search.search import BFS
-    from dslabs_tpu.tpu.protocols.paxos import make_paxos_protocol
+    from dslabs_tpu.tpu.specs_lab3 import make_paxos_protocol
 
     servers = tuple(LocalAddress(f"server{i}") for i in range(1, 4))
     gen = NodeGenerator(

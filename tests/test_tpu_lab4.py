@@ -19,7 +19,7 @@ from dslabs_tpu.search.settings import SearchSettings
 from dslabs_tpu.testing.predicates import RESULTS_OK
 
 from dslabs_tpu.tpu.engine import TensorSearch
-from dslabs_tpu.tpu.protocols.shardstore import make_shardstore_protocol
+from dslabs_tpu.tpu.specs_lab4 import make_shardstore_protocol
 
 import tests.test_lab4_shardstore as lab4
 
@@ -221,7 +221,7 @@ def test_join_twin_depth_parity():
     object oracle's unique-state counts depth by depth for both group
     counts, including full exhaustion of the done-pruned space."""
     from dslabs_tpu.testing.predicates import CLIENTS_DONE
-    from dslabs_tpu.tpu.protocols.shardmaster_join import \
+    from dslabs_tpu.tpu.specs_lab4 import \
         make_join_protocol
 
     for G in (1, 2):
@@ -275,7 +275,7 @@ def _object_tx_joined(max_levels, n_tx=1):
 def test_lab4_tx_depth_parity():
     """Cross-group 2PC twin parity (MultiPut spanning both groups —
     the flagship lab4 semantics on the tensor backend)."""
-    from dslabs_tpu.tpu.protocols.shardstore_tx import \
+    from dslabs_tpu.tpu.specs_lab4 import \
         make_shardstore_tx_protocol
 
     obj = _object_tx_joined(3)
@@ -294,7 +294,7 @@ def test_lab4_tx_two_shard_depth_parity():
     from dslabs_tpu.labs.shardedstore.txkvstore import (MultiPut,
                                                        MultiPutOk)
     from dslabs_tpu.testing.workload import Workload
-    from dslabs_tpu.tpu.protocols.shardstore_tx import \
+    from dslabs_tpu.tpu.specs_lab4 import \
         make_shardstore_tx_protocol
 
     state = lab4.make_search(2, 1, 1, 2)
@@ -320,7 +320,7 @@ def test_lab4_tx_two_shard_depth_parity():
 def test_lab4_tx_deep_parity():
     """Depths 4-5 (slow: the object oracle expands thousands of 2PC
     interleavings)."""
-    from dslabs_tpu.tpu.protocols.shardstore_tx import \
+    from dslabs_tpu.tpu.specs_lab4 import \
         make_shardstore_tx_protocol
 
     for d in (4, 5):
@@ -336,7 +336,7 @@ def test_lab4_tx_deep_parity():
 def test_lab4_tx_goal_and_invariant():
     """The 2PC twin completes the transaction (CLIENTS_DONE reached)
     with MULTI_GETS_MATCH clean along the way."""
-    from dslabs_tpu.tpu.protocols.shardstore_tx import \
+    from dslabs_tpu.tpu.specs_lab4 import \
         make_shardstore_tx_protocol
 
     ten = TensorSearch(make_shardstore_tx_protocol(n_tx=1), chunk=1024,
@@ -349,7 +349,7 @@ def test_lab4_tx2_depth_parity():
     """n_tx=2 (MultiPut then MultiGet) twin parity at depths 3-5.  The
     second transaction only becomes reachable much deeper; these depths
     pin the lane layout and the shared config-walk/2PC prefix."""
-    from dslabs_tpu.tpu.protocols.shardstore_tx import \
+    from dslabs_tpu.tpu.specs_lab4 import \
         make_shardstore_tx_protocol
 
     for d in (3, 4, 5):

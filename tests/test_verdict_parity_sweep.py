@@ -148,7 +148,7 @@ def _paxos_depth():
     from dslabs_tpu.search.search_state import SearchState
     from dslabs_tpu.search.settings import SearchSettings
     from dslabs_tpu.testing.generator import NodeGenerator
-    from dslabs_tpu.tpu.protocols.paxos import make_paxos_protocol
+    from dslabs_tpu.tpu.specs_lab3 import make_paxos_protocol
 
     servers = tuple(LocalAddress(f"server{i}") for i in range(1, 4))
     gen = NodeGenerator(
@@ -172,7 +172,7 @@ def _paxos_depth():
 
 def _shardstore_depth():
     import tests.test_tpu_lab4 as tl
-    from dslabs_tpu.tpu.protocols.shardstore import \
+    from dslabs_tpu.tpu.specs_lab4 import \
         make_shardstore_protocol
 
     obj = tl._object_joined(3)
@@ -183,7 +183,7 @@ def _shardstore_depth():
 
 def _shardstore_tx_depth():
     import tests.test_tpu_lab4 as tl
-    from dslabs_tpu.tpu.protocols.shardstore_tx import \
+    from dslabs_tpu.tpu.specs_lab4 import \
         make_shardstore_tx_protocol
 
     obj = tl._object_tx_joined(3)

@@ -17,7 +17,7 @@ from dslabs_tpu.testing.predicates import RESULTS_OK
 import tests.test_lab4_shardstore as t
 
 from dslabs_tpu.tpu.engine import TensorSearch
-from dslabs_tpu.tpu.protocols.shardstore import make_shardstore_protocol
+from dslabs_tpu.tpu.specs_lab4 import make_shardstore_protocol
 from tests.test_tpu_lab4 import WORKLOADS
 
 

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 from dslabs_tpu.tpu.engine import (TensorSearch, canonicalize_net,
                                    insert_messages, state_fingerprints,
                                    append_timers, flatten_state)
-from dslabs_tpu.tpu.protocols.paxos import make_paxos_protocol
+from dslabs_tpu.tpu.specs_lab3 import make_paxos_protocol
 from dslabs_tpu.tpu.telemetry import Telemetry, render_sites
 
 TEL = Telemetry(engine_hint="profile_chunk")

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dslabs_tpu.tpu.engine import SENTINEL, timer_deliverable_mask
-from dslabs_tpu.tpu.protocols.paxos import make_paxos_protocol
+from dslabs_tpu.tpu.specs_lab3 import make_paxos_protocol
 from dslabs_tpu.tpu.sharded import ShardedTensorSearch, make_mesh
 from dslabs_tpu.tpu.telemetry import Telemetry, render_sites
 

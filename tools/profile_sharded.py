@@ -12,7 +12,7 @@ jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 import numpy as np
 
-from dslabs_tpu.tpu.protocols.paxos import make_paxos_protocol
+from dslabs_tpu.tpu.specs_lab3 import make_paxos_protocol
 from dslabs_tpu.tpu.sharded import ShardedTensorSearch, make_mesh
 from dslabs_tpu.tpu.telemetry import Telemetry, render_sites
 
